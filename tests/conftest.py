@@ -1,0 +1,30 @@
+"""Test harness config.
+
+Forces JAX onto a virtual 8-device CPU mesh (the environment has at most one
+real TPU chip; multi-chip sharding is validated on host devices — see
+__graft_entry__.dryrun_multichip). Must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def workspace(tmp_path):
+    ws = tmp_path / "workspace"
+    ws.mkdir()
+    return ws
+
+
+@pytest.fixture
+def openclaw_home(tmp_path, monkeypatch):
+    home = tmp_path / "openclaw-home"
+    home.mkdir()
+    monkeypatch.setenv("OPENCLAW_HOME", str(home))
+    return home

@@ -1,0 +1,29 @@
+"""Shared test fixtures.
+
+The reference's key testing pattern (SURVEY §4) is a mock gateway api with a
+``_fire`` helper (nats-eventstore/test/helpers.ts:21-35). Our Gateway *is*
+that harness, so tests mostly construct a real Gateway with a frozen clock and
+a capturing logger.
+"""
+
+from __future__ import annotations
+
+from vainplex_openclaw_tpu.core import Gateway, list_logger
+
+
+class FakeClock:
+    def __init__(self, start: float = 1_700_000_000.0):
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> float:
+        self.t += seconds
+        return self.t
+
+
+def make_gateway(config=None, clock=None):
+    logger = list_logger()
+    gw = Gateway(config=config or {}, logger=logger, clock=clock or FakeClock())
+    return gw, logger

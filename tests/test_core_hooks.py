@@ -1,0 +1,207 @@
+"""Kernel tests: priority ordering, error isolation, result merging, lifecycle.
+
+Coverage model: reference hook tests (governance/test/hooks.test.ts,
+nats-eventstore/test/hooks.test.ts) exercised through the first-class Gateway.
+"""
+
+import asyncio
+
+import pytest
+
+from vainplex_openclaw_tpu.core import Gateway, PluginCommand, PluginService
+from vainplex_openclaw_tpu.core.api import HookBus, list_logger
+
+from helpers import make_gateway
+
+
+def test_handlers_run_in_ascending_priority_order():
+    gw, _ = make_gateway()
+    order = []
+    gw.bus.on("message_received", lambda e, c: order.append("enforce"), priority=1000, plugin_id="g")
+    gw.bus.on("message_received", lambda e, c: order.append("inject"), priority=5, plugin_id="c")
+    gw.bus.on("message_received", lambda e, c: order.append("resolve"), priority=950, plugin_id="r")
+    gw.message_received("hi")
+    assert order == ["inject", "resolve", "enforce"]
+
+
+def test_equal_priority_is_registration_order():
+    gw, _ = make_gateway()
+    order = []
+    for name in ("a", "b", "c"):
+        gw.bus.on("message_received", lambda e, c, n=name: order.append(n), priority=100, plugin_id=name)
+    gw.message_received("x")
+    assert order == ["a", "b", "c"]
+
+
+def test_handler_error_is_isolated_and_counted():
+    gw, logger = make_gateway()
+
+    def boom(e, c):
+        raise RuntimeError("kaput")
+
+    seen = []
+    gw.bus.on("message_received", boom, priority=1, plugin_id="bad")
+    gw.bus.on("message_received", lambda e, c: seen.append(e["content"]), priority=2, plugin_id="good")
+    gw.message_received("survives")
+    assert seen == ["survives"]
+    assert gw.bus.stats["message_received"].errors == 1
+    assert any("kaput" in m for m in logger.messages("error"))
+
+
+def test_before_tool_call_block_short_circuits():
+    gw, _ = make_gateway()
+    ran = []
+    gw.bus.on("before_tool_call", lambda e, c: {"block": True, "block_reason": "policy"}, priority=10, plugin_id="g")
+    gw.bus.on("before_tool_call", lambda e, c: ran.append(1), priority=20, plugin_id="late")
+    d = gw.before_tool_call("exec", {"command": "rm -rf /"})
+    assert d.blocked and d.block_reason == "policy"
+    assert ran == []
+
+
+def test_before_tool_call_params_mutation_chains():
+    gw, _ = make_gateway()
+    seen_by_second = {}
+
+    def resolve(e, c):
+        return {"params": {**e["params"], "token": "real-secret"}}
+
+    def enforce(e, c):
+        seen_by_second.update(e["params"])
+        return None
+
+    gw.bus.on("before_tool_call", resolve, priority=950, plugin_id="redaction")
+    gw.bus.on("before_tool_call", enforce, priority=1000, plugin_id="governance")
+    d = gw.before_tool_call("http", {"token": "[REDACTED:credential:abc123ff]"})
+    assert seen_by_second["token"] == "real-secret"
+    assert d.params["token"] == "real-secret"
+
+
+def test_async_handler_supported_on_async_hooks():
+    gw, _ = make_gateway()
+
+    async def approver(e, c):
+        await asyncio.sleep(0)
+        return {"block": False}
+
+    gw.bus.on("before_tool_call", approver, priority=1000, plugin_id="2fa")
+    d = gw.before_tool_call("exec", {"command": "ls"})
+    assert d.allowed
+
+
+def test_sync_only_hook_rejects_async_handler():
+    gw, logger = make_gateway()
+
+    async def bad(e, c):
+        return {"content": "nope"}
+
+    gw.bus.on("before_message_write", bad, priority=100, plugin_id="bad")
+    d = gw.before_message_write("hello")
+    assert d.final_text == "hello"  # handler rejected, content untouched
+    assert gw.bus.stats["before_message_write"].errors == 1
+    assert any("is async" in m for m in logger.messages("error"))
+
+
+def test_outbound_content_mutation_chains_and_block_fallback():
+    gw, _ = make_gateway()
+    gw.bus.on("before_message_write", lambda e, c: {"content": e["content"].replace("sk-live", "[RED]")},
+              priority=900, plugin_id="redact")
+    gw.bus.on("before_message_write",
+              lambda e, c: {"block": True, "fallback_message": "blocked by gate"} if "[RED]" in e["content"] else None,
+              priority=1000, plugin_id="gate")
+    d = gw.before_message_write("key is sk-live")
+    assert d.blocked and d.final_text == "blocked by gate"
+    assert d.content == "key is [RED]"
+
+
+def test_tool_result_persist_mutates_synchronously():
+    gw, _ = make_gateway()
+    gw.bus.on("tool_result_persist", lambda e, c: {"result": str(e["result"]).upper()}, priority=100, plugin_id="r")
+    out = gw.tool_result_persist("read", "secret text")
+    assert out == "SECRET TEXT"
+
+
+def test_run_tool_full_roundtrip_blocked_and_allowed():
+    gw, _ = make_gateway()
+    after = []
+    gw.bus.on("before_tool_call",
+              lambda e, c: {"block": True, "block_reason": "deny"} if e["tool_name"] == "exec" else None,
+              priority=1000, plugin_id="g")
+    gw.bus.on("after_tool_call", lambda e, c: after.append((e["tool_name"], e["error"])), priority=900, plugin_id="g")
+    d, res = gw.run_tool("exec", {"command": "x"}, lambda p: "ran")
+    assert d.blocked and res is None
+    d2, res2 = gw.run_tool("read", {"path": "f"}, lambda p: "ran")
+    assert d2.allowed and res2 == "ran"
+    assert after[0] == ("exec", "blocked: deny") and after[1] == ("read", None)
+
+
+def test_services_commands_methods_lifecycle():
+    gw, _ = make_gateway()
+    events = []
+
+    class Plug:
+        id = "demo"
+
+        def register(self, api):
+            api.register_service(PluginService(
+                id="svc",
+                start=lambda ctx: events.append("start"),
+                stop=lambda ctx: events.append("stop"),
+            ))
+            api.register_command(PluginCommand(
+                name="status", description="", handler=lambda ctx: {"text": "ok"}))
+            api.register_gateway_method("demo.ping", lambda: "pong")
+            api.on("gateway_start", lambda e, c: events.append("hook-start"), priority=1)
+
+    gw.load(Plug())
+    gw.start()
+    assert events == ["start", "hook-start"]
+    assert gw.command("/status")["text"] == "ok"
+    assert gw.call_method("demo.ping") == "pong"
+    gw.stop()
+    assert events[-1] == "stop"
+
+
+def test_failing_service_does_not_block_gateway_start():
+    gw, logger = make_gateway()
+
+    class Bad:
+        id = "bad"
+
+        def register(self, api):
+            api.register_service(PluginService(id="svc", start=lambda ctx: 1 / 0))
+
+    gw.load(Bad())
+    gw.start()
+    assert any("failed to start" in m for m in logger.messages("error"))
+
+
+def test_unknown_command_and_command_error_are_soft():
+    gw, _ = make_gateway()
+    assert "unknown command" in gw.command("/nope")["text"]
+
+    class P:
+        id = "p"
+
+        def register(self, api):
+            api.register_command(PluginCommand(name="bad", description="", handler=lambda ctx: 1 / 0))
+
+    gw.load(P())
+    assert "failed" in gw.command("/bad")["text"]
+
+
+def test_hookbus_stats_track_fires():
+    bus = HookBus(list_logger())
+    bus.on("session_start", lambda e, c: None, plugin_id="x")
+    bus.fire_sync("session_start", {}, {})
+    bus.fire_sync("session_start", {}, {})
+    assert bus.stats["session_start"].fired == 2
+    assert bus.stats["session_start"].errors == 0
+
+
+def test_until_short_circuit_stops_stats_clean():
+    gw, _ = make_gateway()
+    calls = []
+    gw.bus.on("before_tool_call", lambda e, c: calls.append("a") or {"block": True}, priority=1, plugin_id="a")
+    gw.bus.on("before_tool_call", lambda e, c: calls.append("b"), priority=2, plugin_id="b")
+    gw.before_tool_call("t", {})
+    assert calls == ["a"]

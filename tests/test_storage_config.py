@@ -1,0 +1,134 @@
+"""Storage + config substrate tests (reference: cortex/test/storage.test.ts,
+governance config-loader tests)."""
+
+import json
+import os
+
+from vainplex_openclaw_tpu.config.loader import deep_merge, load_plugin_config
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.storage import (
+    AtomicStorage,
+    Debouncer,
+    append_jsonl,
+    is_file_older_than,
+    is_writable,
+    read_json,
+    read_jsonl,
+    reboot_dir,
+    write_json_atomic,
+)
+from vainplex_openclaw_tpu.storage.atomic import daily_jsonl_name
+
+
+def test_atomic_write_and_read_roundtrip(tmp_path):
+    p = tmp_path / "deep" / "state.json"
+    write_json_atomic(p, {"a": 1, "nested": {"b": [1, 2]}})
+    assert read_json(p) == {"a": 1, "nested": {"b": [1, 2]}}
+    # no tmp litter
+    assert [f.name for f in p.parent.iterdir()] == ["state.json"]
+
+
+def test_read_json_default_on_corrupt(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{not json", encoding="utf-8")
+    assert read_json(p, default={"ok": True}) == {"ok": True}
+
+
+def test_jsonl_append_and_read_skips_bad_lines(tmp_path):
+    p = tmp_path / "log.jsonl"
+    append_jsonl(p, [{"i": 1}, {"i": 2}])
+    with p.open("a") as fh:
+        fh.write("garbage\n")
+    append_jsonl(p, [{"i": 3}])
+    assert [r["i"] for r in read_jsonl(p)] == [1, 2, 3]
+
+
+def test_debouncer_manual_mode_no_threads(tmp_path):
+    hits = []
+    deb = Debouncer(lambda: hits.append(1), delay_s=999, wall=False)
+    deb.trigger()
+    deb.trigger()
+    assert hits == [] and deb.pending
+    deb.flush()
+    assert hits == [1]
+    deb.flush()  # idempotent when nothing pending
+    assert hits == [1]
+
+
+def test_atomic_storage_debounced_save(tmp_path):
+    store = AtomicStorage(tmp_path, wall=False)
+    state = {"n": 0}
+    store.save_debounced("s.json", lambda: dict(state), delay_s=15)
+    state["n"] = 5
+    store.flush_all()
+    assert store.load("s.json") == {"n": 5}
+
+
+def test_workspace_conventions(tmp_path):
+    ws = tmp_path / "ws"
+    rd = reboot_dir(ws)
+    assert str(rd).endswith("memory/reboot")
+    assert is_writable(rd)
+    f = rd / "x.json"
+    write_json_atomic(f, {})
+    assert not is_file_older_than(f, hours=1)
+    old = os.stat(f).st_mtime - 7200
+    os.utime(f, (old, old))
+    assert is_file_older_than(f, hours=1)
+    assert is_file_older_than(rd / "missing.json", hours=1)
+
+
+def test_daily_jsonl_name():
+    assert daily_jsonl_name(0) == "1970-01-01.jsonl"
+
+
+def test_deep_merge_defaults_survive():
+    d = {"a": 1, "b": {"c": 2, "d": 3}, "e": [1]}
+    o = {"b": {"c": 9}, "f": "new"}
+    assert deep_merge(d, o) == {"a": 1, "b": {"c": 9, "d": 3}, "e": [1], "f": "new"}
+
+
+def test_load_plugin_config_bootstraps_default(tmp_path):
+    log = list_logger()
+    cfg = load_plugin_config("governance", inline={"enabled": True},
+                             defaults={"failMode": "open", "trust": {"seed": 0.5}},
+                             home=tmp_path, logger=log)
+    assert cfg["failMode"] == "open" and cfg["enabled"] is True
+    written = json.loads((tmp_path / "plugins" / "governance" / "config.json").read_text())
+    assert written["trust"]["seed"] == 0.5
+    assert any("bootstrapped" in m for m in log.messages("info"))
+
+
+def test_load_plugin_config_external_overrides(tmp_path):
+    ext = tmp_path / "plugins" / "cortex" / "config.json"
+    ext.parent.mkdir(parents=True)
+    ext.write_text(json.dumps({"languages": ["de"], "enabled": False}))
+    cfg = load_plugin_config("cortex", inline={"enabled": True},
+                             defaults={"languages": ["en"], "maxThreads": 50}, home=tmp_path)
+    assert cfg["languages"] == ["de"] and cfg["maxThreads"] == 50
+    assert cfg["enabled"] is False  # external file wins over inline pointer
+
+
+def test_load_plugin_config_legacy_inline(tmp_path):
+    cfg = load_plugin_config("ke", inline={"enabled": True, "decayHours": 4},
+                             defaults={"decayHours": 24, "x": 1}, home=tmp_path)
+    assert cfg["decayHours"] == 4 and cfg["x"] == 1
+    # legacy inline never touches disk
+    assert not (tmp_path / "plugins" / "ke").exists()
+
+
+def test_load_plugin_config_corrupt_external_falls_back(tmp_path):
+    ext = tmp_path / "plugins" / "g" / "config.json"
+    ext.parent.mkdir(parents=True)
+    ext.write_text("{broken")
+    log = list_logger()
+    cfg = load_plugin_config("g", inline={}, defaults={"ok": 1}, home=tmp_path, logger=log)
+    assert cfg["ok"] == 1
+    assert any("failed to read" in m for m in log.messages("warn"))
+
+
+def test_explicit_config_path(tmp_path):
+    p = tmp_path / "custom.json"
+    p.write_text(json.dumps({"v": 7}))
+    cfg = load_plugin_config("g", inline={"configPath": str(p)}, defaults={"v": 1}, home=tmp_path)
+    assert cfg["v"] == 7

@@ -1,0 +1,34 @@
+"""vainplex-openclaw-tpu: a TPU-native re-design of the vainplex-openclaw suite.
+
+The reference (alberthild/vainplex-openclaw) is a six-package agent-framework
+plugin suite for the OpenClaw gateway: a policy firewall (governance), a
+conversation-intelligence layer (cortex), a knowledge extractor, a NATS
+JetStream event store, a sitrep generator, and an installer CLI. This package
+rebuilds that full capability set as one coherent framework:
+
+- ``core``       — the plugin kernel: hook bus, services, commands, gateway
+                   methods, plus a first-class host gateway harness
+                   (reference: packages/openclaw-governance/src/types.ts:10-41).
+- ``config``     — external-config loading with bootstrap-write defaults
+                   (reference: governance/src/config-loader.ts).
+- ``storage``    — atomic JSON/JSONL persistence and workspace conventions
+                   (reference: cortex/src/storage.ts, brainplex/src/writer.ts).
+- ``events``     — event envelope + pluggable event store
+                   (reference: openclaw-nats-eventstore).
+- ``governance`` — the agent firewall (reference: openclaw-governance).
+- ``cortex``     — trackers, boot context, trace analyzer (reference:
+                   openclaw-cortex).
+- ``knowledge``  — entity/fact extraction (reference: openclaw-knowledge-engine).
+- ``sitrep``     — situation-report aggregation (reference: openclaw-sitrep).
+- ``brainplex``  — the installer CLI (reference: brainplex).
+- ``ops``/``models``/``parallel`` — the TPU-native numeric layer: JAX/Pallas
+  kernels for the framework's batch-numeric surfaces (signal similarity
+  scanning, embedding, triage classification) and the sharded flagship
+  encoder model that backs them.
+
+Unlike the reference (whose compute-heavy paths shell out to an external LLM
+over HTTP), the numeric corners here are designed TPU-first: batched, static
+shapes, bfloat16 matmuls, sharded over a ``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"

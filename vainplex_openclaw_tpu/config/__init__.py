@@ -1,0 +1,5 @@
+"""Config conventions shared by every plugin."""
+
+from .loader import deep_merge, load_plugin_config, plugins_dir
+
+__all__ = ["deep_merge", "load_plugin_config", "plugins_dir"]

@@ -1,0 +1,302 @@
+"""The plugin API contract.
+
+This is the one seam every subsystem attaches through, equivalent to the
+reference's ``OpenClawPluginApi`` (openclaw-governance/src/types.ts:10-41,
+duplicated per package there; shared here because the gateway is in-repo).
+
+Semantics:
+
+- Hooks are named lifecycle events (``before_tool_call``, ``message_received``,
+  ...). Handlers register with an integer priority and run in **ascending**
+  priority order (5 before 950 before 1000), stable by registration order
+  within a priority. This matches the reference's observed ordering: redaction
+  vault resolution (prio 950) runs before governance enforcement (prio 1000)
+  on ``before_tool_call`` (governance/src/redaction/hooks.ts:121-125 vs
+  src/hooks.ts:883), and context injection registers at prio 5 to run first.
+- Handlers may be sync functions or ``async def``. Certain hooks are declared
+  synchronous (``before_message_write`` — reference engine.ts:360-365 requires
+  output validation to stay sync) and the bus rejects coroutine results there.
+- Every handler invocation is wrapped in try/except: a plugin must never crash
+  the gateway (reference: each handler try/caught, e.g. cortex hooks.ts:127-130).
+  Errors are logged and counted; the hook continues with later handlers.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional, Protocol, Union
+
+HookHandler = Callable[..., Union[Any, Awaitable[Any]]]
+
+# Hooks whose handlers must be synchronous (results are needed inline, before
+# the gateway writes the message).
+SYNC_ONLY_HOOKS = frozenset({"before_message_write", "tool_result_persist"})
+
+KNOWN_HOOKS = (
+    "before_tool_call",
+    "after_tool_call",
+    "tool_result_persist",
+    "message_received",
+    "message_sending",
+    "message_sent",
+    "before_message_write",
+    "before_agent_start",
+    "agent_end",
+    "session_start",
+    "session_end",
+    "before_compaction",
+    "after_compaction",
+    "gateway_start",
+    "gateway_stop",
+    "llm_input",
+    "llm_output",
+)
+
+
+class PluginLogger(Protocol):
+    def info(self, msg: str) -> None: ...
+    def warn(self, msg: str) -> None: ...
+    def error(self, msg: str) -> None: ...
+    def debug(self, msg: str) -> None: ...
+
+
+@dataclass
+class _StdLogger:
+    """Default logger: ``[plugin-id]``-prefixed lines into :mod:`logging`."""
+
+    prefix: str
+    _log: logging.Logger = field(default_factory=lambda: logging.getLogger("openclaw"))
+
+    def _fmt(self, msg: str) -> str:
+        return msg if msg.startswith("[") else f"[{self.prefix}] {msg}"
+
+    def info(self, msg: str) -> None:
+        self._log.info(self._fmt(msg))
+
+    def warn(self, msg: str) -> None:
+        self._log.warning(self._fmt(msg))
+
+    def error(self, msg: str) -> None:
+        self._log.error(self._fmt(msg))
+
+    def debug(self, msg: str) -> None:
+        self._log.debug(self._fmt(msg))
+
+
+def make_logger(plugin_id: str) -> PluginLogger:
+    return _StdLogger(plugin_id)
+
+
+@dataclass
+class ListLogger:
+    """Test logger capturing ``(level, msg)`` pairs.
+
+    Mirrors the reference's ``createMockLogger`` fixture
+    (cortex/test/trace-analyzer/helpers.ts:149-158) — here it is part of the
+    framework because the host harness is first-class.
+    """
+
+    records: list[tuple[str, str]] = field(default_factory=list)
+
+    def info(self, msg: str) -> None:
+        self.records.append(("info", msg))
+
+    def warn(self, msg: str) -> None:
+        self.records.append(("warn", msg))
+
+    def error(self, msg: str) -> None:
+        self.records.append(("error", msg))
+
+    def debug(self, msg: str) -> None:
+        self.records.append(("debug", msg))
+
+    def messages(self, level: Optional[str] = None) -> list[str]:
+        return [m for lv, m in self.records if level is None or lv == level]
+
+
+def list_logger() -> ListLogger:
+    return ListLogger()
+
+
+@dataclass
+class PluginService:
+    id: str
+    start: Callable[[Any], Any]
+    stop: Optional[Callable[[Any], Any]] = None
+
+
+@dataclass
+class PluginCommand:
+    name: str
+    description: str
+    handler: Callable[..., dict]
+    require_auth: bool = False
+    accepts_args: bool = False
+
+
+@dataclass
+class _Registration:
+    priority: int
+    seq: int
+    plugin_id: str
+    handler: HookHandler
+
+
+@dataclass
+class HookStats:
+    fired: int = 0
+    errors: int = 0
+    last_fired_at: Optional[float] = None
+    last_error: Optional[str] = None
+
+
+class HookBus:
+    """Priority-ordered hook dispatch with per-hook fire/error diagnostics.
+
+    Diagnostics mirror cortex's per-hook fire counters
+    (cortex/src/hooks.ts:31-36,71-77) but live in the kernel so every plugin
+    gets them for free.
+    """
+
+    def __init__(self, logger: Optional[PluginLogger] = None, clock: Callable[[], float] = time.time):
+        self._handlers: dict[str, list[_Registration]] = {}
+        self._seq = 0
+        self._logger = logger or make_logger("hook-bus")
+        self._clock = clock
+        self.stats: dict[str, HookStats] = {}
+
+    def on(self, hook_name: str, handler: HookHandler, priority: int = 100, plugin_id: str = "?") -> None:
+        self._seq += 1
+        reg = _Registration(priority=priority, seq=self._seq, plugin_id=plugin_id, handler=handler)
+        regs = self._handlers.setdefault(hook_name, [])
+        regs.append(reg)
+        regs.sort(key=lambda r: (r.priority, r.seq))
+
+    def handlers_for(self, hook_name: str) -> list[_Registration]:
+        return list(self._handlers.get(hook_name, ()))
+
+    def _record(self, hook_name: str, error: Optional[str]) -> None:
+        st = self.stats.setdefault(hook_name, HookStats())
+        st.fired += 1
+        st.last_fired_at = self._clock()
+        if error is not None:
+            st.errors += 1
+            st.last_error = error
+
+    async def fire(
+        self,
+        hook_name: str,
+        *args: Any,
+        until: Optional[Callable[[Any], bool]] = None,
+        on_result: Optional[Callable[[Any], None]] = None,
+    ) -> list[Any]:
+        """Run all handlers in priority order; return their non-None results.
+
+        ``until(result)`` short-circuits the chain when it returns True (used
+        by the gateway for block verdicts). ``on_result`` is invoked after each
+        non-None result so the caller can fold mutations (e.g. redacted params)
+        into the shared event before the next handler sees it.
+        """
+        results: list[Any] = []
+        err: Optional[str] = None
+        for reg in self.handlers_for(hook_name):
+            try:
+                out = reg.handler(*args)
+                if inspect.isawaitable(out):
+                    if hook_name in SYNC_ONLY_HOOKS:
+                        raise TypeError(
+                            f"hook '{hook_name}' is synchronous; handler from "
+                            f"plugin '{reg.plugin_id}' returned a coroutine"
+                        )
+                    out = await out
+            except Exception as exc:  # noqa: BLE001 — plugins must not crash the gateway
+                err = f"{reg.plugin_id}/{hook_name}: {exc}"
+                self._logger.error(f"[hook-bus] handler error in {err}")
+                continue
+            if out is not None:
+                results.append(out)
+                if on_result is not None:
+                    on_result(out)
+                if until is not None and until(out):
+                    break
+        self._record(hook_name, err)
+        return results
+
+    def fire_sync(
+        self,
+        hook_name: str,
+        *args: Any,
+        until: Optional[Callable[[Any], bool]] = None,
+        on_result: Optional[Callable[[Any], None]] = None,
+    ) -> list[Any]:
+        """Synchronous dispatch; rejects async handlers on any hook."""
+        results: list[Any] = []
+        err: Optional[str] = None
+        for reg in self.handlers_for(hook_name):
+            try:
+                out = reg.handler(*args)
+                if inspect.isawaitable(out):
+                    out.close()
+                    raise TypeError(
+                        f"sync fire of '{hook_name}': handler from plugin "
+                        f"'{reg.plugin_id}' is async"
+                    )
+            except Exception as exc:  # noqa: BLE001
+                err = f"{reg.plugin_id}/{hook_name}: {exc}"
+                self._logger.error(f"[hook-bus] handler error in {err}")
+                continue
+            if out is not None:
+                results.append(out)
+                if on_result is not None:
+                    on_result(out)
+                if until is not None and until(out):
+                    break
+        self._record(hook_name, err)
+        return results
+
+
+class PluginApi:
+    """The per-plugin view handed to ``plugin.register(api)``.
+
+    Field-for-field equivalent of the reference contract
+    (governance/src/types.ts:10-26): ``id``, ``plugin_config``, ``logger``,
+    ``config``, ``register_service``, ``register_command``,
+    ``register_gateway_method``, ``on``.
+    """
+
+    def __init__(
+        self,
+        plugin_id: str,
+        gateway: "Any",
+        plugin_config: Optional[dict] = None,
+        logger: Optional[PluginLogger] = None,
+    ):
+        self.id = plugin_id
+        self.plugin_config = plugin_config or {}
+        self.logger = logger or make_logger(plugin_id)
+        self._gateway = gateway
+
+    @property
+    def config(self) -> dict:
+        """The gateway-level config (openclaw.json equivalent)."""
+        return self._gateway.config
+
+    def register_service(self, service: PluginService) -> None:
+        self._gateway._register_service(self.id, service)
+
+    def register_command(self, command: PluginCommand) -> None:
+        self._gateway._register_command(self.id, command)
+
+    def register_gateway_method(self, method: str, handler: Callable[..., Any]) -> None:
+        self._gateway._register_gateway_method(self.id, method, handler)
+
+    def register_tool(self, tool: dict) -> None:
+        """Optional agent-tool registration (reference: cortex/index.ts checks
+        ``api.registerTool`` existence before registering its 5 tools)."""
+        self._gateway._register_tool(self.id, tool)
+
+    def on(self, hook_name: str, handler: HookHandler, priority: int = 100) -> None:
+        self._gateway.bus.on(hook_name, handler, priority=priority, plugin_id=self.id)

@@ -1,0 +1,291 @@
+"""The host gateway harness.
+
+The reference suite assumes an *external* OpenClaw gateway and only ships a
+test mock of it (``createMockApi`` with ``_fire`` —
+openclaw-nats-eventstore/test/helpers.ts:21-35). Here the gateway host is a
+first-class component: it loads plugins, owns the hook bus, runs service
+lifecycles, dispatches commands and gateway RPC methods, and exposes typed
+entry points for the flows that matter (tool calls, messages, sessions,
+compaction). Everything is in-process, mirroring the reference's
+single-event-loop execution model (SURVEY §3.1).
+
+Hook result merging implemented here (reference: gateway-side semantics
+reverse-engineered from handler return shapes, governance/src/types.ts:44-55
+``HookBeforeToolCallResult {params?, block?, blockReason?}`` and the
+response-gate fallback-message flow, governance/src/hooks.ts:339-353):
+
+- ``before_tool_call``: first ``block`` verdict wins and stops the chain;
+  ``params`` results replace the event's params for later handlers and for
+  the tool itself.
+- ``tool_result_persist``: synchronous; ``result`` mutations chain.
+- ``message_sending`` / ``before_message_write``: ``content`` mutations chain;
+  ``block`` stops the chain, optionally substituting ``fallback_message``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .api import HookBus, PluginApi, PluginCommand, PluginLogger, PluginService, make_logger
+
+
+@dataclass
+class ToolCallDecision:
+    blocked: bool
+    block_reason: Optional[str]
+    params: dict
+
+    @property
+    def allowed(self) -> bool:
+        return not self.blocked
+
+
+@dataclass
+class MessageWriteDecision:
+    blocked: bool
+    content: str
+    fallback_message: Optional[str] = None
+
+    @property
+    def final_text(self) -> str:
+        if self.blocked:
+            return self.fallback_message or ""
+        return self.content
+
+
+@dataclass
+class _LoadedPlugin:
+    plugin_id: str
+    api: PluginApi
+    module: Any
+
+
+def _run(coro):
+    """Run a coroutine to completion from sync code (no nested loops)."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    raise RuntimeError("use the async gateway methods inside an event loop")
+
+
+class Gateway:
+    """In-process host: plugin loader + hook dispatcher + service supervisor."""
+
+    def __init__(
+        self,
+        config: Optional[dict] = None,
+        logger: Optional[PluginLogger] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.config = config or {}
+        self.logger = logger or make_logger("gateway")
+        self.clock = clock
+        self.bus = HookBus(self.logger, clock=clock)
+        self.plugins: dict[str, _LoadedPlugin] = {}
+        self.services: list[tuple[str, PluginService]] = []
+        self.commands: dict[str, PluginCommand] = {}
+        self.methods: dict[str, Callable[..., Any]] = {}
+        self.tools: dict[str, dict] = {}
+        self._started = False
+
+    # ── plugin registry ──────────────────────────────────────────────
+
+    def load(self, plugin: Any, plugin_config: Optional[dict] = None,
+             logger: Optional[PluginLogger] = None) -> PluginApi:
+        """Load a plugin object exposing ``id`` and ``register(api)``."""
+        plugin_id = getattr(plugin, "id", None) or getattr(plugin, "ID", None)
+        if not plugin_id:
+            raise ValueError("plugin must expose an 'id'")
+        api = PluginApi(plugin_id, self, plugin_config=plugin_config, logger=logger)
+        plugin.register(api)
+        self.plugins[plugin_id] = _LoadedPlugin(plugin_id, api, plugin)
+        return api
+
+    def _register_service(self, plugin_id: str, service: PluginService) -> None:
+        self.services.append((plugin_id, service))
+        if self._started:
+            self._start_service(plugin_id, service)
+
+    def _register_command(self, plugin_id: str, command: PluginCommand) -> None:
+        self.commands[command.name] = command
+
+    def _register_gateway_method(self, plugin_id: str, method: str, handler: Callable[..., Any]) -> None:
+        self.methods[method] = handler
+
+    def _register_tool(self, plugin_id: str, tool: dict) -> None:
+        self.tools[tool["name"]] = tool
+
+    # ── lifecycle ────────────────────────────────────────────────────
+
+    def _start_service(self, plugin_id: str, service: PluginService) -> None:
+        try:
+            out = service.start(self)
+            if asyncio.iscoroutine(out):
+                _run(out)
+        except Exception as exc:  # noqa: BLE001 — a bad service must not take the gateway down
+            self.logger.error(f"[gateway] service {plugin_id}/{service.id} failed to start: {exc}")
+
+    def start(self) -> None:
+        self._started = True
+        for plugin_id, service in self.services:
+            self._start_service(plugin_id, service)
+        self.fire("gateway_start", {}, {})
+
+    def stop(self) -> None:
+        self.fire("gateway_stop", {}, {})
+        for plugin_id, service in reversed(self.services):
+            if service.stop is None:
+                continue
+            try:
+                out = service.stop(self)
+                if asyncio.iscoroutine(out):
+                    _run(out)
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(f"[gateway] service {plugin_id}/{service.id} failed to stop: {exc}")
+        self._started = False
+
+    # ── generic hook firing (the mock-api `_fire` equivalent) ────────
+
+    def fire(self, hook_name: str, *args: Any) -> list[Any]:
+        return _run(self.bus.fire(hook_name, *args))
+
+    async def fire_async(self, hook_name: str, *args: Any) -> list[Any]:
+        return await self.bus.fire(hook_name, *args)
+
+    # ── typed flows ──────────────────────────────────────────────────
+
+    async def before_tool_call_async(self, tool_name: str, params: dict,
+                                     ctx: Optional[dict] = None) -> ToolCallDecision:
+        event = {"tool_name": tool_name, "params": dict(params)}
+        ctx = dict(ctx or {})
+        ctx.setdefault("tool_name", tool_name)
+
+        def fold(result: Any) -> None:
+            if isinstance(result, dict) and result.get("params") is not None:
+                event["params"] = result["params"]
+
+        results = await self.bus.fire(
+            "before_tool_call", event, ctx,
+            until=lambda r: isinstance(r, dict) and bool(r.get("block")),
+            on_result=fold,
+        )
+        for r in results:
+            if isinstance(r, dict) and r.get("block"):
+                return ToolCallDecision(True, r.get("block_reason") or r.get("blockReason"), event["params"])
+        return ToolCallDecision(False, None, event["params"])
+
+    def before_tool_call(self, tool_name: str, params: dict, ctx: Optional[dict] = None) -> ToolCallDecision:
+        return _run(self.before_tool_call_async(tool_name, params, ctx))
+
+    def after_tool_call(self, tool_name: str, params: dict, result: Any = None,
+                        error: Optional[str] = None, ctx: Optional[dict] = None) -> None:
+        event = {"tool_name": tool_name, "params": params, "result": result, "error": error}
+        ctx = dict(ctx or {})
+        ctx.setdefault("tool_name", tool_name)
+        self.fire("after_tool_call", event, ctx)
+
+    def tool_result_persist(self, tool_name: str, result: Any, ctx: Optional[dict] = None) -> Any:
+        """Synchronous mutation point before a tool result enters LLM context
+        (reference: redaction Layer 1, redaction/hooks.ts:33-47)."""
+        event = {"tool_name": tool_name, "result": result}
+        ctx = dict(ctx or {})
+        ctx.setdefault("tool_name", tool_name)
+
+        def fold(r: Any) -> None:
+            if isinstance(r, dict) and "result" in r:
+                event["result"] = r["result"]
+
+        self.bus.fire_sync("tool_result_persist", event, ctx, on_result=fold)
+        return event["result"]
+
+    def run_tool(self, tool_name: str, params: dict, fn: Callable[[dict], Any],
+                 ctx: Optional[dict] = None) -> tuple[ToolCallDecision, Any]:
+        """Full tool round-trip: before → execute → persist-mutate → after."""
+        decision = self.before_tool_call(tool_name, params, ctx)
+        if decision.blocked:
+            self.after_tool_call(tool_name, params, None, error=f"blocked: {decision.block_reason}", ctx=ctx)
+            return decision, None
+        try:
+            raw = fn(decision.params)
+            err = None
+        except Exception as exc:  # noqa: BLE001 — tool failures flow into after_tool_call as errors
+            raw, err = None, str(exc)
+        persisted = self.tool_result_persist(tool_name, raw, ctx) if err is None else None
+        self.after_tool_call(tool_name, decision.params, persisted, error=err, ctx=ctx)
+        return decision, persisted
+
+    def message_received(self, content: str, ctx: Optional[dict] = None) -> list[Any]:
+        return self.fire("message_received", {"content": content}, dict(ctx or {}))
+
+    def message_sending(self, content: str, ctx: Optional[dict] = None) -> MessageWriteDecision:
+        return self._outbound("message_sending", content, ctx, sync=False)
+
+    def before_message_write(self, content: str, ctx: Optional[dict] = None) -> MessageWriteDecision:
+        return self._outbound("before_message_write", content, ctx, sync=True)
+
+    def message_sent(self, content: str, ctx: Optional[dict] = None) -> list[Any]:
+        return self.fire("message_sent", {"content": content}, dict(ctx or {}))
+
+    def _outbound(self, hook: str, content: str, ctx: Optional[dict], sync: bool) -> MessageWriteDecision:
+        event = {"content": content}
+        ctx = dict(ctx or {})
+
+        def fold(r: Any) -> None:
+            if isinstance(r, dict) and r.get("content") is not None:
+                event["content"] = r["content"]
+
+        def is_block(r: Any) -> bool:
+            return isinstance(r, dict) and bool(r.get("block"))
+
+        if sync:
+            results = self.bus.fire_sync(hook, event, ctx, until=is_block, on_result=fold)
+        else:
+            results = self.fire_results(hook, event, ctx, until=is_block, on_result=fold)
+        for r in results:
+            if is_block(r):
+                return MessageWriteDecision(True, event["content"],
+                                            r.get("fallback_message") or r.get("fallbackMessage"))
+        return MessageWriteDecision(False, event["content"])
+
+    def fire_results(self, hook: str, *args: Any, until=None, on_result=None) -> list[Any]:
+        return _run(self.bus.fire(hook, *args, until=until, on_result=on_result))
+
+    def session_start(self, ctx: Optional[dict] = None) -> list[Any]:
+        return self.fire("session_start", {}, dict(ctx or {}))
+
+    def session_end(self, ctx: Optional[dict] = None) -> list[Any]:
+        return self.fire("session_end", {}, dict(ctx or {}))
+
+    def before_agent_start(self, ctx: Optional[dict] = None) -> list[Any]:
+        """Returns context-injection results (``{prepend_context: str}``)."""
+        return self.fire("before_agent_start", {}, dict(ctx or {}))
+
+    def agent_end(self, ctx: Optional[dict] = None, error: Optional[str] = None) -> list[Any]:
+        return self.fire("agent_end", {"error": error}, dict(ctx or {}))
+
+    def before_compaction(self, ctx: Optional[dict] = None) -> list[Any]:
+        return self.fire("before_compaction", {}, dict(ctx or {}))
+
+    # ── commands & RPC ───────────────────────────────────────────────
+
+    def command(self, name: str, ctx: Optional[dict] = None, args: str = "") -> dict:
+        cmd = self.commands.get(name.lstrip("/"))
+        if cmd is None:
+            return {"text": f"unknown command: {name}"}
+        try:
+            out = cmd.handler({"args": args, **(ctx or {})})
+            if asyncio.iscoroutine(out):
+                out = _run(out)
+            return out
+        except Exception as exc:  # noqa: BLE001
+            return {"text": f"command {name} failed: {exc}"}
+
+    def call_method(self, method: str, *args: Any) -> Any:
+        handler = self.methods.get(method)
+        if handler is None:
+            raise KeyError(f"unknown gateway method: {method}")
+        return handler(*args)

@@ -1,0 +1,23 @@
+"""Persistence substrate: atomic JSON/JSONL writes, debounce, workspace layout."""
+
+from .atomic import (
+    AtomicStorage,
+    Debouncer,
+    append_jsonl,
+    read_json,
+    read_jsonl,
+    write_json_atomic,
+)
+from .workspace import is_file_older_than, is_writable, reboot_dir
+
+__all__ = [
+    "AtomicStorage",
+    "Debouncer",
+    "append_jsonl",
+    "is_file_older_than",
+    "is_writable",
+    "read_json",
+    "read_jsonl",
+    "reboot_dir",
+    "write_json_atomic",
+]

@@ -1,0 +1,133 @@
+"""Atomic JSON persistence.
+
+Reference conventions rebuilt here once instead of per-package:
+- tmp-then-rename atomic writes (cortex/src/storage.ts:17-27,
+  brainplex/src/writer.ts:14-36, knowledge-engine/src/storage.ts)
+- debounced saves (commitment tracker's 15 s debounce,
+  cortex/src/commitment-tracker.ts:7-8; knowledge-engine AtomicStorage.debounce)
+- daily JSONL append files (governance/src/audit-trail.ts:62,167)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterator, Optional
+
+
+def write_json_atomic(path: str | Path, obj: Any, indent: int = 2) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+    tmp.write_text(json.dumps(obj, indent=indent, ensure_ascii=False, default=str), encoding="utf-8")
+    os.replace(tmp, path)
+
+
+def read_json(path: str | Path, default: Any = None) -> Any:
+    path = Path(path)
+    try:
+        return json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return default
+
+
+def append_jsonl(path: str | Path, records: list[Any]) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("a", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, ensure_ascii=False, default=str) + "\n")
+
+
+def read_jsonl(path: str | Path) -> Iterator[Any]:
+    path = Path(path)
+    if not path.exists():
+        return
+    with path.open("r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+
+
+class Debouncer:
+    """Trailing-edge debounce with an explicit ``flush`` for shutdown paths.
+
+    ``wall=False`` (tests) never starts a timer thread; callers drive it via
+    ``flush()``. With ``wall=True`` a daemon timer fires after ``delay_s``.
+    """
+
+    def __init__(self, fn: Callable[[], None], delay_s: float, wall: bool = True):
+        self._fn = fn
+        self._delay = delay_s
+        self._wall = wall
+        self._timer: Optional[threading.Timer] = None
+        self._pending = False
+        self._lock = threading.Lock()
+
+    def trigger(self) -> None:
+        with self._lock:
+            self._pending = True
+            if not self._wall:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self._delay, self.flush)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            if not self._pending:
+                return
+            self._pending = False
+        self._fn()
+
+    @property
+    def pending(self) -> bool:
+        return self._pending
+
+
+class AtomicStorage:
+    """Directory-rooted JSON store with per-key debounced persistence."""
+
+    def __init__(self, root: str | Path, wall: bool = True):
+        self.root = Path(root)
+        self._wall = wall
+        self._debouncers: dict[str, Debouncer] = {}
+
+    def path(self, name: str) -> Path:
+        return self.root / name
+
+    def save(self, name: str, obj: Any) -> None:
+        write_json_atomic(self.path(name), obj)
+
+    def load(self, name: str, default: Any = None) -> Any:
+        return read_json(self.path(name), default)
+
+    def save_debounced(self, name: str, supplier: Callable[[], Any], delay_s: float = 15.0) -> None:
+        deb = self._debouncers.get(name)
+        if deb is None:
+            deb = Debouncer(lambda: self.save(name, supplier()), delay_s, wall=self._wall)
+            self._debouncers[name] = deb
+        deb.trigger()
+
+    def flush_all(self) -> None:
+        for deb in self._debouncers.values():
+            deb.flush()
+
+
+def daily_jsonl_name(ts: Optional[float] = None) -> str:
+    """``YYYY-MM-DD.jsonl`` file name for daily logs (audit-trail convention)."""
+    t = time.gmtime(ts if ts is not None else time.time())
+    return f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}.jsonl"
