@@ -1,0 +1,47 @@
+"""Headline benchmark. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current headline: event-store publish throughput through the full hook →
+envelope → transport path, vs the reference's published NATS sequential
+publish rate (~3,800 msg/s, nats-eventstore/README.md:256-263 /
+BASELINE.md). Once the trace analyzer lands this switches to its
+events/min pipeline metric (reference requirement ≥10k events/min).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_event_publish(n: int = 50_000) -> dict:
+    from vainplex_openclaw_tpu.core import Gateway
+    from vainplex_openclaw_tpu.events import EventStorePlugin, MemoryTransport
+
+    gw = Gateway()
+    plugin = EventStorePlugin(transport=MemoryTransport(max_msgs=n + 1))
+    gw.load(plugin, plugin_config={"enabled": True, "transport": "memory"})
+    ctx = {"agent_id": "main", "session_key": "main", "run_id": "warm"}
+    gw.message_received("warmup", ctx)
+
+    handler_regs = gw.bus.handlers_for("message_received")
+    assert handler_regs, "event store must be wired"
+    t0 = time.perf_counter()
+    for i in range(n):
+        gw.message_received(f"message {i} with some payload text", {
+            "agent_id": "main", "session_key": "main", "message_id": f"m{i}",
+        })
+    dt = time.perf_counter() - t0
+    assert plugin.transport.stats.published >= n
+    rate = n / dt
+    baseline = 3800.0  # NATS sequential publish msg/s (BASELINE.md)
+    return {
+        "metric": "event_store_publish_throughput",
+        "value": round(rate, 1),
+        "unit": "msg/s",
+        "vs_baseline": round(rate / baseline, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_event_publish()))

@@ -12,6 +12,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The image pre-registers an experimental 'axon' TPU-tunnel platform that
+# overrides JAX_PLATFORMS; config.update before first backend init wins.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

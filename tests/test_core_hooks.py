@@ -88,6 +88,24 @@ def test_async_handler_supported_on_async_hooks():
     assert d.allowed
 
 
+def test_sync_lambda_wrapping_async_still_enforced():
+    # Registration-time detection can't see this shape; the runtime fallback
+    # must still honor the verdict (and promote the registration).
+    gw, _ = make_gateway()
+
+    async def check(e, c):
+        await asyncio.sleep(0)
+        return {"block": True, "block_reason": "wrapped"}
+
+    gw.bus.on("before_tool_call", lambda e, c: check(e, c), priority=1000, plugin_id="g")
+    assert not gw.bus.has_async("before_tool_call")
+    d = gw.before_tool_call("exec", {"command": "x"})
+    assert d.blocked and d.block_reason == "wrapped"
+    assert gw.bus.has_async("before_tool_call")  # promoted for next fires
+    d2 = gw.before_tool_call("exec", {"command": "x"})
+    assert d2.blocked
+
+
 def test_sync_only_hook_rejects_async_handler():
     gw, logger = make_gateway()
 
@@ -187,6 +205,32 @@ def test_unknown_command_and_command_error_are_soft():
 
     gw.load(P())
     assert "failed" in gw.command("/bad")["text"]
+
+
+def test_multiple_failing_handlers_counted_individually():
+    gw, _ = make_gateway()
+    gw.bus.on("message_received", lambda e, c: 1 / 0, priority=1, plugin_id="a")
+    gw.bus.on("message_received", lambda e, c: [][1], priority=2, plugin_id="b")
+    gw.message_received("x")
+    assert gw.bus.stats["message_received"].errors == 2
+
+
+def test_sync_fire_in_running_loop_fails_loud():
+    import asyncio as aio
+
+    gw, _ = make_gateway()
+
+    async def check(e, c):
+        return {"block": True}
+
+    gw.bus.on("before_tool_call", lambda e, c: check(e, c), priority=1000, plugin_id="g")
+
+    async def main():
+        # sync entry point inside a loop must raise, not silently fail open
+        with pytest.raises(RuntimeError):
+            gw.bus.fire_sync("before_tool_call", {"tool_name": "t", "params": {}}, {})
+
+    aio.run(main())
 
 
 def test_hookbus_stats_track_fires():

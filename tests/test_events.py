@@ -1,0 +1,246 @@
+"""Event store tests (reference: nats-eventstore test suite — envelope
+construction, deterministic IDs, hook mappings, integration via the harness)."""
+
+import json
+
+from vainplex_openclaw_tpu.events import (
+    ClawEvent,
+    EventStorePlugin,
+    FileTransport,
+    MemoryTransport,
+    build_envelope,
+    build_subject,
+    derive_event_id,
+)
+from vainplex_openclaw_tpu.events.transport import _subject_matches, parse_nats_url
+
+from helpers import FakeClock, make_gateway
+
+
+def make_event(i=0, agent="main", session="main", etype="msg.in", ts=1000.0):
+    return ClawEvent(
+        id=f"evt-{i}", ts=ts, agent=agent, session=session, type=etype,
+        canonical_type=None, legacy_type=None, schema_version=1,
+        source={"plugin": "t"}, actor={}, scope={}, trace={}, visibility="internal",
+        payload={"i": i},
+    )
+
+
+# ── envelope ─────────────────────────────────────────────────────────
+
+
+def test_deterministic_event_id_idempotent():
+    a = derive_event_id("message.in.received", "s1", {}, {"run_id": "r-42"})
+    b = derive_event_id("message.in.received", "s1", {}, {"run_id": "r-42"})
+    c = derive_event_id("message.in.received", "s2", {}, {"run_id": "r-42"})
+    assert a == b and a != c and a.startswith("evt-")
+
+
+def test_event_id_prefers_most_specific_source():
+    # Two messages in the same run must not collapse to one ID.
+    a = derive_event_id("message.in.received", "s1", {}, {"run_id": "r1", "message_id": "m1"})
+    b = derive_event_id("message.in.received", "s1", {}, {"run_id": "r1", "message_id": "m2"})
+    assert a != b
+    # Run-scoped events still key off the run id deterministically.
+    c = derive_event_id("run.started", "s1", {}, {"run_id": "r1"})
+    d = derive_event_id("run.started", "s1", {}, {"run_id": "r1"})
+    assert c == d
+
+
+def test_blocked_tool_call_still_audited(openclaw_home):
+    gw, plugin = _loaded_gateway()
+    gw.bus.on("before_tool_call", lambda e, c: {"block": True, "block_reason": "deny"},
+              priority=1000, plugin_id="governance")
+    for tc in ("tc1", "tc2"):
+        d = gw.before_tool_call("exec", {"command": "rm -rf /"},
+                                {"agent_id": "m", "run_id": "r1", "tool_call_id": tc})
+        assert d.blocked
+    reqs = [e for e in plugin.transport.fetch() if e.canonical_type == "tool.call.requested"]
+    # both denied calls audited, each with its own deterministic id from the
+    # ctx-borne tool_call_id (not collapsed onto the shared run_id)
+    assert len(reqs) == 2 and len({e.id for e in reqs}) == 2
+    assert [e.scope["tool_call_id"] for e in reqs] == ["tc1", "tc2"]
+
+
+def test_event_id_random_without_stable_source():
+    a = derive_event_id("message.in.received", "s1", {}, {})
+    b = derive_event_id("message.in.received", "s1", {}, {})
+    assert a != b
+
+
+def test_build_envelope_fields_and_trace_propagation():
+    ev = build_envelope(
+        "tool.call.executed", {"tool_name": "read"},
+        {"agent_id": "viola", "session_key": "viola:telegram:1", "run_id": "r1",
+         "trace_id": "t1", "span_id": "sp1"},
+        legacy_type="tool.result", visibility="internal", now_ms=123456.0)
+    assert ev.agent == "viola" and ev.session == "viola:telegram:1"
+    assert ev.type == "tool.result" and ev.canonical_type == "tool.call.executed"
+    assert ev.schema_version == 1 and ev.ts == 123456.0
+    assert ev.trace["trace_id"] == "t1" and ev.trace["correlation_id"] == "r1"
+    assert ev.scope["run_id"] == "r1"
+
+
+def test_system_event_uses_system_identity():
+    ev = build_envelope("gateway.started", {}, {"agent_id": "main"}, system_event=True)
+    assert ev.agent == "system" and ev.session == "system"
+    assert ev.actor["agent_id"] is None
+
+
+def test_envelope_roundtrip_dict():
+    ev = build_envelope("session.started", {"a": 1}, {"agent_id": "m"}, now_ms=1.0)
+    again = ClawEvent.from_dict(json.loads(json.dumps(ev.to_dict())))
+    assert again.canonical_type == "session.started" and again.payload == {"a": 1}
+
+
+# ── subjects ─────────────────────────────────────────────────────────
+
+
+def test_subject_scheme_and_sanitization():
+    assert build_subject("claw", "main", "msg.in") == "claw.main.msg.in"
+    assert build_subject("claw", "agent with spaces!", "x") == "claw.agent_with_spaces_.x"
+
+
+def test_subject_wildcards():
+    assert _subject_matches(">", "claw.main.msg.in")
+    assert _subject_matches("claw.>", "claw.main.msg.in")
+    assert _subject_matches("claw.*.msg.in", "claw.main.msg.in")
+    assert not _subject_matches("claw.*.msg.in", "claw.main.tool.call")
+    assert not _subject_matches("claw.main", "claw.main.msg.in")
+
+
+def test_parse_nats_url():
+    p = parse_nats_url("nats://user:pw@broker:5222")
+    assert p == {"servers": "nats://broker:5222", "user": "user", "password": "pw"}
+    assert parse_nats_url("localhost")["servers"] == "nats://localhost:4222"
+
+
+# ── memory transport ─────────────────────────────────────────────────
+
+
+def test_memory_transport_seq_and_fetch_filters():
+    t = MemoryTransport()
+    for i in range(5):
+        agent = "main" if i % 2 == 0 else "viola"
+        t.publish(build_subject("claw", agent, "msg.in"), make_event(i, agent=agent))
+    assert t.last_sequence() == 5 and t.event_count() == 5
+    viola = list(t.fetch("claw.viola.>"))
+    assert [e.payload["i"] for e in viola] == [1, 3]
+    after = list(t.fetch(">", start_seq=3))
+    assert [e.seq for e in after] == [4, 5]
+    batch = list(t.fetch(">", batch=2))
+    assert len(batch) == 2
+
+
+def test_memory_transport_retention_max_msgs():
+    t = MemoryTransport(max_msgs=3)
+    for i in range(10):
+        t.publish("claw.m.x", make_event(i))
+    assert t.event_count() == 3
+    assert t.stats.dropped_retention == 7
+    assert [e.payload["i"] for e in t.fetch()] == [7, 8, 9]
+
+
+def test_memory_transport_retention_age():
+    clk = FakeClock(1000.0)
+    t = MemoryTransport(max_age_s=60, clock=clk)
+    t.publish("c.m.x", make_event(0, ts=1000.0 * 1000))
+    clk.advance(120)
+    t.publish("c.m.x", make_event(1, ts=1120.0 * 1000))
+    assert [e.payload["i"] for e in t.fetch()] == [1]
+
+
+def test_memory_transport_subscriber_errors_swallowed():
+    t = MemoryTransport()
+    seen = []
+    t.subscribe(lambda s, e: 1 / 0)
+    t.subscribe(lambda s, e: seen.append(e.payload["i"]))
+    assert t.publish("c.m.x", make_event(7))
+    assert seen == [7] and t.stats.published == 1
+
+
+# ── file transport ───────────────────────────────────────────────────
+
+
+def test_file_transport_durable_roundtrip_and_seq_recovery(tmp_path):
+    t = FileTransport(tmp_path, clock=lambda: 0.0)
+    for i in range(3):
+        t.publish("claw.m.msg.in", make_event(i))
+    assert (tmp_path / "1970-01-01.jsonl").exists()
+    # second process recovers the sequence counter
+    t2 = FileTransport(tmp_path, clock=lambda: 0.0)
+    assert t2.last_sequence() == 3
+    t2.publish("claw.m.msg.in", make_event(3))
+    assert [e.seq for e in t2.fetch()] == [1, 2, 3, 4]
+    assert [e.payload["i"] for e in t2.fetch(start_seq=2)] == [2, 3]
+
+
+# ── plugin integration through the gateway ───────────────────────────
+
+
+def _loaded_gateway(clock=None):
+    gw, logger = make_gateway(clock=clock)
+    plugin = EventStorePlugin(transport=MemoryTransport(clock=clock or gw.clock), clock=gw.clock)
+    gw.load(plugin, plugin_config={"enabled": True, "transport": "memory"})
+    return gw, plugin
+
+
+def test_hooks_publish_canonical_and_legacy_types(openclaw_home):
+    gw, plugin = _loaded_gateway()
+    ctx = {"agent_id": "main", "session_key": "main", "run_id": "r1"}
+    gw.message_received("hello", ctx)
+    gw.before_tool_call("exec", {"command": "ls"}, ctx)
+    gw.after_tool_call("exec", {"command": "ls"}, result="ok", ctx=ctx)
+    events = list(plugin.transport.fetch())
+    kinds = [(e.canonical_type, e.type) for e in events]
+    assert ("message.in.received", "msg.in") in kinds
+    assert ("tool.call.requested", "tool.call") in kinds
+    assert ("tool.call.executed", "tool.result") in kinds
+
+
+def test_failed_tool_call_discriminated_and_run_error_extra(openclaw_home):
+    gw, plugin = _loaded_gateway()
+    ctx = {"agent_id": "main", "session_key": "main", "run_id": "r9"}
+    gw.after_tool_call("exec", {"command": "x"}, result=None, error="boom", ctx=ctx)
+    gw.agent_end(ctx=ctx, error="run exploded")
+    kinds = [e.canonical_type for e in plugin.transport.fetch()]
+    assert "tool.call.failed" in kinds
+    assert "run.ended" in kinds and "run.failed" in kinds
+
+
+def test_llm_hooks_omit_bodies(openclaw_home):
+    gw, plugin = _loaded_gateway()
+    gw.fire("llm_input", {"prompt": "super secret prompt"}, {"agent_id": "m"})
+    ev = next(e for e in plugin.transport.fetch() if e.canonical_type == "model.input.observed")
+    assert "prompt" not in ev.payload and ev.payload["chars"] == len("super secret prompt")
+    assert ev.visibility == "secret" and ev.redaction["applied"] is True
+    assert "secret prompt" not in json.dumps(ev.to_dict())
+
+
+def test_gateway_lifecycle_system_events_and_status(openclaw_home):
+    gw, plugin = _loaded_gateway()
+    gw.start()
+    gw.stop()
+    evs = [e for e in plugin.transport.fetch() if e.agent == "system"]
+    assert [e.canonical_type for e in evs] == ["gateway.started", "gateway.stopped"]
+    assert "published=" in gw.command("/eventstatus")["text"]
+    s = gw.call_method("eventstore.status")
+    assert s["healthy"] and s["published"] >= 2
+
+
+def test_publish_runs_after_other_plugins(openclaw_home):
+    gw, plugin = _loaded_gateway()
+    order = []
+    gw.bus.on("message_received", lambda e, c: order.append("cortex"), priority=100, plugin_id="cortex")
+    plugin.transport.subscribe(lambda s, e: order.append("publish"))
+    gw.message_received("hi", {"agent_id": "m"})
+    assert order == ["cortex", "publish"]
+
+
+def test_disabled_plugin_registers_nothing(openclaw_home):
+    gw, _ = make_gateway()
+    plugin = EventStorePlugin()
+    gw.load(plugin, plugin_config={"enabled": False})
+    gw.message_received("hi", {"agent_id": "m"})
+    assert plugin.transport is None
+    assert gw.bus.handlers_for("message_received") == []

@@ -117,6 +117,15 @@ def test_load_plugin_config_legacy_inline(tmp_path):
     assert not (tmp_path / "plugins" / "ke").exists()
 
 
+def test_disabled_plugin_stays_disabled_across_runs(tmp_path):
+    # Bootstrap writes defaults (which may carry enabled:true); the inline
+    # pointer's enabled:false must still win on every subsequent run.
+    defaults = {"enabled": True, "x": 1}
+    cfg1 = load_plugin_config("es", inline={"enabled": False}, defaults=defaults, home=tmp_path)
+    cfg2 = load_plugin_config("es", inline={"enabled": False}, defaults=defaults, home=tmp_path)
+    assert cfg1["enabled"] is False and cfg2["enabled"] is False
+
+
 def test_load_plugin_config_corrupt_external_falls_back(tmp_path):
     ext = tmp_path / "plugins" / "g" / "config.json"
     ext.parent.mkdir(parents=True)

@@ -86,7 +86,11 @@ def load_plugin_config(
             logger.warn(f"could not bootstrap config at {path}: {exc}")
 
     merged = deep_merge(defaults, external or {})
-    merged["enabled"] = bool(external.get("enabled", enabled)) if external else enabled
+    # The inline pointer's enabled:false always wins: an operator who disabled
+    # a plugin in openclaw.json must not have it re-enabled by the external
+    # file (including the bootstrap-written defaults, which carry enabled:true).
+    external_enabled = bool(external.get("enabled", True)) if external else True
+    merged["enabled"] = enabled and external_enabled
     return merged
 
 

@@ -23,6 +23,7 @@ Semantics:
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import logging
 import time
@@ -54,6 +55,12 @@ KNOWN_HOOKS = (
     "llm_input",
     "llm_output",
 )
+
+
+class SyncDispatchInAsyncContext(RuntimeError):
+    """Raised (never swallowed) when a sync fire meets an awaitable while an
+    event loop is already running — the caller must use the async entry point;
+    silently dropping an enforcement verdict here would fail open."""
 
 
 class PluginLogger(Protocol):
@@ -143,6 +150,7 @@ class _Registration:
     seq: int
     plugin_id: str
     handler: HookHandler
+    is_async: bool = False
 
 
 @dataclass
@@ -170,7 +178,9 @@ class HookBus:
 
     def on(self, hook_name: str, handler: HookHandler, priority: int = 100, plugin_id: str = "?") -> None:
         self._seq += 1
-        reg = _Registration(priority=priority, seq=self._seq, plugin_id=plugin_id, handler=handler)
+        reg = _Registration(priority=priority, seq=self._seq, plugin_id=plugin_id,
+                            handler=handler,
+                            is_async=inspect.iscoroutinefunction(inspect.unwrap(handler)))
         regs = self._handlers.setdefault(hook_name, [])
         regs.append(reg)
         regs.sort(key=lambda r: (r.priority, r.seq))
@@ -178,12 +188,29 @@ class HookBus:
     def handlers_for(self, hook_name: str) -> list[_Registration]:
         return list(self._handlers.get(hook_name, ()))
 
-    def _record(self, hook_name: str, error: Optional[str]) -> None:
+    def has_async(self, hook_name: str) -> bool:
+        return any(r.is_async for r in self._handlers.get(hook_name, ()))
+
+    @staticmethod
+    async def _await_result(awaitable: Any) -> Any:
+        return await awaitable
+
+    @staticmethod
+    def _close_awaitable(out: Any) -> None:
+        """Best-effort close; Tasks/Futures/custom __await__ objects lack close()."""
+        close = getattr(out, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _record(self, hook_name: str, error: Optional[str], n_errors: int = 0) -> None:
         st = self.stats.setdefault(hook_name, HookStats())
         st.fired += 1
         st.last_fired_at = self._clock()
-        if error is not None:
-            st.errors += 1
+        if n_errors:
+            st.errors += n_errors
             st.last_error = error
 
     async def fire(
@@ -202,17 +229,20 @@ class HookBus:
         """
         results: list[Any] = []
         err: Optional[str] = None
+        n_errors = 0
         for reg in self.handlers_for(hook_name):
             try:
                 out = reg.handler(*args)
                 if inspect.isawaitable(out):
                     if hook_name in SYNC_ONLY_HOOKS:
+                        self._close_awaitable(out)
                         raise TypeError(
                             f"hook '{hook_name}' is synchronous; handler from "
                             f"plugin '{reg.plugin_id}' returned a coroutine"
                         )
                     out = await out
             except Exception as exc:  # noqa: BLE001 — plugins must not crash the gateway
+                n_errors += 1
                 err = f"{reg.plugin_id}/{hook_name}: {exc}"
                 self._logger.error(f"[hook-bus] handler error in {err}")
                 continue
@@ -222,7 +252,7 @@ class HookBus:
                     on_result(out)
                 if until is not None and until(out):
                     break
-        self._record(hook_name, err)
+        self._record(hook_name, err, n_errors)
         return results
 
     def fire_sync(
@@ -232,29 +262,57 @@ class HookBus:
         until: Optional[Callable[[Any], bool]] = None,
         on_result: Optional[Callable[[Any], None]] = None,
     ) -> list[Any]:
-        """Synchronous dispatch; rejects async handlers on any hook."""
+        """Synchronous dispatch.
+
+        A handler that unexpectedly returns an awaitable (sync lambda wrapping
+        an async call, async ``__call__`` object — shapes registration-time
+        detection can't see) is still honored on async-capable hooks: the
+        awaitable is run to completion here and the registration is promoted
+        so subsequent fires take the async path upfront. Sync-only hooks
+        reject it, as ``fire`` does.
+        """
         results: list[Any] = []
         err: Optional[str] = None
-        for reg in self.handlers_for(hook_name):
-            try:
-                out = reg.handler(*args)
-                if inspect.isawaitable(out):
-                    out.close()
-                    raise TypeError(
-                        f"sync fire of '{hook_name}': handler from plugin "
-                        f"'{reg.plugin_id}' is async"
-                    )
-            except Exception as exc:  # noqa: BLE001
-                err = f"{reg.plugin_id}/{hook_name}: {exc}"
-                self._logger.error(f"[hook-bus] handler error in {err}")
-                continue
-            if out is not None:
-                results.append(out)
-                if on_result is not None:
-                    on_result(out)
-                if until is not None and until(out):
-                    break
-        self._record(hook_name, err)
+        n_errors = 0
+        try:
+            for reg in self.handlers_for(hook_name):
+                try:
+                    out = reg.handler(*args)
+                    if inspect.isawaitable(out):
+                        if hook_name in SYNC_ONLY_HOOKS:
+                            self._close_awaitable(out)
+                            raise TypeError(
+                                f"sync fire of '{hook_name}': handler from plugin "
+                                f"'{reg.plugin_id}' is async"
+                            )
+                        reg.is_async = True
+                        try:
+                            asyncio.get_running_loop()
+                        except RuntimeError:
+                            out = asyncio.run(self._await_result(out))
+                        else:
+                            self._close_awaitable(out)
+                            raise SyncDispatchInAsyncContext(
+                                f"hook '{hook_name}' handler from plugin "
+                                f"'{reg.plugin_id}' returned an awaitable during a "
+                                f"sync fire inside a running event loop; use the "
+                                f"async gateway entry points"
+                            )
+                except SyncDispatchInAsyncContext:
+                    raise  # fail loud: dropping a verdict here would fail open
+                except Exception as exc:  # noqa: BLE001
+                    n_errors += 1
+                    err = f"{reg.plugin_id}/{hook_name}: {exc}"
+                    self._logger.error(f"[hook-bus] handler error in {err}")
+                    continue
+                if out is not None:
+                    results.append(out)
+                    if on_result is not None:
+                        on_result(out)
+                    if until is not None and until(out):
+                        break
+        finally:
+            self._record(hook_name, err, n_errors)
         return results
 
 

@@ -151,6 +151,10 @@ class Gateway:
     # ── generic hook firing (the mock-api `_fire` equivalent) ────────
 
     def fire(self, hook_name: str, *args: Any) -> list[Any]:
+        # Fast path: hooks with only sync handlers skip the event loop entirely
+        # (the enforcement/ingest hot paths are sync in the common case).
+        if not self.bus.has_async(hook_name):
+            return self.bus.fire_sync(hook_name, *args)
         return _run(self.bus.fire(hook_name, *args))
 
     async def fire_async(self, hook_name: str, *args: Any) -> list[Any]:
@@ -158,8 +162,8 @@ class Gateway:
 
     # ── typed flows ──────────────────────────────────────────────────
 
-    async def before_tool_call_async(self, tool_name: str, params: dict,
-                                     ctx: Optional[dict] = None) -> ToolCallDecision:
+    @staticmethod
+    def _tool_call_fixture(tool_name: str, params: dict, ctx: Optional[dict]):
         event = {"tool_name": tool_name, "params": dict(params)}
         ctx = dict(ctx or {})
         ctx.setdefault("tool_name", tool_name)
@@ -168,18 +172,30 @@ class Gateway:
             if isinstance(result, dict) and result.get("params") is not None:
                 event["params"] = result["params"]
 
-        results = await self.bus.fire(
-            "before_tool_call", event, ctx,
-            until=lambda r: isinstance(r, dict) and bool(r.get("block")),
-            on_result=fold,
-        )
+        def is_block(r: Any) -> bool:
+            return isinstance(r, dict) and bool(r.get("block"))
+
+        return event, ctx, fold, is_block
+
+    @staticmethod
+    def _tool_call_decision(results: list[Any], event: dict) -> ToolCallDecision:
         for r in results:
             if isinstance(r, dict) and r.get("block"):
                 return ToolCallDecision(True, r.get("block_reason") or r.get("blockReason"), event["params"])
         return ToolCallDecision(False, None, event["params"])
 
+    async def before_tool_call_async(self, tool_name: str, params: dict,
+                                     ctx: Optional[dict] = None) -> ToolCallDecision:
+        event, ctx, fold, is_block = self._tool_call_fixture(tool_name, params, ctx)
+        results = await self.bus.fire("before_tool_call", event, ctx, until=is_block, on_result=fold)
+        return self._tool_call_decision(results, event)
+
     def before_tool_call(self, tool_name: str, params: dict, ctx: Optional[dict] = None) -> ToolCallDecision:
-        return _run(self.before_tool_call_async(tool_name, params, ctx))
+        if self.bus.has_async("before_tool_call"):
+            return _run(self.before_tool_call_async(tool_name, params, ctx))
+        event, fctx, fold, is_block = self._tool_call_fixture(tool_name, params, ctx)
+        results = self.bus.fire_sync("before_tool_call", event, fctx, until=is_block, on_result=fold)
+        return self._tool_call_decision(results, event)
 
     def after_tool_call(self, tool_name: str, params: dict, result: Any = None,
                         error: Optional[str] = None, ctx: Optional[dict] = None) -> None:
@@ -241,7 +257,7 @@ class Gateway:
         def is_block(r: Any) -> bool:
             return isinstance(r, dict) and bool(r.get("block"))
 
-        if sync:
+        if sync or not self.bus.has_async(hook):
             results = self.bus.fire_sync(hook, event, ctx, until=is_block, on_result=fold)
         else:
             results = self.fire_results(hook, event, ctx, until=is_block, on_result=fold)

@@ -1,0 +1,34 @@
+"""Event store: envelope taxonomy, hook→event mapping, pluggable transports.
+
+Reference: packages/openclaw-nats-eventstore. The transport is pluggable here
+(the reference hard-wires NATS JetStream): an in-memory JetStream-lite ring
+for tests and single-process installs, a durable JSONL file log, and a NATS
+adapter that degrades to None when the client library is absent (matching the
+reference's optional-dependency posture, cortex nats-trace-source.ts:71-79).
+"""
+
+from .envelope import (
+    CANONICAL_EVENT_TYPES,
+    ClawEvent,
+    build_envelope,
+    derive_event_id,
+)
+from .mappings import EXTRA_EMITTERS, HOOK_MAPPINGS, HookMapping
+from .plugin import EventStorePlugin
+from .subjects import build_subject
+from .transport import FileTransport, MemoryTransport, create_nats_transport
+
+__all__ = [
+    "CANONICAL_EVENT_TYPES",
+    "ClawEvent",
+    "EXTRA_EMITTERS",
+    "EventStorePlugin",
+    "FileTransport",
+    "HOOK_MAPPINGS",
+    "HookMapping",
+    "MemoryTransport",
+    "build_envelope",
+    "build_subject",
+    "create_nats_transport",
+    "derive_event_id",
+]

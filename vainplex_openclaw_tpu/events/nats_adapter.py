@@ -1,0 +1,104 @@
+"""NATS JetStream transport adapter (only imported when ``nats`` is present).
+
+Mirrors the reference client's posture (ne/src/nats-client.ts): stream
+auto-create with ``<prefix>.>`` subjects and retention limits, infinite
+reconnect, publish with a timeout race, failures swallowed and counted.
+The asyncio NATS client is bridged onto a dedicated background loop thread
+so the (synchronous) gateway hot path never blocks on the broker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+from .envelope import ClawEvent
+from .transport import TransportStats, parse_nats_url
+
+
+class NatsTransport:  # pragma: no cover - requires a live broker
+    def __init__(self, url: str, stream: str = "CLAW_EVENTS", prefix: str = "claw",
+                 publish_timeout_s: float = 2.0, max_msgs: int = 1_000_000,
+                 max_bytes: int = 1 << 30, max_age_s: float = 30 * 86400, logger=None):
+        self.url = url
+        self.stream = stream
+        self.prefix = prefix
+        self.publish_timeout_s = publish_timeout_s
+        self.retention = {"max_msgs": max_msgs, "max_bytes": max_bytes, "max_age_s": max_age_s}
+        self.logger = logger
+        self.stats = TransportStats()
+        self._nc = None
+        self._js = None
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+        self._thread.start()
+
+    def _submit(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def connect(self) -> bool:
+        try:
+            self._submit(self._connect(), timeout=10.0)
+            return True
+        except Exception as exc:  # noqa: BLE001
+            self.stats.last_error = str(exc)
+            if self.logger:
+                self.logger.warn(f"nats connect failed: {exc}")
+            return False
+
+    async def _connect(self) -> None:
+        import nats  # type: ignore
+
+        opts = parse_nats_url(self.url)
+        self._nc = await nats.connect(
+            servers=[opts["servers"]],
+            user=opts.get("user"),
+            password=opts.get("password"),
+            max_reconnect_attempts=-1,  # infinite, like the reference
+        )
+        self._js = self._nc.jetstream()
+        await self._ensure_stream()
+
+    async def _ensure_stream(self) -> None:
+        from nats.js.api import StreamConfig  # type: ignore
+
+        cfg = StreamConfig(
+            name=self.stream,
+            subjects=[f"{self.prefix}.>"],
+            max_msgs=self.retention["max_msgs"],
+            max_bytes=self.retention["max_bytes"],
+            max_age=self.retention["max_age_s"],  # seconds; client converts to ns
+        )
+        try:
+            await self._js.add_stream(cfg)
+        except Exception:  # noqa: BLE001 — already exists
+            pass
+
+    def publish(self, subject: str, event: ClawEvent) -> bool:
+        if self._js is None:
+            self.stats.publish_failures += 1
+            return False
+        try:
+            payload = json.dumps(event.to_dict(), default=str).encode()
+            self._submit(self._js.publish(subject, payload), timeout=self.publish_timeout_s)
+            self.stats.published += 1
+            return True
+        except Exception as exc:  # noqa: BLE001 — never block agent operations
+            self.stats.publish_failures += 1
+            self.stats.last_error = str(exc)
+            return False
+
+    def healthy(self) -> bool:
+        return self._nc is not None and not self._nc.is_closed
+
+    def drain(self) -> None:
+        if self._nc is None:
+            return
+        try:
+            self._submit(self._nc.drain(), timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)

@@ -1,0 +1,150 @@
+"""The event-store plugin: wires HOOK_MAPPINGS onto the gateway bus.
+
+Reference: nats-eventstore/index.ts:20-81 (service + /eventstatus command +
+``eventstore.status`` gateway method) and src/hooks.ts (table-driven handler
+registration, fire-and-forget publishing).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..core.api import PluginCommand, PluginService
+from ..config.loader import load_plugin_config
+from .envelope import ClawEvent, build_envelope
+from .mappings import EXTRA_EMITTERS, HOOK_MAPPINGS, ExtraEmitter, HookMapping
+from .subjects import build_subject
+from .transport import FileTransport, MemoryTransport, create_nats_transport
+
+DEFAULTS = {
+    "enabled": True,
+    "transport": "memory",  # memory | file | nats
+    "prefix": "claw",
+    "stream": "CLAW_EVENTS",
+    "natsUrl": "nats://localhost:4222",
+    "fileRoot": None,  # required for transport=file
+    "retention": {"maxMsgs": 100_000, "maxBytes": 256 * 1024 * 1024, "maxAgeS": None},
+    "publishPriority": 10_000,  # after every other plugin has seen the hook
+}
+
+
+class EventStorePlugin:
+    id = "eventstore"
+
+    def __init__(self, transport=None, clock: Callable[[], float] = time.time):
+        self._injected_transport = transport
+        self.transport = None
+        self.clock = clock
+        self.config: dict = {}
+
+    def register(self, api) -> None:
+        self.config = load_plugin_config(self.id, api.plugin_config, defaults=DEFAULTS,
+                                         logger=api.logger)
+        if not self.config.get("enabled", True):
+            api.logger.info("disabled via config")
+            return
+
+        self.transport = self._injected_transport or self._build_transport(api.logger)
+
+        api.register_service(PluginService(id="eventstore", start=self._start, stop=self._stop))
+        api.register_command(PluginCommand(name="eventstatus", description="Event store status",
+                                           handler=lambda ctx: {"text": self.status_text()}))
+        api.register_gateway_method("eventstore.status", self.status)
+
+        default_prio = int(self.config.get("publishPriority", 10_000))
+        for mapping in HOOK_MAPPINGS:
+            prio = mapping.priority if mapping.priority is not None else default_prio
+            api.on(mapping.hook_name, self._make_handler(mapping), priority=prio)
+        for extra in EXTRA_EMITTERS:
+            api.on(extra.hook_name, self._make_extra_handler(extra), priority=default_prio + 1)
+
+    def _build_transport(self, logger):
+        kind = self.config.get("transport", "memory")
+        if kind == "nats":
+            t = create_nats_transport(self.config.get("natsUrl"), stream=self.config.get("stream"),
+                                      prefix=self.config.get("prefix"), logger=logger)
+            if t is not None:
+                return t
+            logger.warn("falling back to in-memory transport")
+        if kind == "file" and self.config.get("fileRoot"):
+            return FileTransport(self.config["fileRoot"], clock=self.clock)
+        r = self.config.get("retention", {})
+        return MemoryTransport(
+            max_msgs=r.get("maxMsgs", 100_000),
+            max_bytes=r.get("maxBytes", 256 * 1024 * 1024),
+            max_age_s=r.get("maxAgeS"),
+            clock=self.clock,
+        )
+
+    def _start(self, ctx) -> None:
+        connect = getattr(self.transport, "connect", None)
+        if connect is not None:
+            connect()
+
+    def _stop(self, ctx) -> None:
+        if self.transport is not None:
+            self.transport.drain()
+
+    def _emit(self, canonical_type, mapping_attrs: dict, event: dict, ctx: dict) -> None:
+        if self.transport is None:
+            return
+        payload = mapping_attrs["mapper"](event, ctx)
+        envelope = build_envelope(
+            canonical_type, payload, ctx,
+            plugin=self.id,
+            legacy_type=mapping_attrs.get("legacy_type"),
+            visibility=mapping_attrs.get("visibility", "internal"),
+            redaction=mapping_attrs.get("redaction"),
+            system_event=mapping_attrs.get("system_event", False),
+            now_ms=self.clock() * 1000.0,
+        )
+        subject = build_subject(self.config.get("prefix", "claw"), envelope.agent, envelope.type)
+        self.transport.publish(subject, envelope)  # fire-and-forget; failures counted
+
+    def _make_handler(self, mapping: HookMapping):
+        def handler(event: dict, ctx: dict) -> None:
+            et = mapping.event_type
+            canonical = et(event, ctx) if callable(et) else et
+            self._emit(canonical, {
+                "mapper": mapping.mapper, "legacy_type": mapping.legacy_type,
+                "visibility": mapping.visibility, "redaction": mapping.redaction,
+                "system_event": mapping.system_event,
+            }, event, ctx)
+            return None
+
+        return handler
+
+    def _make_extra_handler(self, extra: ExtraEmitter):
+        def handler(event: dict, ctx: dict) -> None:
+            if not extra.condition(event):
+                return None
+            et = extra.event_type
+            canonical = et(event, ctx) if callable(et) else et
+            self._emit(canonical, {
+                "mapper": extra.mapper, "legacy_type": extra.legacy_type,
+                "visibility": extra.visibility, "redaction": None, "system_event": False,
+            }, event, ctx)
+            return None
+
+        return handler
+
+    def status(self) -> dict:
+        t = self.transport
+        if t is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "healthy": t.healthy(),
+            "published": t.stats.published,
+            "publish_failures": t.stats.publish_failures,
+            "last_error": t.stats.last_error,
+            "transport": type(t).__name__,
+        }
+
+    def status_text(self) -> str:
+        s = self.status()
+        if not s.get("enabled"):
+            return "event store: disabled"
+        return (f"event store: {s['transport']} healthy={s['healthy']} "
+                f"published={s['published']} failures={s['publish_failures']}")

@@ -1,0 +1,15 @@
+"""Subject scheme: ``<prefix>.<agent>.<type>`` (reference: ne/src/util.ts)."""
+
+from __future__ import annotations
+
+import re
+
+_TOKEN_SANITIZE = re.compile(r"[^A-Za-z0-9_-]")
+
+
+def sanitize_token(token: str) -> str:
+    return _TOKEN_SANITIZE.sub("_", token) or "unknown"
+
+
+def build_subject(prefix: str, agent: str, event_type: str) -> str:
+    return f"{prefix}.{sanitize_token(agent)}.{event_type}"

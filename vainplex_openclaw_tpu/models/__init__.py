@@ -1,0 +1,6 @@
+"""Flagship numeric models backing the framework's analysis surfaces."""
+
+from .encoder import EncoderConfig, forward, init_params
+from .tokenizer import encode_texts
+
+__all__ = ["EncoderConfig", "encode_texts", "forward", "init_params"]

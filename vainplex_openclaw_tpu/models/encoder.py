@@ -1,0 +1,127 @@
+"""CortexEncoder: the flagship transformer encoder.
+
+Replaces the reference's outbound-HTTP LLM calls for the *classification*
+duties the suite performs continuously — trace-finding triage (keep/severity,
+cortex classifier.ts Stage-2 triage), conversation mood, and text embeddings
+(knowledge-engine/src/embeddings.ts delegates to ChromaDB; here embeddings
+are computed on-device). Designed TPU-first:
+
+- pure-functional params pytree + ``forward`` (jit/pjit-friendly, no classes
+  holding state)
+- bf16 activations/matmuls on the MXU, fp32 params and softmax accumulation
+- static shapes end-to-end (hash tokenizer emits fixed ``seq_len``)
+- tensor-parallel-ready weight layout: per-head QKV and the MLP expand/
+  contract matrices split cleanly over a ``tp`` mesh axis
+  (see parallel/mesh.shard_params rules in __graft_entry__).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 8192
+    seq_len: int = 128
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1024
+    n_severity: int = 4   # info | low | medium | high-critical
+    n_mood: int = 5       # frustrated | neutral | satisfied | urgent | confused
+    dtype: object = jnp.bfloat16
+
+
+def _dense_init(key, shape, scale=None):
+    scale = scale if scale is not None else (1.0 / np.sqrt(shape[0]))
+    return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+
+
+def init_params(key: jax.Array, cfg: EncoderConfig) -> dict:
+    keys = iter(jax.random.split(key, 6 + cfg.n_layers * 8))
+    params: dict = {
+        "embed": {"tok": _dense_init(next(keys), (cfg.vocab_size, cfg.d_model), 0.02),
+                  "pos": _dense_init(next(keys), (cfg.seq_len, cfg.d_model), 0.02)},
+        "blocks": [],
+        "final_norm": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+        "heads": {
+            "severity": _dense_init(next(keys), (cfg.d_model, cfg.n_severity)),
+            "keep": _dense_init(next(keys), (cfg.d_model, 2)),
+            "mood": _dense_init(next(keys), (cfg.d_model, cfg.n_mood)),
+            "embed_proj": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
+        },
+    }
+    for _ in range(cfg.n_layers):
+        params["blocks"].append({
+            "attn": {
+                "q": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
+                "k": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
+                "v": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
+                "o": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
+            },
+            "mlp": {
+                "w1": _dense_init(next(keys), (cfg.d_model, cfg.d_ff)),
+                "w2": _dense_init(next(keys), (cfg.d_ff, cfg.d_model)),
+            },
+            "norm1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+            "norm2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
+        })
+    return params
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * rms * scale).astype(x.dtype)
+
+
+def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array) -> jax.Array:
+    B, L, D = x.shape
+    H, Dh = n_heads, D // n_heads
+    dt = x.dtype
+
+    def heads(w):
+        return (x @ w.astype(dt)).reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(p["q"]), heads(p["k"]), heads(p["v"])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
+    return out @ p["o"].astype(dt)
+
+
+def _block(x: jax.Array, p: dict, n_heads: int, mask: jax.Array) -> jax.Array:
+    x = x + _attention(_rmsnorm(x, p["norm1"]["scale"]), p["attn"], n_heads, mask)
+    h = _rmsnorm(x, p["norm2"]["scale"])
+    dt = x.dtype
+    h = jax.nn.gelu(h @ p["mlp"]["w1"].astype(dt)) @ p["mlp"]["w2"].astype(dt)
+    return x + h
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(params: dict, tokens: jax.Array, cfg: EncoderConfig) -> dict:
+    """tokens [B, L] int32 → {severity, keep, mood} logits + pooled embedding."""
+    mask = tokens > 0
+    dt = cfg.dtype
+    x = params["embed"]["tok"].astype(dt)[tokens] + params["embed"]["pos"].astype(dt)[None, :, :]
+    for p in params["blocks"]:
+        x = _block(x, p, cfg.n_heads, mask)
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1).astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / denom
+    heads = params["heads"]
+    emb = pooled @ heads["embed_proj"]
+    return {
+        "severity": pooled @ heads["severity"],
+        "keep": pooled @ heads["keep"],
+        "mood": pooled @ heads["mood"],
+        "embedding": emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-6),
+    }

@@ -1,0 +1,51 @@
+"""Training step for the CortexEncoder (multi-head classification).
+
+The suite learns from its own telemetry: trace-analyzer findings labelled by
+the slow LLM path (or by operator feedback) become (text, severity/keep/mood)
+examples, and the encoder distills them so the hot path stays on-device.
+This module is the sharded train step the driver dry-runs multi-chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .encoder import EncoderConfig, forward
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def init_state(params: dict, optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def loss_fn(params: dict, batch: dict, cfg: EncoderConfig) -> jax.Array:
+    out = forward(params, batch["tokens"], cfg)
+    losses = []
+    for head in ("severity", "keep", "mood"):
+        logits = out[head].astype(jnp.float32)
+        losses.append(optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch[head]).mean())
+    return sum(losses)
+
+
+@partial(jax.jit, static_argnames=("cfg", "optimizer"), donate_argnums=(0,))
+def train_step(state: TrainState, batch: dict, cfg: EncoderConfig,
+               optimizer: optax.GradientTransformation) -> tuple[TrainState, jax.Array]:
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, batch, cfg)
+    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss

@@ -1,0 +1,5 @@
+"""Device-mesh and sharding utilities for the TPU numeric layer."""
+
+from .mesh import make_mesh, batch_sharding, replicated, shard_params
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params"]

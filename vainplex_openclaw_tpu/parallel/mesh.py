@@ -1,0 +1,71 @@
+"""Mesh construction + sharding helpers.
+
+The reference has no device parallelism to mirror (SURVEY §5: NATS carries
+telemetry, not tensors). This layer exists for the framework's own numeric
+surfaces: the flagship encoder (triage/embedding model) trains and serves
+data-parallel × tensor-parallel over a ``jax.sharding.Mesh``; long-sequence
+attention shards over a sequence axis (see parallel/ring_attention.py).
+
+Axis convention: ``dp`` (batch/data), ``tp`` (model/tensor), ``sp``
+(sequence). Collectives ride whatever fabric the mesh spans — ICI on a real
+TPU slice, host memory on the virtual CPU mesh used in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _factor(n: int) -> tuple[int, int]:
+    """Split n into (dp, tp) with tp the largest power-of-two divisor ≤ sqrt(n)."""
+    tp = 1
+    for cand in (2, 4, 8, 16):
+        if n % cand == 0 and cand * cand <= n * 2:
+            tp = cand
+    return n // tp, tp
+
+
+def make_mesh(n_devices: Optional[int] = None, axes: Sequence[str] = ("dp", "tp"),
+              shape: Optional[Sequence[int]] = None) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    if shape is None:
+        if len(axes) == 1:
+            shape = (n,)
+        elif len(axes) == 2:
+            shape = _factor(n)
+        else:
+            dp, tp = _factor(n)
+            shape = (dp, tp) + (1,) * (len(axes) - 2)
+    arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_params(params, mesh: Mesh, rules) -> dict:
+    """Apply sharding rules: list of (path-substring, PartitionSpec); first
+    match wins, default replicated. Returns a pytree of NamedShardings."""
+
+    def spec_for(path: str):
+        for needle, spec in rules:
+            if needle in path:
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    shardings = [spec_for(jax.tree_util.keystr(path)) for path, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
