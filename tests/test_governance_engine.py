@@ -1,0 +1,283 @@
+"""Engine + plugin integration: full pipeline against a real filesystem
+workspace through the gateway harness (reference:
+governance/test/integration.test.ts (712), hooks.test.ts, engine.test.ts)."""
+
+from vainplex_openclaw_tpu.core import Gateway
+from vainplex_openclaw_tpu.governance import GovernancePlugin
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock, make_gateway
+
+
+def load_governance(workspace, config=None, clock=None, gw=None):
+    gw = gw or Gateway(config={"agents": {"list": ["main", "viola"]}},
+                       clock=clock or FakeClock())
+    plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock)
+    cfg = {"enabled": True, **(config or {})}
+    gw.load(plugin, plugin_config=cfg)
+    gw.start()
+    return gw, plugin
+
+
+CTX = {"agent_id": "main", "session_key": "agent:main"}
+
+
+class TestEnforcementRoundTrip:
+    def test_credential_guard_blocks_and_audits(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        d, res = gw.run_tool("read", {"file_path": "/app/.env"}, lambda p: "secret", CTX)
+        assert d.blocked and "Credential Guard" in d.block_reason
+        assert res is None
+        plugin.engine.audit_trail.flush()
+        recs = plugin.engine.audit_trail.query(verdict="deny")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["context"]["toolName"] == "read"
+        assert "A.5.24" in rec["controls"] and "A.8.11" in rec["controls"]
+
+    def test_allowed_tool_flows_and_builds_trust(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        before = plugin.engine.trust_manager.get_agent_trust("main")["score"]
+        d, res = gw.run_tool("read", {"file_path": "/app/main.py"}, lambda p: "code", CTX)
+        assert d.allowed and res == "code"
+        after = plugin.engine.trust_manager.get_agent_trust("main")
+        assert after["signals"]["successCount"] == 1
+        assert after["score"] >= before
+
+    def test_denial_records_violation_and_session_penalty(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        gw.session_start(CTX)
+        st_before = plugin.engine.session_trust.get_session_trust("agent:main", "main").score
+        gw.before_tool_call("exec", {"command": "cat .env"}, CTX)
+        agent = plugin.engine.trust_manager.get_agent_trust("main")
+        assert agent["signals"]["violationCount"] == 1
+        st_after = plugin.engine.session_trust.get_session_trust("agent:main", "main").score
+        assert st_after == max(0, st_before - 5)
+
+    def test_night_mode_deny_skips_trust_violation(self, workspace, openclaw_home):
+        clock = FakeClock(0.0)  # epoch 00:00 UTC → night (local=UTC in tests)
+        gw, plugin = load_governance(
+            workspace, config={"builtinPolicies": {"nightMode": True}}, clock=clock)
+        d = gw.before_tool_call("exec", {"command": "ls"}, CTX)
+        assert d.blocked and "Night mode" in d.block_reason
+        # no trust death spiral for scheduled agents
+        assert plugin.engine.trust_manager.get_agent_trust("main")["signals"]["violationCount"] == 0
+
+    def test_2fa_verdict_without_approver_denies(self, workspace, openclaw_home):
+        policy = {"id": "needs-2fa", "rules": [{
+            "id": "r", "conditions": [{"type": "tool", "name": "exec"}],
+            "effect": {"action": "2fa", "reason": "sensitive"}}]}
+        gw, _ = load_governance(workspace, config={"policies": [policy],
+                                                   "builtinPolicies": {}})
+        d = gw.before_tool_call("exec", {"command": "ls"}, CTX)
+        assert d.blocked and "2FA required" in d.block_reason
+
+    def test_message_sending_enforcement(self, workspace, openclaw_home):
+        policy = {"id": "no-pii-out", "scope": {"hooks": ["message_sending"]}, "rules": [{
+            "id": "r", "conditions": [{"type": "context", "messageContains": r"\bSSN\b"}],
+            "effect": {"action": "deny", "reason": "PII outbound"}}]}
+        gw, _ = load_governance(workspace, config={"policies": [policy],
+                                                   "builtinPolicies": {}})
+        d = gw.message_sending("here is the SSN 123", CTX)
+        assert d.blocked
+        d2 = gw.message_sending("all clear", CTX)
+        assert not d2.blocked
+
+
+class TestLifecycleAndFailModes:
+    def test_fail_open_vs_closed_on_engine_crash(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        plugin.engine.evaluate = None  # simulate catastrophic breakage
+        d = gw.before_tool_call("read", {}, CTX)
+        assert d.allowed  # fail-open default
+
+        gw2, plugin2 = load_governance(workspace, config={"failMode": "closed"})
+        plugin2.engine.evaluate = None
+        d2 = gw2.before_tool_call("read", {}, CTX)
+        assert d2.blocked and "closed-fail" in d2.block_reason
+
+    def test_pipeline_internal_error_respects_fail_mode(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace, config={"failMode": "closed"})
+        plugin.engine.risk_assessor.assess = lambda *a: 1 / 0
+        d = gw.before_tool_call("read", {}, CTX)
+        assert d.blocked
+
+    def test_trust_persisted_across_gateway_restarts(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        gw.run_tool("read", {"file_path": "x.py"}, lambda p: "ok", CTX)
+        gw.stop()
+        stored = read_json(workspace / "governance" / "trust.json")
+        assert stored["agents"]["main"]["signals"]["successCount"] == 1
+
+        gw2, plugin2 = load_governance(workspace)
+        assert plugin2.engine.trust_manager.get_agent_trust("main")["signals"]["successCount"] == 1
+
+    def test_known_agents_seeded_from_gateway_config(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        assert set(plugin.engine.trust_manager.store["agents"]) >= {"main", "viola"}
+
+    def test_session_end_cleans_state(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        gw.session_start(CTX)
+        gw.run_tool("read", {"file_path": "x"}, lambda p: 1, CTX)
+        assert "agent:main" in plugin.tool_call_log
+        gw.session_end(CTX)
+        assert "agent:main" not in plugin.tool_call_log
+        assert "agent:main" not in plugin.engine.session_trust.sessions
+
+
+class TestSubAgents:
+    def test_spawn_detection_and_ceiling(self, workspace, openclaw_home):
+        gw, plugin = load_governance(
+            workspace, config={"trust": {"enabled": True, "defaults": {"main": 50, "*": 10}}})
+        child_key = "agent:main:subagent:forge:abc"
+        gw.run_tool("sessions_spawn", {"agent": "forge"},
+                    lambda p: {"session_key": child_key}, CTX)
+        rel = plugin.engine.cross_agent.get_parent(child_key)
+        assert rel is not None and rel.parent_agent_id == "main"
+        # ceiling tracks the parent's live score (the spawn call itself
+        # recorded a success for main, so it moved slightly above the seed)
+        parent_score = plugin.engine.trust_manager.get_agent_trust("main")["score"]
+        assert plugin.engine.cross_agent.compute_trust_ceiling(child_key) == parent_score
+        assert 50 <= parent_score < 51
+
+    def test_child_denied_by_inherited_policy(self, workspace, openclaw_home):
+        parent_policy = {"id": "parent-no-exec", "scope": {"agents": ["main"]}, "rules": [{
+            "id": "r", "conditions": [{"type": "tool", "name": "exec"}],
+            "effect": {"action": "deny", "reason": "parent says no"}}]}
+        gw, _ = load_governance(workspace, config={"policies": [parent_policy],
+                                                   "builtinPolicies": {}})
+        child_ctx = {"agent_id": "forge", "session_key": "agent:main:subagent:forge:abc"}
+        d = gw.before_tool_call("exec", {"command": "ls"}, child_ctx)
+        assert d.blocked and "parent says no" in d.block_reason
+        # parent policy does not leak to unrelated agents
+        d2 = gw.before_tool_call("exec", {"command": "ls"},
+                                 {"agent_id": "viola", "session_key": "agent:viola"})
+        assert d2.allowed
+
+
+class TestValidationWiring:
+    def test_response_gate_blocks_with_fallback(self, workspace, openclaw_home):
+        gw, _ = load_governance(workspace, config={
+            "validation": {"enabled": True, "responseGate": {
+                "enabled": True,
+                "rules": [{"validators": [{"type": "requiredTools", "tools": ["web_search"]}]}]}}})
+        d = gw.before_message_write("the answer is 42", CTX)
+        assert d.blocked and "withheld" in d.final_text
+        gw.run_tool("web_search", {"q": "x"}, lambda p: "results", CTX)
+        d2 = gw.before_message_write("the answer is 42", CTX)
+        assert not d2.blocked
+
+    def test_output_validation_contradiction_blocks_low_trust(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace, config={
+            "trust": {"enabled": True, "defaults": {"*": 30}},
+            "validation": {"enabled": True, "facts": [
+                {"subject": "nats-broker", "predicate": "state", "value": "stopped"}]}})
+        d = gw.before_message_write("good news: the nats-broker is running", CTX)
+        assert d.blocked and "Contradiction" in d.final_text
+
+    def test_external_message_stage3_llm(self, workspace, openclaw_home):
+        gw, _ = make_gateway()
+        plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock,
+                                  call_llm=lambda p: '{"verdict": "block", "reason": "fabricated"}')
+        gw.load(plugin, plugin_config={
+            "enabled": True, "builtinPolicies": {},
+            "validation": {"enabled": True, "llmValidator": {"enabled": True}}})
+        gw.start()
+        d = gw.message_sending("press release text", {**CTX, "channel_id": "twitter"})
+        assert d.blocked and "fabricated" in d.block_reason
+        # internal channel → no stage 3
+        gw2, _ = make_gateway()
+        plugin2 = GovernancePlugin(workspace=str(workspace), clock=gw2.clock,
+                                   call_llm=lambda p: '{"verdict": "block", "reason": "nope"}')
+        gw2.load(plugin2, plugin_config={
+            "enabled": True, "builtinPolicies": {}, "internalChannels": ["team-chat"],
+            "validation": {"enabled": True, "llmValidator": {"enabled": True}}})
+        gw2.start()
+        d2 = gw2.message_sending("press release text", {**CTX, "channel_id": "team-chat"})
+        assert not d2.blocked
+
+
+class Test2FAWiring:
+    def test_2fa_flow_through_gateway(self, workspace, openclaw_home):
+        import threading
+
+        from vainplex_openclaw_tpu.governance.approval import generate_base32_secret
+
+        secret = generate_base32_secret()
+        policy = {"id": "gate-exec", "rules": [{
+            "id": "r", "conditions": [{"type": "tool", "name": "exec"}],
+            "effect": {"action": "2fa", "reason": "exec needs approval"}}]}
+        gw, plugin = load_governance(workspace, config={
+            "policies": [policy], "builtinPolicies": {},
+            "twoFa": {"enabled": True, "totpSecret": secret, "batchWindowMs": 30,
+                      "timeoutSeconds": 30, "approvers": ["@boss:m.org"]}})
+        assert plugin.approval_2fa is not None
+
+        code = plugin.approval_2fa.totp.generate()
+
+        def approve_later():
+            import time as _t
+
+            deadline = _t.time() + 2
+            while plugin.approval_2fa.pending_count() == 0 and _t.time() < deadline:
+                _t.sleep(0.01)
+            # the code arrives as a message in the same conversation
+            gw2_results = plugin.handle_2fa_code(
+                {"content": code}, {"sender_id": "@boss:m.org", "session_key": "agent:main"})
+            assert gw2_results["twofa"]["status"] == "approved"
+
+        t = threading.Thread(target=approve_later)
+        t.start()
+        d = gw.before_tool_call("exec", {"command": "deploy"}, CTX)
+        t.join(timeout=5)
+        assert d.allowed
+        # session approval: immediate second call needs no code
+        d2 = gw.before_tool_call("exec", {"command": "deploy2"}, CTX)
+        assert d2.allowed
+
+    def test_non_code_messages_pass_through(self, workspace, openclaw_home):
+        from vainplex_openclaw_tpu.governance.approval import generate_base32_secret
+
+        gw, plugin = load_governance(workspace, config={
+            "twoFa": {"enabled": True, "totpSecret": generate_base32_secret(),
+                      "approvers": ["@b"]}})
+        assert gw.message_received("hello there", CTX) == []
+
+
+class TestDashboardsAndMethods:
+    def test_status_and_trust_commands(self, workspace, openclaw_home):
+        gw, _ = load_governance(workspace)
+        gw.before_tool_call("read", {"file_path": "x"}, CTX)
+        text = gw.command("/governance")["text"]
+        assert "policies=" in text and "evaluations=1" in text
+        trust_text = gw.command("/trust")["text"]
+        assert "main" in trust_text
+        one = gw.command("/trust", args="main")["text"]
+        assert "successes=" in one
+
+    def test_gateway_methods(self, workspace, openclaw_home):
+        gw, _ = load_governance(workspace)
+        status = gw.call_method("governance.status")
+        assert status["policyCount"] >= 3
+        trust = gw.call_method("governance.trust", "main", "agent:main")
+        assert trust["agent"]["agentId"] == "main"
+
+    def test_stats_running_average(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        for _ in range(5):
+            gw.before_tool_call("read", {"file_path": "ok.py"}, CTX)
+        st = plugin.engine.stats
+        assert st.total_evaluations == 5 and st.avg_evaluation_us > 0
+
+    def test_agent_resolution_from_session_key(self, workspace, openclaw_home):
+        gw, plugin = load_governance(workspace)
+        gw.before_tool_call("read", {"file_path": "x"},
+                            {"session_key": "agent:viola:subagent:scout:1"})
+        assert "scout" in plugin.engine.trust_manager.store["agents"]
+
+    def test_disabled_plugin_no_hooks(self, workspace, openclaw_home):
+        gw, _ = make_gateway()
+        plugin = GovernancePlugin(workspace=str(workspace))
+        gw.load(plugin, plugin_config={"enabled": False})
+        assert gw.bus.handlers_for("before_tool_call") == []

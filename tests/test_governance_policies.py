@@ -1,0 +1,367 @@
+"""Policy evaluator + conditions + builtins + loader tests
+(reference: governance/test/policy-evaluator.test.ts (366),
+conditions tests, builtin-policies tests, policy-loader tests)."""
+
+import pytest
+
+from vainplex_openclaw_tpu.governance.builtin_policies import get_builtin_policies
+from vainplex_openclaw_tpu.governance.conditions import create_condition_evaluators
+from vainplex_openclaw_tpu.governance.frequency import FrequencyTracker
+from vainplex_openclaw_tpu.governance.policy_evaluator import (
+    PolicyEvaluator,
+    aggregate_matches,
+    policy_specificity,
+    sort_policies,
+)
+from vainplex_openclaw_tpu.governance.policy_loader import (
+    build_policy_index,
+    load_policies,
+    policies_for,
+    validate_regex,
+)
+from vainplex_openclaw_tpu.governance.types import (
+    ConditionDeps,
+    EvalTrust,
+    EvaluationContext,
+    MatchedPolicy,
+    RiskAssessment,
+    TrustSnapshot,
+)
+from vainplex_openclaw_tpu.governance.util import TimeContext
+
+from helpers import FakeClock
+from vainplex_openclaw_tpu.core.api import list_logger
+
+
+def make_ctx(agent_id="main", tool_name="exec", tool_params=None, hour=12,
+             agent_score=50, session_score=50, session_key=None, channel=None,
+             message_content=None, day_of_week=3, **kw):
+    from vainplex_openclaw_tpu.governance.util import score_to_tier
+
+    return EvaluationContext(
+        agent_id=agent_id,
+        session_key=session_key or f"agent:{agent_id}",
+        hook="before_tool_call",
+        trust=EvalTrust(
+            agent=TrustSnapshot(agent_score, score_to_tier(agent_score)),
+            session=TrustSnapshot(session_score, score_to_tier(session_score)),
+        ),
+        time=TimeContext(hour=hour, minute=0, day_of_week=day_of_week, date="2026-07-29"),
+        tool_name=tool_name,
+        tool_params=tool_params,
+        channel=channel,
+        message_content=message_content,
+        **kw,
+    )
+
+
+def make_deps(risk_level="low", tracker=None, time_windows=None):
+    evaluators = create_condition_evaluators()
+    return ConditionDeps(
+        regex_cache={},
+        time_windows=time_windows or {},
+        risk=RiskAssessment(level=risk_level, score=10, factors=[]),
+        frequency_tracker=tracker or FrequencyTracker(),
+        evaluators=evaluators,
+    )
+
+
+def policy(rules, id="p1", priority=0, scope=None, controls=None):
+    return {"id": id, "name": id, "version": "1.0.0", "priority": priority,
+            "scope": scope or {}, "controls": controls or [], "rules": rules}
+
+
+def rule(conditions, action="deny", reason="r", id="r1", **kw):
+    return {"id": id, "conditions": conditions,
+            "effect": {"action": action, "reason": reason}, **kw}
+
+
+# ── conditions ───────────────────────────────────────────────────────
+
+
+class TestConditions:
+    def test_tool_name_exact_list_and_glob(self):
+        ev = make_deps().evaluators["tool"]
+        assert ev({"type": "tool", "name": "exec"}, make_ctx(), make_deps())
+        assert ev({"type": "tool", "name": ["read", "exec"]}, make_ctx(), make_deps())
+        assert ev({"type": "tool", "name": "ex*"}, make_ctx(), make_deps())
+        assert ev({"type": "tool", "name": "e?ec"}, make_ctx(), make_deps())
+        assert not ev({"type": "tool", "name": "read"}, make_ctx(), make_deps())
+        assert not ev({"type": "tool", "name": "exec"}, make_ctx(tool_name=None), make_deps())
+
+    @pytest.mark.parametrize("matcher,value,expected", [
+        ({"equals": "x"}, "x", True),
+        ({"equals": "x"}, "y", False),
+        ({"contains": "env"}, "/app/.env", True),
+        ({"contains": "env"}, "/app/config", False),
+        ({"matches": r"\.env$"}, "path/.env", True),
+        ({"matches": r"\.env$"}, "path/.envy", False),
+        ({"matches": r"\.env$"}, 42, False),
+        ({"startsWith": "rm"}, "rm -rf", True),
+        ({"startsWith": "rm"}, "echo rm", False),
+        ({"in": ["a", "b"]}, "a", True),
+        ({"in": ["a", "b"]}, "c", False),
+    ])
+    def test_param_matchers(self, matcher, value, expected):
+        ev = make_deps().evaluators["tool"]
+        got = ev({"type": "tool", "params": {"command": matcher}},
+                 make_ctx(tool_params={"command": value}), make_deps())
+        assert got is expected
+
+    def test_tool_params_missing_fails(self):
+        ev = make_deps().evaluators["tool"]
+        assert not ev({"type": "tool", "params": {"x": {"equals": 1}}},
+                      make_ctx(tool_params=None), make_deps())
+
+    def test_invalid_regex_param_fails_safe(self):
+        ev = make_deps().evaluators["tool"]
+        assert not ev({"type": "tool", "params": {"c": {"matches": "("}}},
+                      make_ctx(tool_params={"c": "x"}), make_deps())
+
+    def test_time_inline_range_and_midnight_wrap(self):
+        ev = make_deps().evaluators["time"]
+        night = {"type": "time", "after": "23:00", "before": "08:00"}
+        assert ev(night, make_ctx(hour=23), make_deps())
+        assert ev(night, make_ctx(hour=2), make_deps())
+        assert not ev(night, make_ctx(hour=12), make_deps())
+        assert ev({"type": "time", "after": "09:00"}, make_ctx(hour=10), make_deps())
+        assert not ev({"type": "time", "before": "09:00"}, make_ctx(hour=10), make_deps())
+
+    def test_time_days_and_named_window(self):
+        ev = make_deps().evaluators["time"]
+        deps = make_deps(time_windows={"maintenance": {"start": "10:00", "end": "14:00", "days": [3]}})
+        cond = {"type": "time", "window": "maintenance"}
+        assert ev(cond, make_ctx(hour=12, day_of_week=3), deps)
+        assert not ev(cond, make_ctx(hour=12, day_of_week=4), deps)
+        assert not ev(cond, make_ctx(hour=15, day_of_week=3), deps)
+        assert not ev({"type": "time", "window": "missing"}, make_ctx(), deps)
+        assert not ev({"type": "time", "after": "10:00", "days": [1]},
+                      make_ctx(hour=12, day_of_week=3), make_deps())
+
+    def test_malformed_time_fails_safe(self):
+        ev = make_deps().evaluators["time"]
+        assert not ev({"type": "time", "after": "25:00", "before": "08:00"},
+                      make_ctx(hour=2), make_deps())
+
+    def test_agent_condition(self):
+        ev = make_deps().evaluators["agent"]
+        assert ev({"type": "agent", "id": "main"}, make_ctx(), make_deps())
+        assert ev({"type": "agent", "id": "m*"}, make_ctx(), make_deps())
+        assert not ev({"type": "agent", "id": ["viola"]}, make_ctx(), make_deps())
+        # trustTier checks the persistent AGENT tier, not session tier
+        ctx = make_ctx(agent_score=85, session_score=10)
+        assert ev({"type": "agent", "trustTier": ["elevated"]}, ctx, make_deps())
+        assert ev({"type": "agent", "minScore": 80}, ctx, make_deps())
+        assert not ev({"type": "agent", "maxScore": 80}, ctx, make_deps())
+
+    def test_risk_condition(self):
+        ev = make_deps().evaluators["risk"]
+        assert ev({"type": "risk", "minRisk": "medium"}, make_ctx(), make_deps("high"))
+        assert not ev({"type": "risk", "minRisk": "critical"}, make_ctx(), make_deps("high"))
+        assert ev({"type": "risk", "maxRisk": "high"}, make_ctx(), make_deps("medium"))
+        assert not ev({"type": "risk", "maxRisk": "low"}, make_ctx(), make_deps("medium"))
+
+    def test_frequency_condition(self):
+        clk = FakeClock()
+        tracker = FrequencyTracker(clock=clk)
+        for _ in range(5):
+            tracker.record("main", "agent:main", "exec")
+        deps = make_deps(tracker=tracker)
+        ev = deps.evaluators["frequency"]
+        assert ev({"type": "frequency", "maxCount": 5, "windowSeconds": 60}, make_ctx(), deps)
+        assert not ev({"type": "frequency", "maxCount": 6, "windowSeconds": 60}, make_ctx(), deps)
+
+    def test_context_condition(self):
+        deps = make_deps()
+        ev = deps.evaluators["context"]
+        ctx = make_ctx(message_content="please deploy to prod", channel="telegram",
+                       metadata={"urgent": True}, conversation_context=["we said hello"])
+        assert ev({"type": "context", "messageContains": "deploy"}, ctx, deps)
+        assert not ev({"type": "context", "messageContains": "^deploy$"}, ctx, deps)
+        assert ev({"type": "context", "conversationContains": ["hello"]}, ctx, deps)
+        assert ev({"type": "context", "hasMetadata": "urgent"}, ctx, deps)
+        assert not ev({"type": "context", "hasMetadata": ["urgent", "nope"]}, ctx, deps)
+        assert ev({"type": "context", "channel": ["telegram"]}, ctx, deps)
+        assert not ev({"type": "context", "channel": "matrix"}, ctx, deps)
+        assert ev({"type": "context", "sessionKey": "agent:*"}, ctx, deps)
+
+    def test_any_and_not_recursive(self):
+        deps = make_deps()
+        any_cond = {"type": "any", "conditions": [
+            {"type": "tool", "name": "read"},
+            {"type": "tool", "name": "exec"},
+        ]}
+        assert deps.evaluators["any"](any_cond, make_ctx(), deps)
+        assert not deps.evaluators["any"]({"type": "any", "conditions": []}, make_ctx(), deps)
+        not_cond = {"type": "not", "condition": {"type": "tool", "name": "read"}}
+        assert deps.evaluators["not"](not_cond, make_ctx(), deps)
+        nested = {"type": "not", "condition": any_cond}
+        assert not deps.evaluators["not"](nested, make_ctx(), deps)
+
+
+# ── evaluator & aggregation ──────────────────────────────────────────
+
+
+class TestPolicyEvaluator:
+    def test_verdict_precedence_deny_over_2fa_over_audit_over_allow(self):
+        def m(action):
+            return MatchedPolicy("p", "r", {"action": action, "reason": action})
+
+        assert aggregate_matches([m("allow"), m("audit"), m("2fa"), m("deny")]).action == "deny"
+        assert aggregate_matches([m("allow"), m("audit"), m("2fa")]).action == "2fa"
+        res = aggregate_matches([m("allow"), m("audit")])
+        assert res.action == "allow" and res.audit_only
+        assert aggregate_matches([m("allow")]).action == "allow"
+        assert aggregate_matches([]).reason == "No matching policies"
+
+    def test_first_deny_reason_wins(self):
+        matches = [MatchedPolicy("a", "r", {"action": "deny", "reason": "first"}),
+                   MatchedPolicy("b", "r", {"action": "deny", "reason": "second"})]
+        assert aggregate_matches(matches).reason == "first"
+
+    def test_scope_filtering_and_specificity_sort(self):
+        p_broad = policy([rule([], action="allow")], id="broad", priority=10)
+        p_specific = policy([rule([], action="deny")], id="specific", priority=10,
+                            scope={"agents": ["main"], "hooks": ["before_tool_call"]})
+        ordered = sort_policies([p_broad, p_specific])
+        assert [p["id"] for p in ordered] == ["specific", "broad"]
+        assert policy_specificity(p_specific) == 13
+
+    def test_exclude_agents_scope(self):
+        ev = PolicyEvaluator()
+        p = policy([rule([], action="deny")], scope={"excludeAgents": ["main"]})
+        res = ev.evaluate(make_ctx(agent_id="main"), [p], make_deps())
+        assert res.action == "allow"
+        res2 = ev.evaluate(make_ctx(agent_id="viola", session_key="agent:viola"), [p], make_deps())
+        assert res2.action == "deny"
+
+    def test_channel_scope(self):
+        ev = PolicyEvaluator()
+        p = policy([rule([], action="deny")], scope={"channels": ["telegram"]})
+        assert ev.evaluate(make_ctx(), [p], make_deps()).action == "allow"
+        assert ev.evaluate(make_ctx(channel="telegram"), [p], make_deps()).action == "deny"
+
+    def test_rule_trust_gates_use_session_tier(self):
+        ev = PolicyEvaluator()
+        p = policy([rule([], action="deny", minTrust="trusted")])
+        # session tier standard → rule skipped
+        assert ev.evaluate(make_ctx(session_score=50), [p], make_deps()).action == "allow"
+        assert ev.evaluate(make_ctx(session_score=70), [p], make_deps()).action == "deny"
+        p2 = policy([rule([], action="deny", maxTrust="restricted")])
+        assert ev.evaluate(make_ctx(session_score=50), [p2], make_deps()).action == "allow"
+        assert ev.evaluate(make_ctx(session_score=10), [p2], make_deps()).action == "deny"
+
+    def test_first_matching_rule_in_policy_wins(self):
+        ev = PolicyEvaluator()
+        p = policy([
+            rule([{"type": "tool", "name": "exec"}], action="allow", id="allow-exec"),
+            rule([], action="deny", id="deny-all"),
+        ])
+        res = ev.evaluate(make_ctx(tool_name="exec"), [p], make_deps())
+        assert res.matches[0].rule_id == "allow-exec" and res.action == "allow"
+
+
+# ── builtin policies ─────────────────────────────────────────────────
+
+
+class TestBuiltinPolicies:
+    def evaluate(self, ctx, config=None, tracker=None):
+        policies = get_builtin_policies(config or {
+            "nightMode": True, "credentialGuard": True,
+            "productionSafeguard": True, "rateLimiter": {"maxPerMinute": 15}})
+        return PolicyEvaluator().evaluate(ctx, policies, make_deps(tracker=tracker))
+
+    def test_night_mode_allows_readonly_denies_rest(self):
+        res = self.evaluate(make_ctx(tool_name="read", hour=2))
+        assert res.action == "allow"
+        res2 = self.evaluate(make_ctx(tool_name="exec", tool_params={"command": "ls"}, hour=2))
+        assert res2.action == "deny" and "Night mode" in res2.reason
+        res3 = self.evaluate(make_ctx(tool_name="exec", tool_params={"command": "ls"}, hour=12))
+        assert res3.action == "allow"
+
+    def test_credential_guard_patterns(self):
+        deny_cases = [
+            ("read", {"file_path": "/app/.env"}),
+            ("read", {"path": "secrets/prod.pem"}),
+            ("exec", {"command": "cat /etc/app/.env"}),
+            ("exec", {"command": "grep password /var/log"}),
+            ("exec", {"command": "scp id.key host:"}),
+            ("write", {"file_path": "/home/credentials.json"}),
+        ]
+        for tool, params in deny_cases:
+            res = self.evaluate(make_ctx(tool_name=tool, tool_params=params))
+            assert res.action == "deny", (tool, params)
+            assert "Credential Guard" in res.reason
+        ok = self.evaluate(make_ctx(tool_name="read", tool_params={"file_path": "/app/main.py"}))
+        assert ok.action == "allow"
+
+    def test_production_safeguard_trust_exemption(self):
+        params = {"command": "git push origin main"}
+        low = self.evaluate(make_ctx(tool_name="exec", tool_params=params, agent_score=50))
+        assert low.action == "deny" and "Production Safeguard" in low.reason
+        high = self.evaluate(make_ctx(tool_name="exec", tool_params=params, agent_score=70))
+        assert high.action == "allow"
+        # unresolved agents excluded from the safeguard scope entirely
+        unres = self.evaluate(make_ctx(agent_id="unresolved", tool_name="exec",
+                                       tool_params=params, agent_score=50,
+                                       session_key="agent:unresolved"))
+        assert unres.action == "allow"
+
+    def test_rate_limiter_doubles_for_trusted(self):
+        clk = FakeClock()
+        tracker = FrequencyTracker(clock=clk)
+        for _ in range(16):
+            tracker.record("main", "agent:main", "exec")
+        res = self.evaluate(make_ctx(agent_score=50, tool_name="read"), tracker=tracker)
+        assert res.action == "deny" and "Rate limit" in res.reason
+        # trusted agent: limit is 30 → 16 actions still allowed
+        res2 = self.evaluate(make_ctx(agent_score=70, tool_name="read"), tracker=tracker)
+        assert res2.action == "allow"
+        for _ in range(15):
+            tracker.record("main", "agent:main", "exec")
+        res3 = self.evaluate(make_ctx(agent_score=70, tool_name="read"), tracker=tracker)
+        assert res3.action == "deny"
+
+    def test_builtins_disabled_by_config(self):
+        assert get_builtin_policies({}) == []
+        only_cred = get_builtin_policies({"credentialGuard": True})
+        assert [p["id"] for p in only_cred] == ["builtin-credential-guard"]
+
+
+# ── loader / index / ReDoS ───────────────────────────────────────────
+
+
+class TestPolicyLoader:
+    def test_validate_regex_guards(self):
+        assert validate_regex("a" * 501) is not None
+        assert validate_regex("(a+)+") is not None
+        assert validate_regex("(x*)*y") is not None
+        assert validate_regex("(") is not None
+        assert validate_regex(r"\.(env|pem|key)$") is None
+
+    def test_unsafe_user_policy_dropped(self):
+        log = list_logger()
+        user = [policy([rule([{"type": "tool", "params": {"c": {"matches": "(a+)+"}}}])], id="bad")]
+        out = load_policies({}, user, log)
+        assert all(p["id"] != "bad" for p in out)
+        assert any("dropped" in m for m in log.messages("warn"))
+
+    def test_disabled_user_policy_skipped(self):
+        out = load_policies({}, [dict(policy([rule([])], id="off"), enabled=False)], list_logger())
+        assert out == []
+
+    def test_precompiled_regex_cache(self):
+        cache = {}
+        user = [policy([rule([{"type": "tool", "params": {"c": {"matches": r"rm\s+-rf"}}}])], id="ok")]
+        load_policies({}, user, list_logger(), cache)
+        assert r"rm\s+-rf" in cache
+
+    def test_index_and_policies_for(self):
+        p_all = policy([rule([])], id="all-agents")
+        p_main = policy([rule([])], id="main-only", scope={"agents": ["main"]})
+        p_hook = policy([rule([])], id="msg-only", scope={"hooks": ["message_sending"]})
+        index = build_policy_index([p_all, p_main, p_hook])
+        got = {p["id"] for p in policies_for(index, "main", "before_tool_call")}
+        assert got == {"all-agents", "main-only"}
+        got2 = {p["id"] for p in policies_for(index, "viola", "message_sending")}
+        assert got2 == {"all-agents", "msg-only"}

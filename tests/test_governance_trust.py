@@ -1,0 +1,347 @@
+"""Trust manager + session trust + cross-agent + risk + audit tests
+(reference: governance/test/trust-manager.test.ts (437),
+session-trust-manager.test.ts, cross-agent.test.ts, risk-assessor.test.ts,
+audit-trail.test.ts)."""
+
+import math
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.governance.audit import AuditTrail, derive_controls
+from vainplex_openclaw_tpu.governance.cross_agent import CrossAgentManager
+from vainplex_openclaw_tpu.governance.frequency import FrequencyTracker
+from vainplex_openclaw_tpu.governance.risk import RiskAssessor
+from vainplex_openclaw_tpu.governance.trust import (
+    SessionTrustManager,
+    TrustManager,
+    compute_score,
+    DEFAULT_WEIGHTS,
+)
+from vainplex_openclaw_tpu.governance.types import MatchedPolicy
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock
+
+from test_governance_policies import make_ctx
+
+DAY = 86400.0
+
+
+def make_tm(tmp_path, clock=None, config=None):
+    return TrustManager(config or {}, tmp_path, list_logger(), clock=clock or FakeClock())
+
+
+# ── trust formula ────────────────────────────────────────────────────
+
+
+class TestTrustFormula:
+    def test_compute_score_components_and_caps(self):
+        s = {"ageDays": 100, "successCount": 1000, "violationCount": 0,
+             "cleanStreak": 100, "manualAdjustment": 0}
+        # age capped at 20, success at 30, streak at 20
+        assert compute_score(s, DEFAULT_WEIGHTS) == 70
+        s2 = {"ageDays": 10, "successCount": 50, "violationCount": 3,
+              "cleanStreak": 10, "manualAdjustment": 5}
+        # 5 + 5 - 6 + 3 + 5 = 12
+        assert compute_score(s2, DEFAULT_WEIGHTS) == 12
+
+    def test_clamped_to_0_100(self):
+        s = {"ageDays": 0, "successCount": 0, "violationCount": 50,
+             "cleanStreak": 0, "manualAdjustment": 0}
+        assert compute_score(s, DEFAULT_WEIGHTS) == 0
+        s["manualAdjustment"] = 500
+        assert compute_score(s, DEFAULT_WEIGHTS) == 100
+
+
+class TestTrustManager:
+    def test_default_and_wildcard_and_explicit(self, tmp_path):
+        tm = make_tm(tmp_path, config={"defaults": {"main": 60, "*": 25}})
+        assert tm.get_agent_trust("main")["score"] == 60
+        assert tm.get_agent_trust("other")["score"] == 25
+        tm2 = make_tm(tmp_path / "b")
+        assert tm2.get_agent_trust("x")["score"] == 10
+
+    def test_success_violation_streak(self, tmp_path):
+        tm = make_tm(tmp_path, config={"defaults": {"*": 30}})
+        tm.record_success("a")
+        agent = tm.get_agent_trust("a")
+        assert agent["signals"]["successCount"] == 1 and agent["signals"]["cleanStreak"] == 1
+        assert agent["score"] > 30
+        tm.record_violation("a", "bad")
+        agent = tm.get_agent_trust("a")
+        assert agent["signals"]["cleanStreak"] == 0
+        assert agent["history"][-1]["type"] == "violation"
+
+    def test_set_score_compensates_signals(self, tmp_path):
+        tm = make_tm(tmp_path)
+        for _ in range(10):
+            tm.record_success("a")
+        tm.set_score("a", 55)
+        assert tm.get_agent_trust("a")["score"] == 55
+        # another success still moves the needle from the new base
+        tm.record_success("a")
+        assert tm.get_agent_trust("a")["score"] > 55
+
+    def test_tier_lock_and_floor(self, tmp_path):
+        tm = make_tm(tmp_path)
+        tm.lock_tier("a", "trusted")
+        assert tm.get_agent_trust("a")["tier"] == "trusted"
+        tm.record_violation("a")
+        assert tm.get_agent_trust("a")["tier"] == "trusted"  # still locked
+        tm.unlock_tier("a")
+        assert tm.get_agent_trust("a")["tier"] == "untrusted"
+        tm.set_floor("a", 45)
+        assert tm.get_agent_trust("a")["score"] == 45
+        tm.record_violation("a")
+        assert tm.get_agent_trust("a")["score"] == 45  # floor holds
+
+    def test_history_trimmed(self, tmp_path):
+        tm = make_tm(tmp_path, config={"maxHistoryPerAgent": 5})
+        for _ in range(10):
+            tm.record_success("a")
+        assert len(tm.get_agent_trust("a")["history"]) == 5
+
+    def test_persistence_roundtrip_and_age_refresh(self, tmp_path):
+        clk = FakeClock()
+        tm = make_tm(tmp_path, clock=clk, config={"defaults": {"*": 40}})
+        tm.record_success("a")
+        tm.flush()
+        stored = read_json(tmp_path / "governance" / "trust.json")
+        assert stored["agents"]["a"]["signals"]["successCount"] == 1
+
+        clk.advance(10 * DAY)
+        tm2 = make_tm(tmp_path, clock=clk, config={"defaults": {"*": 40}})
+        tm2.load()
+        assert tm2.get_agent_trust("a")["signals"]["ageDays"] == 10
+
+    def test_decay_on_inactivity(self, tmp_path):
+        clk = FakeClock()
+        tm = make_tm(tmp_path, clock=clk, config={
+            "defaults": {"*": 50}, "decay": {"enabled": True, "inactivityDays": 7, "rate": 0.9}})
+        tm.get_agent_trust("a")
+        tm.flush()
+        clk.advance(8 * DAY)
+        tm2 = make_tm(tmp_path, clock=clk, config={"decay": {"enabled": True, "inactivityDays": 7, "rate": 0.9}})
+        tm2.load()
+        assert tm2.store["agents"]["a"]["score"] == 45.0
+
+    def test_migration_unknown_agent_removed(self, tmp_path):
+        tm = make_tm(tmp_path)
+        tm.get_agent_trust("unknown")
+        tm.get_agent_trust("real")
+        tm.flush()
+        tm2 = make_tm(tmp_path)
+        tm2.load()
+        assert "unknown" not in tm2.store["agents"]
+        assert "real" in tm2.store["agents"]
+
+    def test_migration_default_scores_backfilled(self, tmp_path):
+        # Simulate an old store where a fresh agent has score but manual=0
+        tm = make_tm(tmp_path, config={"defaults": {"*": 50}})
+        agent = tm.get_agent_trust("a")
+        agent["signals"]["manualAdjustment"] = 0  # legacy shape
+        tm.dirty = True
+        tm.flush()
+        tm2 = make_tm(tmp_path, config={"defaults": {"*": 50}})
+        tm2.load()
+        assert tm2.store["agents"]["a"]["signals"]["manualAdjustment"] == 50
+
+    def test_corrupt_store_keeps_defaults(self, tmp_path):
+        path = tmp_path / "governance" / "trust.json"
+        path.parent.mkdir(parents=True)
+        path.write_text("{broken")
+        tm = make_tm(tmp_path)
+        tm.load()
+        assert tm.store["agents"] == {}
+
+
+class TestSessionTrust:
+    def test_seed_and_ceiling(self, tmp_path):
+        tm = make_tm(tmp_path, config={"defaults": {"*": 50}})
+        stm = SessionTrustManager({"seedFactor": 0.8, "ceilingFactor": 1.0}, tm)
+        st = stm.initialize_session("s1", "a")
+        assert st.score == 40 and st.tier == "standard"
+        stm.set_score("s1", "a", 90)
+        assert stm.get_session_trust("s1", "a").score == 50  # capped at agent score
+
+    def test_signals_and_streak_bonus(self, tmp_path):
+        tm = make_tm(tmp_path, config={"defaults": {"*": 100}})
+        stm = SessionTrustManager({}, tm)
+        stm.initialize_session("s1", "a")
+        base = stm.get_session_trust("s1", "a").score
+        for _ in range(9):
+            stm.apply_signal("s1", "a", "success")
+        assert stm.get_session_trust("s1", "a").score == base + 9
+        stm.apply_signal("s1", "a", "success")  # 10th → +1 +2 bonus, streak reset
+        st = stm.get_session_trust("s1", "a")
+        assert st.score == base + 12 and st.clean_streak == 0
+        stm.apply_signal("s1", "a", "policyBlock")
+        assert stm.get_session_trust("s1", "a").score == base + 7
+        stm.apply_signal("s1", "a", "credentialViolation")
+        assert stm.get_session_trust("s1", "a").score == max(0, base + 7 - 15)
+
+    def test_disabled_mirrors_agent(self, tmp_path):
+        tm = make_tm(tmp_path, config={"defaults": {"*": 70}})
+        stm = SessionTrustManager({"enabled": False}, tm)
+        st = stm.initialize_session("s1", "a")
+        assert st.score == 70 and st.tier == "trusted"
+        stm.apply_signal("s1", "a", "policyBlock")
+        assert stm.get_session_trust("s1", "a").score == 70
+
+    def test_lru_eviction_above_500(self, tmp_path):
+        clk = FakeClock()
+        tm = make_tm(tmp_path, clock=clk)
+        stm = SessionTrustManager({}, tm, clock=clk)
+        for i in range(505):
+            clk.advance(1)
+            stm.initialize_session(f"s{i}", "a")
+        assert len(stm.sessions) == 500
+        assert "s0" not in stm.sessions and "s504" in stm.sessions
+
+    def test_destroy_session(self, tmp_path):
+        tm = make_tm(tmp_path)
+        stm = SessionTrustManager({}, tm)
+        stm.initialize_session("s1", "a")
+        stm.destroy_session("s1")
+        assert "s1" not in stm.sessions
+
+
+class TestCrossAgent:
+    def make(self, tmp_path, parent_score=60):
+        tm = make_tm(tmp_path, config={"defaults": {"main": parent_score, "*": 80}})
+        return CrossAgentManager(tm, list_logger()), tm
+
+    def test_explicit_registration_and_parse_fallback(self, tmp_path):
+        cam, _ = self.make(tmp_path)
+        cam.register_relationship("agent:main", "agent:main:subagent:forge:abc")
+        rel = cam.get_parent("agent:main:subagent:forge:abc")
+        assert rel.parent_agent_id == "main" and rel.child_agent_id == "forge"
+        # fallback parse without registration
+        rel2 = cam.get_parent("agent:main:subagent:scout:x")
+        assert rel2 is not None and rel2.parent_agent_id == "main"
+        assert cam.get_parent("agent:main") is None
+
+    def test_trust_ceiling_caps_child(self, tmp_path):
+        cam, tm = self.make(tmp_path, parent_score=60)
+        ctx = make_ctx(agent_id="forge", session_key="agent:main:subagent:forge:abc",
+                       agent_score=80, session_score=80)
+        out = cam.enrich_context(ctx)
+        assert out.trust.agent.score == 60 and out.trust.agent.tier == "trusted"
+        assert out.cross_agent.trust_ceiling == 60
+        assert math.isinf(cam.compute_trust_ceiling("agent:main"))
+
+    def test_policy_inheritance_one_level_deduped(self, tmp_path):
+        from vainplex_openclaw_tpu.governance.policy_loader import build_policy_index
+        from test_governance_policies import policy, rule
+
+        cam, _ = self.make(tmp_path)
+        p_parent = policy([rule([])], id="parent-policy", scope={"agents": ["main"]})
+        p_shared = policy([rule([])], id="shared", scope={"agents": ["main", "forge"]})
+        p_child = policy([rule([])], id="child-policy", scope={"agents": ["forge"]})
+        index = build_policy_index([p_parent, p_shared, p_child])
+        ctx = make_ctx(agent_id="forge", session_key="agent:main:subagent:forge:abc")
+        ctx = cam.enrich_context(ctx)
+        effective = cam.resolve_effective_policies(ctx, index)
+        ids = [p["id"] for p in effective]
+        assert sorted(ids) == ["child-policy", "parent-policy", "shared"]
+        assert ctx.cross_agent.inherited_policy_ids == ["parent-policy"]
+
+
+class TestRiskAssessor:
+    def test_factor_weights_sum(self):
+        ra = RiskAssessor()
+        tracker = FrequencyTracker(clock=FakeClock())
+        ctx = make_ctx(tool_name="gateway", hour=2, session_score=0,
+                       tool_params={"host": "prod.example.com"})
+        out = ra.assess(ctx, tracker)
+        # 95/100*30 + 15 + 20 + 0 + 20 = 83.5 → critical
+        assert out.score == 84 and out.level == "critical"
+
+    def test_low_risk_read_business_hours(self):
+        ra = RiskAssessor()
+        out = ra.assess(make_ctx(tool_name="read", hour=12, session_score=100),
+                        FrequencyTracker(clock=FakeClock()))
+        assert out.level == "low" and out.score == 3
+
+    def test_frequency_factor_and_overrides(self):
+        clk = FakeClock()
+        tracker = FrequencyTracker(clock=clk)
+        for _ in range(20):
+            tracker.record("main", "agent:main", "x")
+        ra = RiskAssessor({"read": 90})
+        out = ra.assess(make_ctx(tool_name="read", session_score=100), tracker)
+        freq = next(f for f in out.factors if f.name == "frequency")
+        assert freq.value == 15
+        tool = next(f for f in out.factors if f.name == "tool_sensitivity")
+        assert tool.value == 27.0  # override 90
+
+    def test_unknown_tool_default(self):
+        ra = RiskAssessor()
+        out = ra.assess(make_ctx(tool_name="mystery", session_score=100),
+                        FrequencyTracker(clock=FakeClock()))
+        tool = next(f for f in out.factors if f.name == "tool_sensitivity")
+        assert tool.value == 9.0  # 30/100*30
+
+
+class TestAuditTrail:
+    def make(self, tmp_path, clock=None, config=None):
+        return AuditTrail(config or {}, tmp_path, list_logger(), clock=clock or FakeClock())
+
+    def test_derive_controls_denials_add_incident_response(self):
+        m = MatchedPolicy("p", "r", {"action": "deny"}, controls=["A.8.11"])
+        assert derive_controls([m], "deny") == ["A.5.24", "A.5.28", "A.8.11"]
+        assert derive_controls([m], "allow") == ["A.8.11"]
+
+    def test_buffering_and_flush_threshold(self, tmp_path):
+        at = self.make(tmp_path)
+        at.load()
+        for i in range(99):
+            at.record("allow", "ok", {"agentId": "a"}, {}, {}, [], 100)
+        assert len(at.buffer) == 99
+        at.record("allow", "ok", {"agentId": "a"}, {}, {}, [], 100)
+        assert at.buffer == []  # auto-flushed at 100
+        files = list((tmp_path / "governance" / "audit").glob("*.jsonl"))
+        assert len(files) == 1
+
+    def test_redaction_before_buffering(self, tmp_path):
+        at = self.make(tmp_path, config={"redactPatterns": [r"sk-\w+"]})
+        rec = at.record("allow", "ok", {"toolParams": {"key": "sk-live123"}}, {}, {}, [], 1)
+        assert rec["context"]["toolParams"]["key"] == "[REDACTED]"
+
+    def test_query_filters(self, tmp_path):
+        at = self.make(tmp_path)
+        at.load()
+        at.record("deny", "no", {"agentId": "a"}, {}, {}, [], 1)
+        at.record("allow", "ok", {"agentId": "b"}, {}, {}, [], 1)
+        assert len(at.query(verdict="deny")) == 1
+        assert len(at.query(agent_id="b")) == 1
+        assert len(at.query(limit=1)) == 1
+
+    def test_retention_cleanup(self, tmp_path):
+        clk = FakeClock()
+        at = self.make(tmp_path, clock=clk, config={"retentionDays": 1})
+        old = tmp_path / "governance" / "audit" / "1999-01-01.jsonl"
+        old.parent.mkdir(parents=True)
+        old.write_text("{}\n")
+        at.load()
+        assert not old.exists()
+
+
+class TestFrequencyTracker:
+    def test_window_and_scopes(self):
+        clk = FakeClock()
+        t = FrequencyTracker(clock=clk)
+        t.record("a", "s1")
+        clk.advance(30)
+        t.record("a", "s2")
+        t.record("b", "s3")
+        assert t.count(60, "agent", "a") == 2
+        assert t.count(60, "session", session_key="s2") == 1
+        assert t.count(60, "global") == 3
+        clk.advance(40)  # first entry now out of window
+        assert t.count(60, "agent", "a") == 1
+
+    def test_ring_capacity(self):
+        t = FrequencyTracker(max_entries=3, clock=FakeClock())
+        for i in range(5):
+            t.record("a", f"s{i}")
+        assert t.count(60, "global") == 3

@@ -1,0 +1,409 @@
+"""Output validation + response gate + 2FA + reputation provider tests
+(reference: claim-detector/fact-checker/llm-validator/output-validator/
+response-gate/approval-2fa/erc8004 test files)."""
+
+import threading
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.governance.approval import Approval2FA, Totp, generate_base32_secret
+from vainplex_openclaw_tpu.governance.approval.poller import MatrixPoller
+from vainplex_openclaw_tpu.governance.security import (
+    AgentProofRestClient,
+    ERC8004Provider,
+    decode_agent_profile,
+    encode_uint256,
+)
+from vainplex_openclaw_tpu.governance.validation import (
+    FactRegistry,
+    LlmValidator,
+    OutputValidator,
+    ResponseGate,
+    check_claims,
+    detect_claims,
+    extract_facts_from_trace_report,
+)
+from vainplex_openclaw_tpu.governance.validation.facts import Fact
+from vainplex_openclaw_tpu.storage.atomic import write_json_atomic
+
+from helpers import FakeClock
+
+
+class TestClaimDetector:
+    def test_system_state(self):
+        claims = detect_claims("the nats-broker is running and backup.timer is down")
+        subjects = {(c.subject, c.value) for c in claims if c.type == "system_state"}
+        assert ("nats-broker", "running") in subjects
+        assert (("backup.timer", "down") in subjects)
+
+    def test_common_word_filter(self):
+        assert not [c for c in detect_claims("it is running and everything is down")
+                    if c.type == "system_state"]
+
+    def test_entity_name(self):
+        claims = detect_claims('the service named "cortex-api" handles requests')
+        assert any(c.type == "entity_name" and c.subject == "cortex-api"
+                   and c.value == "service" for c in claims)
+
+    def test_existence_positive_and_negative(self):
+        claims = detect_claims("backup.sh exists but restore.sh does not exist")
+        values = {(c.subject, c.value) for c in claims if c.type == "existence"}
+        assert ("backup.sh", "true") in values and ("restore.sh", "false") in values
+
+    def test_self_referential(self):
+        claims = detect_claims("I have deployed the fix to production")
+        assert any(c.type == "self_referential" for c in claims)
+
+    def test_detector_subset(self):
+        claims = detect_claims("service x is running. I am sure.",
+                               enabled=["self_referential"])
+        assert all(c.type == "self_referential" for c in claims)
+
+
+class TestFactRegistry:
+    def test_check_claims_statuses(self):
+        reg = FactRegistry([{"subject": "nats-broker", "predicate": "state", "value": "stopped"},
+                            {"subject": "api", "predicate": "state", "value": "running"}])
+        claims = detect_claims("nats-broker is running and api is running and mystery is up")
+        results = {r.claim.subject: r.status for r in check_claims(claims, reg)}
+        assert results["nats-broker"] == "contradicted"
+        assert results["api"] == "verified"
+        assert results["mystery"] == "unverified"
+
+    def test_fact_file_loading(self, tmp_path):
+        path = tmp_path / "facts.json"
+        write_json_atomic(path, {"facts": [
+            {"subject": "db", "predicate": "state", "value": "online"}]})
+        reg = FactRegistry()
+        assert reg.load_facts_from_file(path) == 1
+        assert reg.lookup("DB", "STATE").value == "online"
+
+    def test_trace_to_facts_bridge(self, tmp_path):
+        path = tmp_path / "trace-analysis-report.json"
+        write_json_atomic(path, {"findings": [
+            {"signal": "SIG-HALLUCINATION", "confidence": 0.9,
+             "factCorrection": {"subject": "backup.timer", "predicate": "state",
+                                "value": "disabled"}},
+            {"signal": "SIG-TOOL-FAIL"},  # no correction → skipped
+        ]})
+        facts = extract_facts_from_trace_report(path)
+        assert len(facts) == 1
+        assert facts[0]["subject"] == "backup.timer"
+        assert facts[0]["source"] == "trace-analyzer:SIG-HALLUCINATION"
+
+
+class TestOutputValidator:
+    def make(self, facts=None, config=None, llm=None):
+        reg = FactRegistry(facts or [
+            {"subject": "nats-broker", "predicate": "state", "value": "stopped"}])
+        return OutputValidator(config or {"enabled": True}, reg, list_logger(), llm)
+
+    def test_trust_proportional_contradiction_verdicts(self):
+        ov = self.make()
+        text = "the nats-broker is running"
+        assert ov.validate(text, 30).verdict == "block"
+        assert ov.validate(text, 50).verdict == "flag"
+        assert ov.validate(text, 70).verdict == "pass"
+
+    def test_no_claims_passes(self):
+        assert self.make().validate("hello world", 10).verdict == "pass"
+
+    def test_unverified_policy(self):
+        ov = self.make(config={"enabled": True, "unverifiedClaimPolicy": "flag"})
+        res = ov.validate("mystery-svc is running", 50)
+        assert res.verdict == "flag" and "Unverified claim" in res.reason
+
+    def test_self_referential_policy(self):
+        ov = self.make(config={"enabled": True, "unverifiedClaimPolicy": "flag",
+                               "selfReferentialPolicy": "block"})
+        res = ov.validate("I have verified the backups", 90)
+        assert res.verdict == "block" and "Self-referential" in res.reason
+
+    def test_stage3_most_restrictive_wins(self):
+        llm = LlmValidator(lambda p: '{"verdict": "block", "reason": "llm says no"}',
+                           list_logger())
+        ov = self.make(config={"enabled": True, "llmValidator": {"enabled": True}}, llm=llm)
+        res = ov.validate("all good here", 90, is_external=True)
+        assert res.verdict == "block" and "llm says no" in res.reason
+
+    def test_stage3_error_fails_open_to_stage12(self):
+        def boom(p):
+            raise ConnectionError("no llm")
+
+        llm = LlmValidator(boom, list_logger())
+        ov = self.make(config={"enabled": True, "llmValidator": {"enabled": True}}, llm=llm)
+        res = ov.validate("the nats-broker is running", 70, is_external=True)
+        assert res.verdict == "pass"
+
+
+class TestLlmValidator:
+    def test_markdown_fence_tolerance_and_cache(self):
+        calls = []
+
+        def fake_llm(prompt):
+            calls.append(prompt)
+            return '```json\n{"verdict": "flag", "reason": "odd", "issues": [{"category": "exaggeration", "detail": "x"}]}\n```'
+
+        clk = FakeClock()
+        v = LlmValidator(fake_llm, list_logger(), clock=clk)
+        r1 = v.validate("text", [])
+        assert r1.verdict == "flag" and len(r1.issues) == 1
+        r2 = v.validate("text", [])
+        assert r2.from_cache and len(calls) == 1
+        clk.advance(301)
+        v.validate("text", [])
+        assert len(calls) == 2
+
+    def test_retry_then_fail_mode(self):
+        flaky_calls = []
+
+        def flaky(prompt):
+            flaky_calls.append(1)
+            return "not json at all"
+
+        v = LlmValidator(flaky, list_logger(), fail_mode="open")
+        assert v.validate("t", []).verdict == "pass"
+        assert len(flaky_calls) == 2  # one retry
+        v2 = LlmValidator(flaky, list_logger(), fail_mode="closed")
+        assert v2.validate("other", []).verdict == "block"
+
+    def test_known_facts_in_prompt(self):
+        captured = {}
+
+        def spy(prompt):
+            captured["prompt"] = prompt
+            return '{"verdict": "pass", "reason": "ok"}'
+
+        v = LlmValidator(spy, list_logger())
+        v.validate("msg", [Fact("db", "state", "online")])
+        assert "db state: online" in captured["prompt"]
+
+
+class TestResponseGate:
+    def make(self, rules, fallback=None):
+        cfg = {"enabled": True, "rules": rules}
+        if fallback:
+            cfg["fallbackMessage"] = fallback
+        return ResponseGate(cfg)
+
+    def test_required_tools(self):
+        gate = self.make([{"agents": ["main"], "validators": [
+            {"type": "requiredTools", "tools": ["web_search"]}]}])
+        res = gate.validate("answer", "main", [{"tool": "read"}])
+        assert not res.passed and "web_search" in res.reasons[0]
+        res2 = gate.validate("answer", "main", [{"tool": "web_search"}])
+        assert res2.passed
+        # rule scoped to main doesn't hit viola
+        assert gate.validate("answer", "viola", []).passed
+
+    def test_must_match_and_not_match(self):
+        gate = self.make([{"validators": [
+            {"type": "mustMatch", "pattern": r"(?i)sources?:"},
+            {"type": "mustNotMatch", "pattern": r"(?i)as an ai"}]}])
+        assert gate.validate("Sources: wiki", "a", []).passed
+        bad = gate.validate("As an AI, here are Sources: wiki", "a", [])
+        assert not bad.passed
+
+    def test_invalid_regex_fails_closed(self):
+        gate = self.make([{"validators": [{"type": "mustMatch", "pattern": "("}]}])
+        res = gate.validate("anything", "a", [])
+        assert not res.passed and "fail-closed" in res.reasons[0]
+
+    def test_fallback_template(self):
+        gate = self.make([{"validators": [
+            {"type": "mustMatch", "pattern": "x{99}"}]}], fallback="agent {agent} failed: {validators}")
+        res = gate.validate("nope", "main", [])
+        assert res.fallback_message == "agent main failed: mustMatch:x{99}"
+
+    def test_disabled_gate_passes(self):
+        assert ResponseGate({"enabled": False}).validate("x", "a", []).passed
+
+
+class Test2FA:
+    def make(self, clock=None, **overrides):
+        secret = generate_base32_secret()
+        clock = clock or FakeClock()
+        cfg = {"totpSecret": secret, "approvers": ["@boss:matrix.org"],
+               "batchWindowMs": 50, "timeoutSeconds": 60, **overrides}
+        return Approval2FA(cfg, list_logger(), clock=clock, wall_timers=False), clock
+
+    def test_totp_rfc6238_vector(self):
+        # RFC 6238 SHA1 test vector: secret ASCII "12345678901234567890"
+        import base64
+
+        secret = base64.b32encode(b"12345678901234567890").decode()
+        totp = Totp(secret, digits=8, clock=lambda: 59)
+        assert totp.generate() == "94287082"
+
+    def test_totp_validate_window_and_reject(self):
+        clk = FakeClock(1_000_000)
+        totp = Totp(generate_base32_secret(), clock=clk)
+        code = totp.generate()
+        assert totp.validate(code) == 0
+        clk.advance(30)
+        assert totp.validate(code) == -1  # previous period, within window
+        clk.advance(60)
+        assert totp.validate(code) is None
+        assert totp.validate("abc123") is None
+
+    def test_batch_approval_resolves_all(self):
+        approval, clk = self.make()
+        results = {}
+
+        def worker(name):
+            results[name] = approval.request("main", "conv1", name, {"command": name},
+                                             wait_timeout=5)
+
+        threads = [threading.Thread(target=worker, args=(f"tool{i}",)) for i in range(3)]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        deadline = _time.time() + 2
+        while approval.pending_count() < 3 and _time.time() < deadline:
+            _time.sleep(0.01)
+        code = approval.totp.generate()
+        out = approval.try_resolve(code, "@boss:matrix.org", "conv1")
+        assert out["status"] == "approved" and out["count"] == 3
+        for t in threads:
+            t.join(timeout=5)
+        assert all(r == {} for r in results.values())
+
+    def test_session_auto_approve_after_code(self):
+        approval, clk = self.make()
+        approval.request("main", "conv1", "exec", {}, wait=False)
+        approval.try_resolve(approval.totp.generate(), "@boss:matrix.org", "conv1")
+        # further calls auto-approve without waiting
+        assert approval.request("main", "conv1", "exec", {"command": "x"}) == {}
+        clk.advance(11 * 60)
+        out = approval.request("main", "conv1", "exec", {}, wait=False)
+        assert out.get("pending")  # session expired → new batch
+
+    def test_invalid_codes_cooldown(self):
+        approval, clk = self.make(maxAttempts=2, cooldownSeconds=60)
+        approval.request("main", "conv1", "exec", {}, wait=False)
+        assert approval.try_resolve("000000", "@boss:matrix.org", "conv1")["status"] == "invalid"
+        assert approval.try_resolve("000001", "@boss:matrix.org", "conv1")["status"] == "denied_cooldown"
+        out = approval.request("main", "conv1", "exec", {}, wait=False)
+        assert out.get("block") and "cooldown" in out["block_reason"]
+        clk.advance(61)
+        assert approval.request("main", "conv1", "exec", {}, wait=False).get("pending")
+
+    def test_unauthorized_sender(self):
+        approval, _ = self.make()
+        approval.request("main", "conv1", "exec", {}, wait=False)
+        out = approval.try_resolve(approval.totp.generate(), "@rando:matrix.org", "conv1")
+        assert out["status"] == "unauthorized"
+
+    def test_replay_protection(self):
+        approval, clk = self.make()
+        approval.request("main", "conv1", "exec", {}, wait=False)
+        code = approval.totp.generate()
+        assert approval.try_resolve(code, "@boss:matrix.org", "conv1")["status"] == "approved"
+        # burn the session window so the next request opens a new batch
+        approval._session_approvals.clear()
+        approval.request("main", "conv1", "exec", {}, wait=False)
+        assert approval.try_resolve(code, "@boss:matrix.org", "conv1")["status"] == "replay"
+
+    def test_timeout_denies_batch(self):
+        approval, clk = self.make()
+        out = {}
+
+        def worker():
+            out["r"] = approval.request("main", "conv1", "exec", {}, wait_timeout=0.1)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=5)
+        assert out["r"]["block"] and "timed out" in out["r"]["block_reason"]
+
+    def test_requires_secret(self):
+        with pytest.raises(ValueError):
+            Approval2FA({"totpSecret": None}, list_logger())
+
+
+class TestMatrixPoller:
+    def test_poll_dispatches_codes(self):
+        codes = []
+        responses = [{"chunk": [
+            {"type": "m.room.message", "sender": "@boss:m.org",
+             "content": {"body": "approval 123456 please"}},
+            {"type": "m.room.member", "content": {"body": "999999"}},
+        ], "start": "tok1"}]
+
+        def fake_get(url, headers, timeout=10.0):
+            assert "Bearer tok" in headers["Authorization"]
+            return responses.pop(0) if responses else {"chunk": []}
+
+        poller = MatrixPoller({"homeserver": "https://m.org", "accessToken": "tok",
+                               "roomId": "!r:m.org"},
+                              lambda code, sender: codes.append((code, sender)),
+                              list_logger(), http_get=fake_get)
+        assert poller.poll_once() == 1
+        assert codes == [("123456", "@boss:m.org")]
+
+
+class TestReputationProviders:
+    def test_abi_encode_decode(self):
+        assert encode_uint256(1) == "0".zfill(63) + "1"
+        profile_hex = ("0x" + "0" * 24 + "ab" * 20 +
+                       encode_uint256(7) + encode_uint256(83))
+        profile = decode_agent_profile(profile_hex)
+        assert profile["owner"] == "0x" + "ab" * 20
+        assert profile["feedback_count"] == 7 and profile["reputation_score"] == 83
+        assert decode_agent_profile("0xshort")["feedback_count"] == 0
+
+    def test_lookup_with_cache_and_tiers(self):
+        calls = []
+
+        def fake_rpc(url, payload, timeout=10.0):
+            calls.append(payload["params"][0]["data"][:10])
+            if payload["params"][0]["data"].startswith("0x6352211e"):
+                return {"result": "0x" + "0" * 24 + "cd" * 20}
+            return {"result": "0x" + "0" * 24 + "cd" * 20 + encode_uint256(12) + encode_uint256(85)}
+
+        p = ERC8004Provider({}, list_logger(), rpc_post=fake_rpc, clock=FakeClock())
+        r = p.lookup_reputation(42)
+        assert r["exists"] and r["tier"] == "excellent" and r["reputation_score"] == 85
+        r2 = p.lookup_reputation(42)
+        assert r2["from_cache"] and len(calls) == 2
+
+    def test_nonexistent_token_and_rpc_failure(self):
+        p = ERC8004Provider({}, list_logger(),
+                            rpc_post=lambda u, pl, timeout=10.0: {"result": "0x" + "0" * 64},
+                            clock=FakeClock())
+        assert p.lookup_reputation(1) == {"exists": False, "tier": "unknown"}
+
+        def down(u, pl, timeout=10.0):
+            raise ConnectionError("no chain")
+
+        p2 = ERC8004Provider({}, list_logger(), rpc_post=down, clock=FakeClock())
+        assert p2.lookup_reputation(1)["error"] == "rpc_unavailable"
+
+    def test_agentproof_lookup_and_feedback_queue(self, tmp_path):
+        keyfile = tmp_path / "key"
+        keyfile.write_text("secret-api-key\n")
+        sent = []
+
+        def fake_http(method, url, headers, body=None, timeout=10.0):
+            assert headers["Authorization"] == "Bearer secret-api-key"
+            sent.append((method, url, body))
+            if "batch" in url:
+                return {"results": {"a": {"score": 9}}}
+            return {"score": 7}
+
+        c = AgentProofRestClient({"baseUrl": "https://api.ap.io",
+                                  "apiKeyPath": str(keyfile)}, list_logger(),
+                                 http_request=fake_http)
+        assert c.lookup("agent-1")["score"] == 7
+        assert c.lookup_batch(["a", "b"]) == {"a": {"score": 9}, "b": None}
+        c.queue_feedback("a", "violation", "blocked")
+        assert c.queued == 1
+        assert c.flush_feedback() == 1 and c.queued == 0
+
+    def test_agentproof_degrades_without_key(self):
+        c = AgentProofRestClient({"baseUrl": "https://x"}, list_logger())
+        assert c.lookup("a") is None
+        c.queue_feedback("a", "s")
+        assert c.flush_feedback() == 0 and c.queued == 1

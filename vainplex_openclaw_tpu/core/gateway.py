@@ -48,6 +48,7 @@ class MessageWriteDecision:
     blocked: bool
     content: str
     fallback_message: Optional[str] = None
+    block_reason: Optional[str] = None
 
     @property
     def final_text(self) -> str:
@@ -150,12 +151,16 @@ class Gateway:
 
     # ── generic hook firing (the mock-api `_fire` equivalent) ────────
 
+    def _dispatch(self, hook_name: str, *args: Any, until=None, on_result=None) -> list[Any]:
+        """Single sync-vs-async dispatch decision: hooks with only sync
+        handlers skip the event loop entirely (the enforcement/ingest hot
+        paths are sync in the common case)."""
+        if self.bus.has_async(hook_name):
+            return _run(self.bus.fire(hook_name, *args, until=until, on_result=on_result))
+        return self.bus.fire_sync(hook_name, *args, until=until, on_result=on_result)
+
     def fire(self, hook_name: str, *args: Any) -> list[Any]:
-        # Fast path: hooks with only sync handlers skip the event loop entirely
-        # (the enforcement/ingest hot paths are sync in the common case).
-        if not self.bus.has_async(hook_name):
-            return self.bus.fire_sync(hook_name, *args)
-        return _run(self.bus.fire(hook_name, *args))
+        return self._dispatch(hook_name, *args)
 
     async def fire_async(self, hook_name: str, *args: Any) -> list[Any]:
         return await self.bus.fire(hook_name, *args)
@@ -191,10 +196,8 @@ class Gateway:
         return self._tool_call_decision(results, event)
 
     def before_tool_call(self, tool_name: str, params: dict, ctx: Optional[dict] = None) -> ToolCallDecision:
-        if self.bus.has_async("before_tool_call"):
-            return _run(self.before_tool_call_async(tool_name, params, ctx))
         event, fctx, fold, is_block = self._tool_call_fixture(tool_name, params, ctx)
-        results = self.bus.fire_sync("before_tool_call", event, fctx, until=is_block, on_result=fold)
+        results = self._dispatch("before_tool_call", event, fctx, until=is_block, on_result=fold)
         return self._tool_call_decision(results, event)
 
     def after_tool_call(self, tool_name: str, params: dict, result: Any = None,
@@ -257,18 +260,19 @@ class Gateway:
         def is_block(r: Any) -> bool:
             return isinstance(r, dict) and bool(r.get("block"))
 
-        if sync or not self.bus.has_async(hook):
+        if sync:
             results = self.bus.fire_sync(hook, event, ctx, until=is_block, on_result=fold)
         else:
-            results = self.fire_results(hook, event, ctx, until=is_block, on_result=fold)
+            results = self._dispatch(hook, event, ctx, until=is_block, on_result=fold)
         for r in results:
             if is_block(r):
                 return MessageWriteDecision(True, event["content"],
-                                            r.get("fallback_message") or r.get("fallbackMessage"))
+                                            r.get("fallback_message") or r.get("fallbackMessage"),
+                                            r.get("block_reason") or r.get("blockReason"))
         return MessageWriteDecision(False, event["content"])
 
     def fire_results(self, hook: str, *args: Any, until=None, on_result=None) -> list[Any]:
-        return _run(self.bus.fire(hook, *args, until=until, on_result=on_result))
+        return self._dispatch(hook, *args, until=until, on_result=on_result)
 
     def session_start(self, ctx: Optional[dict] = None) -> list[Any]:
         return self.fire("session_start", {}, dict(ctx or {}))

@@ -76,15 +76,16 @@ def _first_str(*values: Any) -> Optional[str]:
 def derive_event_id(canonical_type: str, session: str, payload: dict, ctx: dict) -> str:
     """Deterministic ID from the MOST SPECIFIC stable source identifier.
 
-    Specificity order message/tool-call id → job id → run id (the reference
-    checks run_id first, hooks.ts:74-86 — but a run-scoped id collapses every
-    same-type event within one run to a single ID, which defeats dedup; two
-    inbound messages in one run must not share an event id). UUID fallback.
+    Specificity order tool-call id → message id → job id → run id (the
+    reference checks run_id first, hooks.ts:74-86 — but a coarse-scoped id
+    collapses every same-type event within that scope to a single ID, which
+    defeats dedup: two inbound messages in one run, or two tool calls fired
+    while handling one message, must not share an event id). UUID fallback.
     """
     oe = ctx.get("original_event") or {}
     stable = _first_str(
-        ctx.get("message_id"), payload.get("message_id"), oe.get("message_id"),
         payload.get("tool_call_id"), ctx.get("tool_call_id"), oe.get("tool_call_id"),
+        ctx.get("message_id"), payload.get("message_id"), oe.get("message_id"),
         ctx.get("job_id"), payload.get("job_id"), oe.get("job_id"),
         ctx.get("run_id"), payload.get("run_id"), oe.get("run_id"),
         oe.get("id"),

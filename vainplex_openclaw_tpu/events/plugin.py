@@ -61,15 +61,18 @@ class EventStorePlugin:
 
     def _build_transport(self, logger):
         kind = self.config.get("transport", "memory")
+        r = self.config.get("retention", {})
         if kind == "nats":
-            t = create_nats_transport(self.config.get("natsUrl"), stream=self.config.get("stream"),
-                                      prefix=self.config.get("prefix"), logger=logger)
+            t = create_nats_transport(
+                self.config.get("natsUrl"), stream=self.config.get("stream"),
+                prefix=self.config.get("prefix"), logger=logger,
+                retention={"max_msgs": r.get("maxMsgs"), "max_bytes": r.get("maxBytes"),
+                           "max_age_s": r.get("maxAgeS")})
             if t is not None:
                 return t
             logger.warn("falling back to in-memory transport")
         if kind == "file" and self.config.get("fileRoot"):
             return FileTransport(self.config["fileRoot"], clock=self.clock)
-        r = self.config.get("retention", {})
         return MemoryTransport(
             max_msgs=r.get("maxMsgs", 100_000),
             max_bytes=r.get("maxBytes", 256 * 1024 * 1024),

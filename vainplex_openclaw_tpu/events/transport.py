@@ -22,7 +22,6 @@ never block or crash agent operations.
 
 from __future__ import annotations
 
-import fnmatch
 import json
 import time
 from collections import deque
@@ -124,7 +123,8 @@ class MemoryTransport:
     def fetch(self, subject_filter: str = ">", start_seq: int = 0,
               batch: Optional[int] = None) -> Iterator[ClawEvent]:
         n = 0
-        for subject, event, _ in self._events:
+        # snapshot: consumers iterate while the gateway keeps publishing
+        for subject, event, _ in list(self._events):
             if event.seq is not None and event.seq <= start_seq:
                 continue
             if not _subject_matches(subject_filter, subject):
@@ -235,7 +235,8 @@ def parse_nats_url(url: str) -> dict:
 
 
 def create_nats_transport(url: str, stream: str = "CLAW_EVENTS", prefix: str = "claw",
-                          logger=None):  # pragma: no cover - requires broker
+                          logger=None, retention: Optional[dict] = None,
+                          ):  # pragma: no cover - requires broker
     """Real JetStream adapter; returns None when the client lib is missing."""
     try:
         import nats  # type: ignore  # noqa: F401
@@ -245,4 +246,12 @@ def create_nats_transport(url: str, stream: str = "CLAW_EVENTS", prefix: str = "
         return None
     from .nats_adapter import NatsTransport
 
-    return NatsTransport(url, stream=stream, prefix=prefix, logger=logger)
+    retention = retention or {}
+    kwargs = {}
+    if retention.get("max_msgs") is not None:
+        kwargs["max_msgs"] = retention["max_msgs"]
+    if retention.get("max_bytes") is not None:
+        kwargs["max_bytes"] = retention["max_bytes"]
+    if retention.get("max_age_s") is not None:
+        kwargs["max_age_s"] = retention["max_age_s"]
+    return NatsTransport(url, stream=stream, prefix=prefix, logger=logger, **kwargs)

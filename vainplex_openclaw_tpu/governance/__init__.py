@@ -1,0 +1,12 @@
+"""The agent firewall (reference: packages/openclaw-governance).
+
+Policy-based enforcement over the gateway hooks: condition evaluation, risk
+assessment, persistent agent trust + ephemeral session trust, cross-agent
+trust ceilings, buffered audit trail, plus (in submodules) redaction, output
+validation, the response gate, and TOTP 2FA approval.
+"""
+
+from .engine import GovernanceEngine
+from .plugin import GovernancePlugin
+
+__all__ = ["GovernanceEngine", "GovernancePlugin"]

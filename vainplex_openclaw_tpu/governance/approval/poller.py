@@ -1,0 +1,94 @@
+"""Matrix room poller for TOTP codes
+(reference: governance/src/matrix-poller.ts:1-40 + creds loading
+hooks.ts:786-801).
+
+Polls one Matrix room via the client-server REST API every ``interval_s``
+for 6-digit codes, independent of the gateway's own Matrix sync. Network
+calls go through a DI'd ``http_get`` so tests run without a homeserver and
+the zero-egress environment degrades cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Callable, Optional
+
+from ...storage.atomic import read_json
+
+CODE_RE = re.compile(r"\b(\d{6})\b")
+
+
+def load_matrix_credentials(path: str) -> Optional[dict]:
+    """Secrets file format: {homeserver, accessToken, roomId, userId}."""
+    creds = read_json(path)
+    if not isinstance(creds, dict):
+        return None
+    if not all(creds.get(k) for k in ("homeserver", "accessToken", "roomId")):
+        return None
+    return creds
+
+
+def _default_http_get(url: str, headers: dict, timeout: float = 10.0) -> dict:
+    from urllib.request import Request, urlopen
+
+    req = Request(url, headers=headers)
+    with urlopen(req, timeout=timeout) as resp:  # noqa: S310 — operator-configured homeserver
+        return json.loads(resp.read().decode())
+
+
+class MatrixPoller:
+    def __init__(self, creds: dict, on_code: Callable[[str, str], None],
+                 logger, interval_s: float = 2.0,
+                 http_get: Callable = _default_http_get):
+        self.creds = creds
+        self.on_code = on_code
+        self.logger = logger
+        self.interval_s = interval_s
+        self.http_get = http_get
+        self._since: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="matrix-2fa-poller")
+        self._thread.start()
+        self.logger.info("[2fa] Matrix poller started")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception as exc:  # noqa: BLE001 — keep polling through transient failures
+                self.logger.warn(f"[2fa] Matrix poll failed: {exc}")
+
+    def poll_once(self) -> int:
+        """One fetch of recent room messages; returns # codes dispatched."""
+        room = self.creds["roomId"]
+        base = self.creds["homeserver"].rstrip("/")
+        url = f"{base}/_matrix/client/v3/rooms/{room}/messages?dir=b&limit=10"
+        if self._since:
+            url += f"&from={self._since}"
+        data = self.http_get(url, {"Authorization": f"Bearer {self.creds['accessToken']}"})
+        dispatched = 0
+        for event in data.get("chunk", []):
+            if event.get("type") != "m.room.message":
+                continue
+            body = (event.get("content") or {}).get("body") or ""
+            sender = event.get("sender") or ""
+            m = CODE_RE.search(body)
+            if m:
+                self.on_code(m.group(1), sender)
+                dispatched += 1
+        self._since = data.get("start") or self._since
+        return dispatched

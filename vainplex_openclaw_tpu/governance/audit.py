@@ -1,0 +1,153 @@
+"""Buffered daily-JSONL audit trail with ISO-27001 control derivation
+(reference: governance/src/audit-trail.ts:25-230, audit-redactor.ts).
+
+Records buffer in memory and flush at 100 records (or on the interval timer /
+shutdown). Denials always carry incident-response controls A.5.24/A.5.28.
+Context fields are regex-redacted before buffering — secrets must never wait
+in memory either.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..storage.atomic import append_jsonl, read_jsonl
+from .types import MatchedPolicy
+
+FLUSH_THRESHOLD = 100
+
+
+def derive_controls(matched: list[MatchedPolicy], verdict: str) -> list[str]:
+    controls = set()
+    for m in matched:
+        controls.update(m.controls)
+    if verdict == "deny":
+        controls.update(("A.5.24", "A.5.28"))
+    return sorted(controls)
+
+
+def create_redactor(patterns: list[str]):
+    compiled = []
+    for p in patterns or []:
+        try:
+            compiled.append(re.compile(p))
+        except re.error:
+            continue
+
+    def redact_value(value):
+        if isinstance(value, str):
+            for rx in compiled:
+                value = rx.sub("[REDACTED]", value)
+            return value
+        if isinstance(value, dict):
+            return {k: redact_value(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [redact_value(v) for v in value]
+        return value
+
+    return redact_value
+
+
+class AuditTrail:
+    def __init__(self, config: dict, workspace: str | Path, logger,
+                 clock: Callable[[], float] = time.time):
+        self.config = config or {}
+        self.audit_dir = Path(workspace) / "governance" / "audit"
+        self.logger = logger
+        self.clock = clock
+        self.redact = create_redactor(self.config.get("redactPatterns", []))
+        # Optional deep scrubber (wired to the redaction subsystem's
+        # credential-only engine): vault resolution re-injects REAL secrets
+        # into tool params before governance evaluates/audits them, so the
+        # audit path must scrub independently of user redactPatterns.
+        self.scrubber = None
+        self.buffer: list[dict] = []
+        self.today_count = 0
+
+    def _date_str(self, ts: float) -> str:
+        t = time.gmtime(ts)
+        return f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}"
+
+    def load(self) -> None:
+        self.audit_dir.mkdir(parents=True, exist_ok=True)
+        self.clean_old_files()
+        today = self.audit_dir / f"{self._date_str(self.clock())}.jsonl"
+        self.today_count = sum(1 for _ in read_jsonl(today))
+        self.logger.info("Audit trail loaded")
+
+    def record(self, verdict: str, reason: str, context: dict, trust: dict,
+               risk: dict, matched: list[MatchedPolicy], evaluation_us: int) -> dict:
+        now = self.clock()
+        if self.scrubber is not None:
+            try:
+                context = self.scrubber(context)
+            except Exception as exc:  # noqa: BLE001 — scrub failure must not kill auditing
+                self.logger.error(f"Audit scrubber failed: {exc}")
+        rec = {
+            "id": str(uuid.uuid4()),
+            "timestamp": now * 1000,
+            "timestampIso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)),
+            "verdict": verdict,
+            "reason": reason,
+            "context": self.redact(context),
+            "trust": trust,
+            "risk": risk,
+            "matchedPolicies": [m.to_dict() for m in matched],
+            "evaluationUs": evaluation_us,
+            "controls": derive_controls(matched, verdict),
+        }
+        self.buffer.append(rec)
+        self.today_count += 1
+        if len(self.buffer) >= FLUSH_THRESHOLD:
+            self.flush()
+        return rec
+
+    def flush(self) -> None:
+        if not self.buffer:
+            return
+        by_day: dict[str, list[dict]] = {}
+        for rec in self.buffer:
+            by_day.setdefault(self._date_str(rec["timestamp"] / 1000), []).append(rec)
+        try:
+            for day, records in by_day.items():
+                append_jsonl(self.audit_dir / f"{day}.jsonl", records)
+            self.buffer = []
+        except OSError as exc:
+            self.logger.error(f"Audit flush failed: {exc}")
+
+    def query(self, verdict: Optional[str] = None, agent_id: Optional[str] = None,
+              since_ms: Optional[float] = None, limit: int = 100) -> list[dict]:
+        self.flush()
+        results: list[dict] = []
+        if not self.audit_dir.exists():
+            return results
+        for f in sorted(self.audit_dir.glob("*.jsonl"), reverse=True):
+            for rec in read_jsonl(f):
+                if verdict and rec.get("verdict") != verdict:
+                    continue
+                if agent_id and (rec.get("context") or {}).get("agentId") != agent_id:
+                    continue
+                if since_ms and rec.get("timestamp", 0) < since_ms:
+                    continue
+                results.append(rec)
+            if len(results) >= limit:
+                break
+        results.sort(key=lambda r: r.get("timestamp", 0), reverse=True)
+        return results[:limit]
+
+    def clean_old_files(self) -> None:
+        retention_days = self.config.get("retentionDays", 90)
+        cutoff = self._date_str(self.clock() - retention_days * 86400)
+        for f in self.audit_dir.glob("*.jsonl"):
+            if f.stem < cutoff:
+                try:
+                    f.unlink()
+                except OSError:
+                    pass
+
+    def stats(self) -> dict:
+        return {"today": self.today_count, "buffered": len(self.buffer)}

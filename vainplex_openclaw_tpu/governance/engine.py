@@ -1,0 +1,259 @@
+"""GovernanceEngine: the enforcement orchestrator
+(reference: governance/src/engine.ts).
+
+Pipeline per evaluation (engine.ts:210-267): cross-agent enrich → frequency
+record → risk assess → effective policies (own + inherited) → policy
+evaluate → trust learning on deny (except time-based denials — night-mode
+blocks must not start a trust death spiral for scheduled agents) → audit.
+Tracks a running mean of evaluation µs (the reference's only continuously
+measured metric, engine.ts:535-544).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .audit import AuditTrail
+from .cross_agent import CrossAgentManager
+from .conditions import create_condition_evaluators
+from .frequency import FrequencyTracker
+from .policy_evaluator import PolicyEvaluator
+from .policy_loader import build_policy_index, load_policies
+from .risk import RiskAssessor
+from .trust import SessionTrustManager, TrustManager
+from .types import (
+    ConditionDeps,
+    EvalTrust,
+    EvaluationContext,
+    RiskAssessment,
+    TrustSnapshot,
+)
+from .util import current_time_context, now_us
+
+TIME_BASED_POLICY_IDS = {"builtin-night-mode"}
+
+
+@dataclass
+class Verdict:
+    action: str
+    reason: str
+    risk: Optional[RiskAssessment]
+    matched_policies: list
+    trust: dict
+    evaluation_us: int
+
+
+@dataclass
+class EngineStats:
+    total_evaluations: int = 0
+    allow_count: int = 0
+    deny_count: int = 0
+    avg_evaluation_us: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "totalEvaluations": self.total_evaluations,
+            "allowCount": self.allow_count,
+            "denyCount": self.deny_count,
+            "avgEvaluationUs": round(self.avg_evaluation_us, 1),
+        }
+
+
+class GovernanceEngine:
+    def __init__(self, config: dict, workspace: str, logger,
+                 clock: Callable[[], float] = time.time):
+        self.config = config
+        self.workspace = workspace
+        self.logger = logger
+        self.clock = clock
+
+        self.regex_cache: dict = {}
+        policies = load_policies(config.get("builtinPolicies", {}),
+                                 config.get("policies", []), logger, self.regex_cache)
+        self.policy_index = build_policy_index(policies)
+        self.evaluators = create_condition_evaluators()
+        self.evaluator = PolicyEvaluator()
+        self.frequency_tracker = FrequencyTracker(clock=clock)
+        self.risk_assessor = RiskAssessor(config.get("toolRiskOverrides", {}))
+        self.trust_manager = TrustManager(config.get("trust", {}), workspace, logger, clock=clock)
+        self.session_trust = SessionTrustManager(config.get("sessionTrust", {}),
+                                                 self.trust_manager, clock=clock)
+        self.cross_agent = CrossAgentManager(self.trust_manager, logger, clock=clock)
+        self.audit_trail = AuditTrail(config.get("audit", {}), workspace, logger, clock=clock)
+        self.stats = EngineStats()
+        self.known_agent_ids: list[str] = []
+        # Filled by the validation subsystem (output_validator) when enabled.
+        self.output_validator = None
+
+    # ── lifecycle ────────────────────────────────────────────────────
+
+    def set_known_agents(self, agent_ids: list[str]) -> None:
+        self.known_agent_ids = list(agent_ids)
+
+    def start(self) -> None:
+        self.trust_manager.load()
+        for agent_id in self.known_agent_ids:
+            self.trust_manager.get_agent_trust(agent_id)  # auto-creates w/ defaults
+        if self.config.get("audit", {}).get("enabled", True):
+            self.audit_trail.load()
+        self.frequency_tracker.clear()
+        self.logger.info(f"Engine started: {self.policy_count()} policies loaded")
+
+    def stop(self) -> None:
+        self.audit_trail.flush()
+        self.trust_manager.flush()
+        self.logger.info("Engine stopped")
+
+    # ── context building ─────────────────────────────────────────────
+
+    def build_context(self, hook: str, agent_id: str, session_key: str,
+                      tool_name: Optional[str] = None, tool_params: Optional[dict] = None,
+                      message_content: Optional[str] = None, message_to: Optional[str] = None,
+                      channel: Optional[str] = None, metadata: Optional[dict] = None,
+                      conversation_context: Optional[list] = None) -> EvaluationContext:
+        agent = self.trust_manager.get_agent_trust(agent_id)
+        session = self.session_trust.get_session_trust(session_key, agent_id)
+        return EvaluationContext(
+            agent_id=agent_id,
+            session_key=session_key,
+            hook=hook,
+            trust=EvalTrust(
+                agent=TrustSnapshot(agent["score"], agent["tier"]),
+                session=TrustSnapshot(session.score, session.tier),
+            ),
+            time=current_time_context(self.clock(), self.config.get("timezone", "local")),
+            tool_name=tool_name,
+            tool_params=tool_params,
+            message_content=message_content,
+            message_to=message_to,
+            channel=channel,
+            metadata=metadata or {},
+            conversation_context=conversation_context or [],
+        )
+
+    # ── evaluation ───────────────────────────────────────────────────
+
+    def evaluate(self, ctx: EvaluationContext) -> Verdict:
+        start = now_us()
+        try:
+            verdict = self._run_pipeline(ctx, start)
+        except Exception as exc:  # noqa: BLE001 — fail-open/closed per config
+            self.logger.error(f"Pipeline crash: {exc}")
+            return self._eval_error_verdict(exc, start)
+        self._update_stats(verdict.action, verdict.evaluation_us)
+        return verdict
+
+    def _eval_error_verdict(self, exc: Exception, start: int) -> Verdict:
+        fail_mode = self.config.get("failMode", "open")
+        action = "allow" if fail_mode == "open" else "deny"
+        return Verdict(action=action, reason=f"Governance error ({fail_mode}-fail): {exc}",
+                       risk=None, matched_policies=[], trust={}, evaluation_us=now_us() - start)
+
+    def _run_pipeline(self, ctx: EvaluationContext, start_us: int) -> Verdict:
+        ctx = self.cross_agent.enrich_context(ctx)
+        self.frequency_tracker.record(ctx.agent_id, ctx.session_key, ctx.tool_name)
+        risk = self.risk_assessor.assess(ctx, self.frequency_tracker)
+        policies = self.cross_agent.resolve_effective_policies(ctx, self.policy_index)
+        deps = ConditionDeps(
+            regex_cache=self.regex_cache,
+            time_windows=self.config.get("timeWindows", {}),
+            risk=risk,
+            frequency_tracker=self.frequency_tracker,
+            evaluators=self.evaluators,
+        )
+        result = self.evaluator.evaluate(ctx, policies, deps)
+        elapsed = now_us() - start_us
+        verdict = Verdict(
+            action=result.action,
+            reason=result.reason,
+            risk=risk,
+            matched_policies=result.matches,
+            trust={"score": ctx.trust.session.score, "tier": ctx.trust.session.tier},
+            evaluation_us=elapsed,
+        )
+
+        if verdict.action == "deny" and self.config.get("trust", {}).get("enabled", True):
+            time_based = any(m.policy_id in TIME_BASED_POLICY_IDS for m in result.matches
+                             if m.effect.get("action") == "deny")
+            if not time_based:
+                self.trust_manager.record_violation(ctx.agent_id, f"Policy denial: {verdict.reason}")
+                self.session_trust.apply_signal(ctx.session_key, ctx.agent_id, "policyBlock")
+
+        self._record_audit(ctx, verdict, risk, elapsed)
+        return verdict
+
+    def _record_audit(self, ctx: EvaluationContext, verdict: Verdict,
+                      risk: RiskAssessment, elapsed_us: int) -> None:
+        if not self.config.get("audit", {}).get("enabled", True):
+            return
+        self.audit_trail.record(
+            verdict.action, verdict.reason,
+            {
+                "hook": ctx.hook, "agentId": ctx.agent_id, "sessionKey": ctx.session_key,
+                "channel": ctx.channel, "toolName": ctx.tool_name,
+                "toolParams": ctx.tool_params, "messageContent": ctx.message_content,
+                "messageTo": ctx.message_to,
+            },
+            {"score": ctx.trust.session.score, "tier": ctx.trust.session.tier},
+            {"level": risk.level, "score": risk.score},
+            verdict.matched_policies,
+            elapsed_us,
+        )
+
+    # ── trust feedback (after_tool_call) ─────────────────────────────
+
+    def record_tool_success(self, agent_id: str, session_key: str) -> None:
+        if not self.config.get("trust", {}).get("enabled", True):
+            return
+        self.trust_manager.record_success(agent_id)
+        self.session_trust.apply_signal(session_key, agent_id, "success")
+
+    # ── session lifecycle ────────────────────────────────────────────
+
+    def handle_session_start(self, session_key: str, agent_id: str) -> None:
+        self.session_trust.initialize_session(session_key, agent_id)
+
+    def handle_session_end(self, session_key: str) -> None:
+        self.session_trust.destroy_session(session_key)
+
+    def register_sub_agent(self, parent_session_key: str, child_session_key: str) -> None:
+        self.cross_agent.register_relationship(parent_session_key, child_session_key)
+
+    # ── status & trust API ───────────────────────────────────────────
+
+    def policy_count(self) -> int:
+        return len({p["id"] for p in self.policy_index.all})
+
+    def get_status(self) -> dict:
+        return {
+            "enabled": self.config.get("enabled", True),
+            "policyCount": self.policy_count(),
+            "trustEnabled": self.config.get("trust", {}).get("enabled", True),
+            "auditEnabled": self.config.get("audit", {}).get("enabled", True),
+            "failMode": self.config.get("failMode", "open"),
+            "stats": self.stats.to_dict(),
+        }
+
+    def get_trust(self, agent_id: Optional[str] = None, session_key: Optional[str] = None):
+        if agent_id is None:
+            return self.trust_manager.store
+        agent = self.trust_manager.get_agent_trust(agent_id)
+        if session_key:
+            session = self.session_trust.get_session_trust(session_key, agent_id)
+        else:
+            session = None
+        return {"agent": agent, "session": vars(session) if session else None}
+
+    def set_trust(self, agent_id: str, score: float) -> None:
+        self.trust_manager.set_score(agent_id, score)
+
+    def _update_stats(self, action: str, us: int) -> None:
+        self.stats.total_evaluations += 1
+        if action == "deny":
+            self.stats.deny_count += 1
+        else:
+            self.stats.allow_count += 1
+        n = self.stats.total_evaluations
+        self.stats.avg_evaluation_us = (self.stats.avg_evaluation_us * (n - 1) + us) / n

@@ -1,0 +1,35 @@
+"""Fixed-size ring buffer of recent actions for frequency windows
+(reference: governance/src/frequency-tracker.ts)."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Optional
+
+
+class FrequencyTracker:
+    def __init__(self, max_entries: int = 10_000, clock: Callable[[], float] = time.time):
+        self._entries: deque[tuple[float, str, Optional[str], Optional[str]]] = deque(maxlen=max_entries)
+        self._clock = clock
+
+    def record(self, agent_id: str, session_key: Optional[str] = None,
+               tool_name: Optional[str] = None) -> None:
+        self._entries.append((self._clock(), agent_id, session_key, tool_name))
+
+    def count(self, window_seconds: float, scope: str = "agent",
+              agent_id: Optional[str] = None, session_key: Optional[str] = None) -> int:
+        cutoff = self._clock() - window_seconds
+        n = 0
+        for ts, agent, session, _tool in reversed(self._entries):
+            if ts < cutoff:
+                break  # entries are time-ordered; everything earlier is out of window
+            if scope == "agent" and agent != agent_id:
+                continue
+            if scope == "session" and session != session_key:
+                continue
+            n += 1
+        return n
+
+    def clear(self) -> None:
+        self._entries.clear()

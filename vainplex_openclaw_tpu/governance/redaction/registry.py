@@ -1,0 +1,115 @@
+"""Pattern registry: 17 built-in secret/PII/financial patterns + custom
+(reference: governance/src/redaction/registry.ts:17-220).
+
+Category order credential → financial → pii → custom; overlapping matches
+resolve to the longest match.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+CATEGORY_ORDER = ("credential", "financial", "pii", "custom")
+
+
+@dataclass(frozen=True)
+class RedactionPattern:
+    id: str
+    category: str
+    regex: re.Pattern
+    replacement_type: str
+    builtin: bool = True
+
+
+def _p(id: str, category: str, pattern: str, replacement_type: str,
+       flags: int = 0) -> RedactionPattern:
+    return RedactionPattern(id, category, re.compile(pattern, flags), replacement_type)
+
+
+BUILTIN_PATTERNS: tuple[RedactionPattern, ...] = (
+    _p("anthropic-api-key", "credential", r"sk-ant-[a-zA-Z0-9-]{80,}", "api_key"),
+    _p("openai-api-key", "credential", r"sk-[a-zA-Z0-9]{20,}", "api_key"),
+    _p("generic-api-key", "credential", r"sk-[a-zA-Z0-9_-]{20,}", "api_key"),
+    _p("aws-key", "credential", r"(?<![A-Z0-9])AKIA[0-9A-Z]{16}(?![A-Z0-9])", "api_key"),
+    _p("google-api-key", "credential", r"AIza[0-9A-Za-z_-]{35}", "api_key"),
+    _p("github-pat", "credential", r"ghp_[a-zA-Z0-9]{36}", "token"),
+    _p("github-server-token", "credential", r"ghs_[a-zA-Z0-9]{36}", "token"),
+    _p("gitlab-pat", "credential", r"glpat-[a-zA-Z0-9_-]{20,}", "token"),
+    _p("private-key-header", "credential",
+       r"-----BEGIN (?:RSA |EC |OPENSSH )?PRIVATE KEY-----", "private_key"),
+    _p("bearer-token", "credential", r"Bearer [a-zA-Z0-9_./-]{20,}", "bearer"),
+    _p("basic-auth", "credential", r"Basic [A-Za-z0-9+/]{16,}={0,2}", "basic_auth"),
+    _p("key-value-credential", "credential",
+       r"(?:password|passwd|pwd|secret|token|api_key|apikey)\s*[:=]\s*['\"]?[^\s'\"]{8,64}",
+       "credential", re.IGNORECASE),
+    _p("credit-card", "financial", r"\b[45]\d{3}[\s-]?\d{4}[\s-]?\d{4}[\s-]?\d{4}\b", "credit_card"),
+    _p("iban", "financial", r"\b[A-Z]{2}\d{2}\s?[A-Z0-9]{4}\s?(?:\d{4}\s?){2,7}\d{1,4}\b", "iban"),
+    _p("email-address", "pii", r"\b[a-zA-Z0-9._%+-]+@[a-zA-Z0-9.-]+\.[a-zA-Z]{2,}\b", "email"),
+    _p("phone-number", "pii", r"(?<!\d)\+?[1-9]\d{6,14}(?!\d)", "phone"),
+    _p("ssn-us", "pii", r"\b\d{3}-\d{2}-\d{4}\b", "ssn"),
+)
+
+
+@dataclass
+class PatternMatch:
+    pattern: RedactionPattern
+    match: str
+    start: int
+    end: int
+
+
+class PatternRegistry:
+    def __init__(self, enabled_categories: list[str],
+                 custom_patterns: Optional[list[dict]] = None, logger=None):
+        enabled = set(enabled_categories)
+        self.patterns: list[RedactionPattern] = [
+            p for p in BUILTIN_PATTERNS if p.category in enabled]
+        for cp in custom_patterns or []:
+            compiled = self._compile_custom(cp, logger)
+            if compiled is not None:
+                self.patterns.append(compiled)
+        if logger is not None:
+            n_builtin = sum(1 for p in self.patterns if p.builtin)
+            logger.info(f"[redaction] Registry initialized: {len(self.patterns)} patterns "
+                        f"({n_builtin} built-in, {len(self.patterns) - n_builtin} custom)")
+
+    @staticmethod
+    def _compile_custom(cp: dict, logger) -> Optional[RedactionPattern]:
+        from ..policy_loader import validate_regex
+
+        pattern = cp.get("pattern", "")
+        err = validate_regex(pattern)
+        if err:
+            if logger is not None:
+                logger.warn(f"[redaction] custom pattern {cp.get('id')} rejected: {err}")
+            return None
+        return RedactionPattern(
+            id=cp.get("id", "custom"),
+            category="custom",
+            regex=re.compile(pattern),
+            replacement_type=cp.get("replacementType", "custom"),
+            builtin=False,
+        )
+
+    def by_category(self, category: str) -> list[RedactionPattern]:
+        return [p for p in self.patterns if p.category == category]
+
+    def find_matches(self, text: str) -> list[PatternMatch]:
+        """All matches in category-priority order, overlaps resolved to the
+        longest (earlier-category wins ties), sorted by position."""
+        raw: list[PatternMatch] = []
+        for category in CATEGORY_ORDER:
+            for pattern in self.by_category(category):
+                for m in pattern.regex.finditer(text):
+                    raw.append(PatternMatch(pattern, m.group(0), m.start(), m.end()))
+        # overlap resolution: keep longest, first-registered priority on ties
+        raw.sort(key=lambda m: (m.start, -(m.end - m.start)))
+        out: list[PatternMatch] = []
+        last_end = -1
+        for m in raw:
+            if m.start >= last_end:
+                out.append(m)
+                last_end = m.end
+        return out

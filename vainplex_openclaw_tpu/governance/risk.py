@@ -1,0 +1,71 @@
+"""Risk assessment: 5 weighted factors → 0-100 score → level
+(reference: governance/src/risk-assessor.ts:10-99)."""
+
+from __future__ import annotations
+
+from .types import EvaluationContext, RiskAssessment, RiskFactor
+from .util import clamp
+
+DEFAULT_TOOL_RISK = {
+    "gateway": 95, "cron": 90, "elevated": 95,
+    "exec": 70, "write": 65, "edit": 60,
+    "sessions_spawn": 45, "sessions_send": 50,
+    "browser": 40, "message": 40,
+    "read": 10, "memory_search": 5, "memory_get": 5,
+    "web_search": 15, "web_fetch": 20, "image": 10, "canvas": 15,
+}
+UNKNOWN_TOOL_RISK = 30
+
+
+def score_to_risk_level(score: float) -> str:
+    if score <= 25:
+        return "low"
+    if score <= 50:
+        return "medium"
+    if score <= 75:
+        return "high"
+    return "critical"
+
+
+def _is_external_target(ctx: EvaluationContext) -> bool:
+    if ctx.message_to:
+        return True
+    params = ctx.tool_params
+    if not params:
+        return False
+    host = params.get("host")
+    if isinstance(host, str) and host != "sandbox":
+        return True
+    return params.get("elevated") is True
+
+
+class RiskAssessor:
+    def __init__(self, tool_risk_overrides: dict | None = None):
+        self.overrides = tool_risk_overrides or {}
+
+    def _tool_risk(self, tool_name) -> int:
+        if not tool_name:
+            return UNKNOWN_TOOL_RISK
+        if tool_name in self.overrides:
+            return self.overrides[tool_name]
+        return DEFAULT_TOOL_RISK.get(tool_name, UNKNOWN_TOOL_RISK)
+
+    def assess(self, ctx: EvaluationContext, frequency_tracker) -> RiskAssessment:
+        tool_raw = self._tool_risk(ctx.tool_name)
+        is_off_hours = ctx.time.hour < 8 or ctx.time.hour >= 23
+        recent = frequency_tracker.count(60, "agent", ctx.agent_id, ctx.session_key)
+        external = _is_external_target(ctx)
+        factors = [
+            RiskFactor("tool_sensitivity", 30, (tool_raw / 100) * 30,
+                       f"Tool {ctx.tool_name or 'unknown'} risk={tool_raw}"),
+            RiskFactor("time_of_day", 15, 15 if is_off_hours else 0,
+                       "Off-hours operation" if is_off_hours else "Business hours"),
+            RiskFactor("trust_deficit", 20, ((100 - ctx.trust.session.score) / 100) * 20,
+                       f"Trust score {ctx.trust.session.score}/100"),
+            RiskFactor("frequency", 15, min(recent / 20, 1) * 15,
+                       f"{recent} actions in last 60s"),
+            RiskFactor("target_scope", 20, 20 if external else 0,
+                       "External target" if external else "Internal target"),
+        ]
+        total = clamp(sum(f.value for f in factors), 0, 100)
+        return RiskAssessment(level=score_to_risk_level(total), score=round(total), factors=factors)

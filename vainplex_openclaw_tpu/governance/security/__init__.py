@@ -1,0 +1,19 @@
+"""External reputation providers (reference: governance/src/security/)."""
+
+from .erc8004 import (
+    ERC8004Provider,
+    decode_address,
+    decode_agent_profile,
+    decode_uint256,
+    encode_uint256,
+)
+from .agentproof import AgentProofRestClient
+
+__all__ = [
+    "AgentProofRestClient",
+    "ERC8004Provider",
+    "decode_address",
+    "decode_agent_profile",
+    "decode_uint256",
+    "encode_uint256",
+]
