@@ -1,0 +1,184 @@
+"""Cortex plugin integration through the gateway (reference:
+cortex/test/hooks.test.ts, tools tests, /cortexstatus)."""
+
+from vainplex_openclaw_tpu.core import Gateway
+from vainplex_openclaw_tpu.cortex import CortexPlugin
+
+from helpers import FakeClock, make_gateway
+
+
+def load_cortex(workspace, config=None, call_llm=None, clock=None):
+    gw, logger = make_gateway(clock=clock)
+    plugin = CortexPlugin(workspace=str(workspace), clock=gw.clock,
+                          call_llm=call_llm, wall_timers=False)
+    gw.load(plugin, plugin_config={"enabled": True, **(config or {})})
+    gw.start()
+    return gw, plugin
+
+
+CTX = {"agent_id": "main", "session_key": "agent:main"}
+
+
+def test_message_flow_feeds_all_trackers(workspace, openclaw_home):
+    gw, plugin = load_cortex(workspace)
+    gw.message_received("let's discuss the billing rework", CTX)
+    gw.message_received("we decided to split invoices because tax rules differ", CTX)
+    gw.message_sent("I'll implement the invoice splitter today", CTX)
+    trackers = plugin.trackers(CTX)
+    assert trackers.threads.open_threads()
+    assert trackers.decisions.decisions
+    assert trackers.commitments.open_commitments()
+
+
+def test_agent_end_fallback_only_when_message_sent_missing(workspace, openclaw_home):
+    gw, plugin = load_cortex(workspace)
+    # through the TYPED entry point, not a hand-built event dict
+    gw.agent_end(CTX, final_message="we decided to cache aggressively")
+    assert plugin.trackers(CTX).decisions.decisions  # fallback ingested
+    gw.message_sent("the plan is to use redis for the cache layer", CTX)
+    gw.agent_end(CTX, final_message="we agreed to delete old keys nightly")
+    # message_sent fired → agent_end fallback skipped
+    assert all("delete old keys" not in d["what"]
+               for d in plugin.trackers(CTX).decisions.decisions)
+
+
+def test_compaction_then_fresh_session_restores_context(workspace, openclaw_home):
+    clk = FakeClock()
+    gw, plugin = load_cortex(workspace, clock=clk)
+    gw.message_received("let's discuss the zero downtime deploy plan", CTX)
+    # through the TYPED entry point (messages is an event field, not ctx)
+    gw.before_compaction(CTX, messages=[
+        {"role": "user", "content": "final words before compaction"}])
+    gw.stop()
+
+    # fresh session, same workspace: boot context injected at session_start
+    gw2, plugin2 = load_cortex(workspace, clock=clk)
+    out = gw2.session_start(CTX)
+    injected = next(r["prepend_context"] for r in out if isinstance(r, dict)
+                    and r.get("prepend_context"))
+    assert "zero downtime deploy plan" in injected
+    assert "final words before compaction" in injected
+
+
+def test_session_start_regenerates_not_frozen(workspace, openclaw_home):
+    clk = FakeClock()
+    gw, plugin = load_cortex(workspace, clock=clk)
+    gw.before_compaction(CTX, messages=[])  # writes a BOOTSTRAP.md snapshot
+    # work tracked AFTER the snapshot must appear in the next session context
+    gw.message_received("let's discuss the new caching strategy", CTX)
+    gw.stop()
+    gw2, _ = load_cortex(workspace, clock=clk)
+    out = gw2.session_start(CTX)
+    injected = next(r["prepend_context"] for r in out if isinstance(r, dict)
+                    and r.get("prepend_context"))
+    assert "new caching strategy" in injected
+
+
+def test_cortexstatus_command(workspace, openclaw_home):
+    gw, _ = load_cortex(workspace)
+    gw.message_received("let's discuss the metrics dashboard", CTX)
+    text = gw.command("/cortexstatus")["text"]
+    assert "open=1" in text and "hooks fired" in text
+
+
+def test_agent_tools_readonly(workspace, openclaw_home):
+    gw, _ = load_cortex(workspace)
+    gw.message_received("let's discuss the search relevance tuning", CTX)
+    gw.message_received("search relevance tuning: we decided to boost recency", CTX)
+    threads_tool = gw.tools["cortex_threads"]["handler"]
+    out = threads_tool({"status": "open"})
+    assert out["threads"][0]["title"].startswith("search relevance")
+    search_tool = gw.tools["cortex_search"]["handler"]
+    found = search_tool({"query": "relevance"})
+    assert any(r["kind"] == "thread" for r in found["results"])
+    status = gw.tools["cortex_status"]["handler"]({})
+    assert status["threads_open"] == 1
+
+
+def test_llm_enhance_batch_merges(workspace, openclaw_home):
+    calls = []
+
+    def fake_llm(prompt):
+        calls.append(prompt)
+        return ('{"threads": [{"title": "quarterly planning ritual", "status": "open", '
+                '"summary": "llm found"}], "decisions": ["adopt OKRs next quarter"], '
+                '"closures": [], "mood": "productive"}')
+
+    gw, plugin = load_cortex(workspace, config={"llmEnhance": {"enabled": True,
+                                                               "batchSize": 2}},
+                             call_llm=fake_llm)
+    gw.message_received("first message", CTX)
+    assert calls == []  # batching
+    gw.message_received("second message", CTX)
+    assert len(calls) == 1
+    titles = [t["title"] for t in plugin.trackers(CTX).threads.threads]
+    assert "quarterly planning ritual" in titles
+    # LLM-detected decisions reach the decision tracker too
+    assert any(d["what"] == "adopt OKRs next quarter"
+               for d in plugin.trackers(CTX).decisions.decisions)
+
+
+def test_llm_batches_are_per_workspace(workspace, openclaw_home, tmp_path):
+    transcripts = []
+
+    def fake_llm(prompt):
+        transcripts.append(prompt)
+        return '{"threads": [], "decisions": [], "closures": [], "mood": "neutral"}'
+
+    gw, plugin = load_cortex(workspace, config={"llmEnhance": {"enabled": True,
+                                                               "batchSize": 2}},
+                             call_llm=fake_llm)
+    ws_b = str(tmp_path / "ws-b")
+    gw.message_received("workspace A message one", {**CTX, "workspace": str(workspace)})
+    gw.message_received("workspace B message one", {**CTX, "workspace": ws_b})
+    gw.message_received("workspace A message two", {**CTX, "workspace": str(workspace)})
+    # A's batch fired with only A's messages; B's content never leaks into it
+    assert len(transcripts) == 1
+    assert "workspace B" not in transcripts[0]
+
+
+def test_tools_resolve_workspace_per_call(workspace, openclaw_home, tmp_path):
+    gw, plugin = load_cortex(workspace)
+    ws_b = str(tmp_path / "ws-b")
+    gw.message_received("let's discuss the default workspace topic",
+                        {**CTX, "workspace": str(workspace)})
+    gw.message_received("let's discuss the second workspace topic",
+                        {**CTX, "workspace": ws_b})
+    handler = gw.tools["cortex_threads"]["handler"]
+    default_titles = [t["title"] for t in handler({})["threads"]]
+    b_titles = [t["title"] for t in handler({"workspace": ws_b})["threads"]]
+    assert any("default workspace" in t for t in default_titles)
+    assert any("second workspace" in t for t in b_titles)
+
+
+def test_overdue_transition_persisted_without_new_commitment(workspace, openclaw_home):
+    clk = FakeClock()
+    gw, plugin = load_cortex(workspace, clock=clk)
+    gw.message_sent("I'll rotate the api keys this week", CTX)
+    plugin.trackers(CTX).commitments.flush()
+    clk.advance(8 * 86400)
+    gw.message_received("how is everything going?", CTX)  # no new commitment
+    trackers = plugin.trackers(CTX)
+    trackers.commitments._debouncer.flush()
+    from vainplex_openclaw_tpu.storage.atomic import read_json
+
+    stored = read_json(workspace / "memory" / "reboot" / "commitments.json")
+    assert stored["commitments"][0]["status"] == "overdue"
+
+
+def test_llm_failure_silent_regex_fallback(workspace, openclaw_home):
+    def broken_llm(prompt):
+        raise ConnectionError("llm down")
+
+    gw, plugin = load_cortex(workspace, config={"llmEnhance": {"enabled": True,
+                                                               "batchSize": 1}},
+                             call_llm=broken_llm)
+    gw.message_received("let's discuss the error budget policy", CTX)
+    assert plugin.trackers(CTX).threads.open_threads()  # regex still worked
+
+
+def test_disabled_plugin_registers_nothing(workspace, openclaw_home):
+    gw, _ = make_gateway()
+    plugin = CortexPlugin(workspace=str(workspace))
+    gw.load(plugin, plugin_config={"enabled": False})
+    assert gw.bus.handlers_for("message_received") == []

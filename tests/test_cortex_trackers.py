@@ -1,0 +1,388 @@
+"""Cortex tracker tests (reference: cortex/test/thread-tracker.test.ts (533),
+patterns-lang-*.test.ts ×8, decision/commitment tracker tests,
+boot-context.test.ts, pre-compaction.test.ts)."""
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.cortex.boot_context import BootContextGenerator, get_execution_mode
+from vainplex_openclaw_tpu.cortex.commitment_tracker import CommitmentTracker, detect_commitments
+from vainplex_openclaw_tpu.cortex.decision_tracker import DecisionTracker
+from vainplex_openclaw_tpu.cortex.narrative import NarrativeGenerator
+from vainplex_openclaw_tpu.cortex.patterns import (
+    BUILTIN_LANGUAGES,
+    MergedPatterns,
+    resolve_language_codes,
+)
+from vainplex_openclaw_tpu.cortex.pre_compaction import PreCompaction, build_hot_snapshot
+from vainplex_openclaw_tpu.cortex.thread_tracker import (
+    ThreadTracker,
+    extract_signals,
+    matches_thread,
+)
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock
+
+HOUR = 3600.0
+DAY = 86400.0
+
+
+def en():
+    return MergedPatterns(["en"])
+
+
+def make_tracker(tmp_path, clock=None, config=None, langs=("en",)):
+    return ThreadTracker(tmp_path, config or {}, MergedPatterns(list(langs)),
+                         list_logger(), clock or FakeClock())
+
+
+# ── language packs ───────────────────────────────────────────────────
+
+
+class TestPatterns:
+    def test_all_ten_languages_present(self):
+        assert set(BUILTIN_LANGUAGES) == {"en", "de", "fr", "es", "pt", "it",
+                                          "zh", "ja", "ko", "ru"}
+
+    def test_language_selection(self):
+        assert resolve_language_codes("both") == ["en", "de"]
+        assert resolve_language_codes("all") == list(BUILTIN_LANGUAGES)
+        assert resolve_language_codes("fr") == ["fr"]
+        assert resolve_language_codes(["en", "zh", "xx"]) == ["en", "zh"]
+
+    @pytest.mark.parametrize("lang,decision,close,mood_text,mood", [
+        ("en", "we decided to use postgres", "that's done now", "this sucks", "frustrated"),
+        ("de", "wir haben beschlossen zu migrieren", "ist erledigt", "das ist mega", "excited"),
+        ("fr", "on a décidé de migrer", "c'est fait", "c'est génial", "excited"),
+        ("es", "hemos decidido migrar", "ya está hecho", "es urgente cuidado", "tense"),
+        ("pt", "foi decidido migrar", "está feito", "ficou perfeito", "excited"),
+        ("it", "abbiamo deciso di migrare", "è fatto", "attenzione urgente", "tense"),
+        ("zh", "我们决定用新方案", "搞定了", "太好了", "excited"),
+        ("ja", "移行すると決めました", "完了しました", "最高です", "excited"),
+        ("ko", "마이그레이션하기로 했습니다", "완료했습니다", "대박이네요", "excited"),
+        ("ru", "мы решили мигрировать", "уже готово", "осторожно, срочно", "tense"),
+    ])
+    def test_per_language_signals(self, lang, decision, close, mood_text, mood):
+        p = MergedPatterns([lang])
+        s = extract_signals(decision, p)
+        assert s.decisions, f"{lang} decision not detected"
+        s2 = extract_signals(close, p)
+        assert s2.closures >= 1, f"{lang} closure not detected"
+        assert p.detect_mood(mood_text) == mood
+
+    def test_universal_emoji_moods(self):
+        p = en()
+        assert p.detect_mood("🚀 launch!") == "excited"
+        assert p.detect_mood("⚠️ watch out") == "tense"
+        assert p.detect_mood("all merged ✅") == "productive"
+
+    def test_noise_topic_filter(self):
+        p = en()
+        assert p.is_noise_topic("it")
+        assert p.is_noise_topic("something else")  # noise prefix
+        assert p.is_noise_topic("ab")
+        assert not p.is_noise_topic("database migration")
+
+    def test_merged_languages_all_fire(self):
+        p = MergedPatterns(["en", "de"])
+        assert extract_signals("wir haben beschlossen", p).decisions
+        assert extract_signals("we decided to ship", p).decisions
+
+    def test_custom_patterns(self):
+        p = MergedPatterns(["en"], {"decision": [r"ship it:"]})
+        assert extract_signals("ship it: new release", p).decisions
+
+    def test_r033_performance_budget_all_languages(self):
+        import time as _t
+
+        p = MergedPatterns(list(BUILTIN_LANGUAGES))
+        msg = "we decided to migrate the database because the old one is slow " * 5
+        start = _t.perf_counter()
+        for _ in range(100):
+            extract_signals(msg, p)
+            p.detect_mood(msg)
+        per_message_ms = (_t.perf_counter() - start) * 1000 / 100
+        assert per_message_ms < 2.0, f"{per_message_ms:.2f}ms > 2ms budget (R-033)"
+
+
+# ── thread tracker ───────────────────────────────────────────────────
+
+
+class TestThreadTracker:
+    def test_topic_creates_thread(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("let's talk about database migration", "user")
+        assert len(t.threads) == 1
+        th = t.threads[0]
+        assert th["title"].startswith("database migration")
+        assert th["status"] == "open" and th["priority"] == "high"  # "migration" keyword
+
+    def test_fuzzy_match_two_word_overlap(self):
+        assert matches_thread("database migration plan", "the migration of the database")
+        assert not matches_thread("database migration", "lunch menu today")
+
+    def test_closure_closes_matching_thread(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("regarding the database migration work", "user")
+        t.process_message("the database migration is done", "user")
+        assert t.threads[0]["status"] == "closed"
+
+    def test_decisions_and_waits_attach(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("let's discuss the search indexing pipeline", "user")
+        t.process_message("for search indexing we decided to use a queue", "user")
+        assert t.threads[0]["decisions"]
+        t.process_message("search indexing is waiting for the infra team", "user")
+        assert "waiting for the infra team" in t.threads[0]["waiting_for"]
+
+    def test_noise_topics_ignored(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("let's talk about it", "user")
+        assert t.threads == []
+
+    def test_mood_updates_session_and_threads(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("let's look at the deploy pipeline work", "user")
+        t.process_message("the deploy pipeline work is awesome", "user")
+        assert t.session_mood == "excited"
+        assert t.threads[0]["mood"] == "excited"
+
+    def test_persistence_v2_with_integrity(self, tmp_path):
+        clk = FakeClock()
+        t = make_tracker(tmp_path, clock=clk)
+        t.process_message("let's discuss the cache layer design", "user")
+        data = read_json(tmp_path / "memory" / "reboot" / "threads.json")
+        assert data["version"] == 2
+        assert data["integrity"]["events_processed"] == 1
+        assert data["threads"][0]["title"]
+        # reload in a second "session"
+        t2 = make_tracker(tmp_path, clock=clk)
+        assert t2.threads[0]["title"] == t.threads[0]["title"]
+        assert t2.events_processed == 1
+
+    def test_prune_closed_and_cap_open_first(self, tmp_path):
+        clk = FakeClock()
+        t = make_tracker(tmp_path, clock=clk, config={"pruneDays": 7, "maxThreads": 3})
+        for i, topic in enumerate(("alpha system design", "beta release planning",
+                                   "gamma testing setup", "delta rollout strategy")):
+            t.process_message(f"let's discuss the {topic}", "user")
+        assert len(t.threads) <= 4
+        # close one, age it past pruneDays
+        t.threads[0]["status"] = "closed"
+        t.threads[0]["last_activity"] = "2000-01-01T00:00:00Z"
+        t.process_message("nothing new here", "user")
+        assert all(th["last_activity"] != "2000-01-01T00:00:00Z" for th in t.threads)
+
+    def test_llm_analysis_merge(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.apply_llm_analysis({
+            "threads": [{"title": "payment gateway integration", "status": "open",
+                         "summary": "from llm"}],
+            "closures": [], "mood": "productive"})
+        assert t.threads[0]["summary"] == "from llm"
+        assert t.session_mood == "productive"
+        t.apply_llm_analysis({"threads": [], "closures": ["payment gateway finished"],
+                              "mood": "neutral"})
+        assert t.threads[0]["status"] == "closed"
+
+    def test_legacy_array_format_loads(self, tmp_path):
+        from vainplex_openclaw_tpu.cortex.storage import save_json, reboot_dir
+
+        rd = reboot_dir(tmp_path)
+        rd.mkdir(parents=True)
+        save_json(rd / "threads.json",
+                  [{"id": "1", "title": "old thread", "status": "open",
+                    "priority": "medium", "decisions": [], "waiting_for": None,
+                    "mood": "neutral", "last_activity": "2026-01-01T00:00:00Z",
+                    "created": "2026-01-01T00:00:00Z"}])
+        t = make_tracker(tmp_path)
+        assert t.threads[0]["title"] == "old thread"
+
+
+# ── decision tracker ─────────────────────────────────────────────────
+
+
+class TestDecisionTracker:
+    def make(self, tmp_path, clock=None):
+        return DecisionTracker(tmp_path, {}, en(), list_logger(), clock or FakeClock())
+
+    def test_what_why_extraction(self, tmp_path):
+        d = self.make(tmp_path)
+        d.process_message("after review we decided to use postgres because the "
+                          "team knows it well", "user")
+        assert len(d.decisions) == 1
+        rec = d.decisions[0]
+        assert "decided to use postgres" in rec["what"]
+        assert rec["why"].startswith("the team knows it")
+
+    def test_impact_inference(self, tmp_path):
+        d = self.make(tmp_path)
+        d.process_message("we decided to delete the production database", "user")
+        assert d.decisions[0]["impact"] == "high"
+
+    def test_dedupe_window(self, tmp_path):
+        clk = FakeClock()
+        d = self.make(tmp_path, clock=clk)
+        d.process_message("we decided to use postgres for storage", "user")
+        d.process_message("we decided to use postgres for storage", "user")
+        assert len(d.decisions) == 1
+        clk.advance(25 * HOUR)
+        d.process_message("we decided to use postgres for storage", "user")
+        assert len(d.decisions) == 2
+
+    def test_recent_filter_and_persistence(self, tmp_path):
+        clk = FakeClock()
+        d = self.make(tmp_path, clock=clk)
+        d.process_message("we agreed to adopt type hints everywhere", "user")
+        assert len(d.recent(days=3, limit=10)) == 1
+        d2 = self.make(tmp_path, clock=clk)
+        assert len(d2.decisions) == 1
+
+
+# ── commitment tracker ───────────────────────────────────────────────
+
+
+class TestCommitmentTracker:
+    def make(self, tmp_path, clock=None):
+        return CommitmentTracker(tmp_path, {}, list_logger(),
+                                 clock or FakeClock(), wall_timers=False)
+
+    def test_detect_commitments(self):
+        found = detect_commitments("I'll deploy the fix tomorrow morning")
+        assert any("deploy the fix" in f for f in found)
+        assert detect_commitments("I think maybe we could") == []
+
+    def test_overdue_marking(self, tmp_path):
+        clk = FakeClock()
+        c = self.make(tmp_path, clock=clk)
+        c.process_message("I'll write the migration script", "agent")
+        assert c.open_commitments()[0]["status"] == "open"
+        clk.advance(8 * DAY)
+        c.mark_overdue()
+        assert c.open_commitments()[0]["status"] == "overdue"
+
+    def test_debounced_save_and_flush(self, tmp_path):
+        c = self.make(tmp_path)
+        c.process_message("I'll update the docs this week", "agent")
+        path = tmp_path / "memory" / "reboot" / "commitments.json"
+        assert not path.exists()  # debounced, not yet written
+        c.flush()
+        assert read_json(path)["commitments"][0]["what"].startswith("update the docs")
+
+    def test_resolve(self, tmp_path):
+        c = self.make(tmp_path)
+        c.process_message("I'll fix the flaky test", "agent")
+        cid = c.commitments[0]["id"]
+        assert c.resolve(cid)
+        assert c.open_commitments() == []
+
+
+# ── boot context + narrative + pre-compaction ────────────────────────
+
+
+class TestBootContext:
+    def seed(self, tmp_path, clock):
+        t = make_tracker(tmp_path, clock=clock)
+        t.process_message("let's discuss the production deploy strategy", "user")
+        t.process_message("we decided to deploy at night because traffic is low", "user")
+        d = DecisionTracker(tmp_path, {}, en(), list_logger(), clock)
+        d.process_message("we decided to deploy at night because traffic is low", "user")
+        return t, d
+
+    def test_execution_modes(self):
+        assert "Morning" in get_execution_mode(8)
+        assert "Afternoon" in get_execution_mode(14)
+        assert "Evening" in get_execution_mode(20)
+        assert "Night" in get_execution_mode(2)
+
+    def test_bootstrap_content(self, tmp_path):
+        clk = FakeClock()
+        self.seed(tmp_path, clk)
+        boot = BootContextGenerator(tmp_path, {}, list_logger(), clk)
+        text = boot.generate()
+        assert "production deploy strategy" in text
+        assert "Decisions" in text and "because traffic is low" in text
+        assert "Execution mode" in text
+        assert boot.write()
+        assert (tmp_path / "memory" / "reboot" / "BOOTSTRAP.md").exists()
+
+    def test_staleness_warnings(self, tmp_path):
+        clk = FakeClock()
+        self.seed(tmp_path, clk)
+        boot = BootContextGenerator(tmp_path, {}, list_logger(), clk)
+        assert boot.integrity_warning() == ""
+        clk.advance(3 * HOUR)
+        assert "⚠️" in boot.integrity_warning()
+        clk.advance(6 * HOUR)
+        assert "🚨 STALE" in boot.integrity_warning()
+
+    def test_no_integrity_warning_when_tracker_never_ran(self, tmp_path):
+        boot = BootContextGenerator(tmp_path, {}, list_logger(), FakeClock())
+        assert "may not have run yet" in boot.integrity_warning()
+
+    def test_char_budget(self, tmp_path):
+        clk = FakeClock()
+        t = make_tracker(tmp_path, clock=clk)
+        for i in range(30):
+            t.process_message(f"let's talk about the subsystem{i} redesign effort", "user")
+        boot = BootContextGenerator(tmp_path, {"maxChars": 500}, list_logger(), clk)
+        assert len(boot.generate()) <= 500
+
+
+class TestPreCompaction:
+    def test_full_pipeline(self, tmp_path):
+        clk = FakeClock()
+        t = make_tracker(tmp_path, clock=clk)
+        t.process_message("let's discuss the incident response runbook", "user")
+        pc = PreCompaction(tmp_path, {"preCompaction": {"maxSnapshotMessages": 2},
+                                      "narrative": {"enabled": True},
+                                      "bootContext": {"enabled": True}},
+                           list_logger(), t, clock=clk)
+        messages = [{"role": "user", "content": f"msg {i} " + "x" * 300} for i in range(5)]
+        result = pc.run(messages)
+        assert result.messages_snapshotted == 2 and result.warnings == []
+        rd = tmp_path / "memory" / "reboot"
+        snapshot = (rd / "hot-snapshot.md").read_text()
+        assert "msg 3" in snapshot and "msg 0" not in snapshot
+        assert "..." in snapshot  # 200-char truncation
+        assert (rd / "narrative.md").exists()
+        assert "incident response runbook" in (rd / "BOOTSTRAP.md").read_text()
+
+    def test_step_failure_is_warning_not_abort(self, tmp_path):
+        clk = FakeClock()
+        t = make_tracker(tmp_path, clock=clk)
+
+        class BrokenTracker:
+            def flush(self):
+                raise OSError("disk full")
+
+        pc = PreCompaction(tmp_path, {"narrative": {"enabled": True},
+                                      "bootContext": {"enabled": True},
+                                      "preCompaction": {}},
+                           list_logger(), BrokenTracker(), clock=clk)
+        result = pc.run([])
+        assert any("flush failed" in w for w in result.warnings)
+        assert (tmp_path / "memory" / "reboot" / "BOOTSTRAP.md").exists()
+
+    def test_hot_snapshot_format(self):
+        text = build_hot_snapshot([{"role": "user", "content": "hello"}], 15, FakeClock())
+        assert "# Hot Snapshot" in text and "- [user] hello" in text
+        assert "(No recent messages captured)" in build_hot_snapshot([], 15, FakeClock())
+
+
+class TestNarrative:
+    def test_narrative_prose(self, tmp_path):
+        clk = FakeClock()
+        t = make_tracker(tmp_path, clock=clk)
+        t.process_message("let's discuss the kubernetes cluster upgrade", "user")
+        t.process_message("kubernetes cluster upgrade waiting for approval from ops", "user")
+        n = NarrativeGenerator(tmp_path, list_logger(), clk)
+        text = n.generate()
+        assert "kubernetes cluster upgrade" in text
+        assert "Blocked" in text
+        assert n.write()
+
+    def test_empty_workspace(self, tmp_path):
+        n = NarrativeGenerator(tmp_path, list_logger(), FakeClock())
+        assert "Nothing tracked yet" in n.generate()

@@ -284,11 +284,14 @@ class Gateway:
         """Returns context-injection results (``{prepend_context: str}``)."""
         return self.fire("before_agent_start", {}, dict(ctx or {}))
 
-    def agent_end(self, ctx: Optional[dict] = None, error: Optional[str] = None) -> list[Any]:
-        return self.fire("agent_end", {"error": error}, dict(ctx or {}))
+    def agent_end(self, ctx: Optional[dict] = None, error: Optional[str] = None,
+                  final_message: Optional[str] = None) -> list[Any]:
+        return self.fire("agent_end", {"error": error, "final_message": final_message},
+                         dict(ctx or {}))
 
-    def before_compaction(self, ctx: Optional[dict] = None) -> list[Any]:
-        return self.fire("before_compaction", {}, dict(ctx or {}))
+    def before_compaction(self, ctx: Optional[dict] = None,
+                          messages: Optional[list] = None) -> list[Any]:
+        return self.fire("before_compaction", {"messages": messages or []}, dict(ctx or {}))
 
     # ── commands & RPC ───────────────────────────────────────────────
 

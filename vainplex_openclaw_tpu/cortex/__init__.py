@@ -1,0 +1,11 @@
+"""Conversation intelligence (reference: packages/openclaw-cortex).
+
+Trackers (threads/decisions/commitments) fed by regex signal extraction over
+10 language packs, boot-context generation for session resume, pre-compaction
+snapshotting, optional LLM enhancement, read-only agent tools, and the batch
+trace analyzer (``trace_analyzer`` subpackage).
+"""
+
+from .plugin import CortexPlugin
+
+__all__ = ["CortexPlugin"]

@@ -1,0 +1,104 @@
+"""Commitment tracker (reference: cortex/src/commitment-tracker.ts,
+commitment-patterns.ts).
+
+Detects promises ("I'll deploy it tomorrow"), marks them overdue after
+``overdueDays`` (default 7), saves ``commitments.json`` behind a 15 s
+debounce so chatty sessions don't thrash the disk.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+from pathlib import Path
+from typing import Callable
+
+from ..storage.atomic import Debouncer
+from .storage import ensure_reboot_dir, iso_now, load_json, reboot_dir, save_json
+
+COMMITMENT_PATTERNS = [
+    re.compile(r"\bI(?:'ll| will| am going to| can)\s+((?:\w+\s*){2,12})", re.IGNORECASE),
+    re.compile(r"\b(?:ich werde|ich mach(?:e)? (?:das|es)|kümmere mich um)\s+((?:\w+\s*){1,12})",
+               re.IGNORECASE),
+    re.compile(r"\blet me\s+((?:\w+\s*){2,12})", re.IGNORECASE),
+    re.compile(r"\bI(?:'ll| will)\s+get\s+(?:it|that|this)\s+((?:\w+\s*){1,8})", re.IGNORECASE),
+]
+
+_NON_COMMITTAL = re.compile(r"^(?:think|guess|suppose|probably|maybe|see|check if)\b",
+                            re.IGNORECASE)
+
+
+def detect_commitments(text: str) -> list[str]:
+    out = []
+    for rx in COMMITMENT_PATTERNS:
+        for m in rx.finditer(text):
+            what = m.group(1).strip().rstrip(".!,")
+            if what and not _NON_COMMITTAL.match(what):
+                out.append(what)
+    return out
+
+
+class CommitmentTracker:
+    def __init__(self, workspace: str | Path, config: dict, logger,
+                 clock: Callable[[], float] = time.time, wall_timers: bool = True):
+        self.config = {"enabled": True, "overdueDays": 7, "maxCommitments": 100,
+                       "debounceSeconds": 15, **(config or {})}
+        self.logger = logger
+        self.clock = clock
+        self.path = reboot_dir(workspace) / "commitments.json"
+        self.writeable = ensure_reboot_dir(workspace, logger)
+        data = load_json(self.path)
+        self.commitments: list[dict] = data.get("commitments") or []
+        self._debouncer = Debouncer(self._save_now, self.config["debounceSeconds"],
+                                    wall=wall_timers)
+
+    def process_message(self, content: str, sender: str = "agent") -> None:
+        if not content:
+            return
+        now = iso_now(self.clock)
+        found = detect_commitments(content)
+        for what in found:
+            if any(c["what"] == what and c["status"] == "open" for c in self.commitments):
+                continue
+            self.commitments.append({
+                "id": str(uuid.uuid4()), "what": what, "sender": sender,
+                "status": "open", "created": now, "resolved": None,
+            })
+        n_overdue = self.mark_overdue()
+        if found or n_overdue:
+            if len(self.commitments) > self.config["maxCommitments"]:
+                self.commitments = self.commitments[-self.config["maxCommitments"]:]
+            self._debouncer.trigger()
+
+    def mark_overdue(self) -> int:
+        cutoff = iso_now(lambda: self.clock() - self.config["overdueDays"] * 86400)
+        n = 0
+        for c in self.commitments:
+            if c["status"] == "open" and c["created"] < cutoff:
+                c["status"] = "overdue"
+                n += 1
+        return n
+
+    def resolve(self, commitment_id: str) -> bool:
+        for c in self.commitments:
+            if c["id"] == commitment_id and c["status"] in ("open", "overdue"):
+                c["status"] = "resolved"
+                c["resolved"] = iso_now(self.clock)
+                self._debouncer.trigger()
+                return True
+        return False
+
+    def open_commitments(self) -> list[dict]:
+        return [c for c in self.commitments if c["status"] in ("open", "overdue")]
+
+    def _save_now(self) -> None:
+        if not self.writeable:
+            return
+        save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
+                              "commitments": self.commitments}, self.logger)
+
+    def flush(self) -> bool:
+        self._debouncer.flush()
+        self._save_now()
+        return True

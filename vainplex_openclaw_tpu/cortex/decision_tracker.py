@@ -1,0 +1,112 @@
+"""Decision tracker (reference: cortex/src/decision-tracker.ts).
+
+Decision-pattern matches become ``{what, why}`` records: *what* is the
+50-before/100-after context window around the match, *why* is a trailing
+"because/so that/weil…" clause when present. Impact inferred from
+high-impact keywords; duplicates within ``dedupeWindowHours`` are dropped;
+persists ``decisions.json``.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+from pathlib import Path
+from typing import Callable
+
+from .patterns import MergedPatterns
+from .storage import ensure_reboot_dir, iso_now, load_json, reboot_dir, save_json
+
+_WHY_RE = re.compile(
+    r"(?:because|so that|since|weil|damit|porque|parce que|因为|なぜなら|왜냐하면)\s+(.{5,120})",
+    re.IGNORECASE)
+
+
+class DecisionTracker:
+    def __init__(self, workspace: str | Path, config: dict, patterns: MergedPatterns,
+                 logger, clock: Callable[[], float] = time.time):
+        self.config = {"enabled": True, "dedupeWindowHours": 24, "maxDecisions": 200,
+                       **(config or {})}
+        self.patterns = patterns
+        self.logger = logger
+        self.clock = clock
+        self.path = reboot_dir(workspace) / "decisions.json"
+        self.writeable = ensure_reboot_dir(workspace, logger)
+        data = load_json(self.path)
+        self.decisions: list[dict] = data.get("decisions") or []
+
+    def process_message(self, content: str, sender: str = "user") -> None:
+        if not content:
+            return
+        now = iso_now(self.clock)
+        added = False
+        for rx in self.patterns.decision:
+            for m in rx.finditer(content):
+                start = max(0, m.start() - 50)
+                end = min(len(content), m.end() + 100)
+                what = content[start:end].strip()
+                if self._is_duplicate(what):
+                    continue
+                why_match = _WHY_RE.search(content, m.end())
+                self.decisions.append({
+                    "id": str(uuid.uuid4()),
+                    "what": what,
+                    "why": why_match.group(1).strip() if why_match else None,
+                    "impact": self._infer_impact(what),
+                    "sender": sender,
+                    "date": now[:10],
+                    "timestamp": now,
+                })
+                added = True
+        if added:
+            if len(self.decisions) > self.config["maxDecisions"]:
+                self.decisions = self.decisions[-self.config["maxDecisions"]:]
+            self.persist()
+
+    def _infer_impact(self, text: str) -> str:
+        return self.patterns.infer_priority(text)  # high-impact keywords → "high"
+
+    def _is_duplicate(self, what: str) -> bool:
+        cutoff_ts = self.clock() - self.config["dedupeWindowHours"] * 3600
+        cutoff = iso_now(lambda: cutoff_ts)
+        words = {w for w in what.lower().split() if len(w) > 2}
+        for d in reversed(self.decisions):
+            if d["timestamp"] < cutoff:
+                break
+            d_words = {w for w in d["what"].lower().split() if len(w) > 2}
+            union = words | d_words
+            if union and len(words & d_words) / len(union) > 0.6:
+                return True
+        return False
+
+    def add_llm_decisions(self, decisions: list[str], sender: str = "llm") -> None:
+        """Merge LLM-detected decisions the regex pass missed."""
+        now = iso_now(self.clock)
+        added = False
+        for what in decisions:
+            what = (what or "").strip()[:200]
+            if not what or self._is_duplicate(what):
+                continue
+            self.decisions.append({
+                "id": str(uuid.uuid4()), "what": what, "why": None,
+                "impact": self._infer_impact(what), "sender": sender,
+                "date": now[:10], "timestamp": now,
+            })
+            added = True
+        if added:
+            self.persist()
+
+    def recent(self, days: int, limit: int) -> list[dict]:
+        cutoff = iso_now(lambda: self.clock() - days * 86400)[:10]
+        return [d for d in self.decisions if d["date"] >= cutoff][-limit:]
+
+    def persist(self) -> None:
+        if not self.writeable:
+            return
+        save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
+                              "decisions": self.decisions}, self.logger)
+
+    def flush(self) -> bool:
+        self.persist()
+        return True

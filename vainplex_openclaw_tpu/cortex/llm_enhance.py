@@ -1,0 +1,77 @@
+"""Optional LLM conversation-analysis enhancement
+(reference: cortex/src/llm-enhance.ts:14-120).
+
+Batches messages (default 3), sends one strict-JSON analysis prompt through
+the DI'd ``call_llm`` seam (HTTP LLM in the reference; the local TPU
+CortexEncoder serve path here), merges results into the trackers, and falls
+back silently to regex-only on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+
+SYSTEM_PROMPT = (
+    "You analyze agent-user conversations. Given the messages, respond with "
+    "ONLY strict JSON: {\"threads\": [{\"title\": str, \"status\": "
+    "\"open\"|\"closed\", \"summary\": str}], \"decisions\": [str], "
+    "\"closures\": [str], \"mood\": \"frustrated\"|\"excited\"|\"tense\"|"
+    "\"productive\"|\"exploratory\"|\"neutral\"}"
+)
+
+
+def parse_analysis(raw: str) -> Optional[dict]:
+    text = raw.strip()
+    if text.startswith("```"):
+        text = "\n".join(ln for ln in text.splitlines()
+                         if not ln.strip().startswith("```")).strip()
+    try:
+        parsed = json.loads(text)
+    except json.JSONDecodeError:
+        start, end = text.find("{"), text.rfind("}")
+        if start == -1 or end <= start:
+            return None
+        try:
+            parsed = json.loads(text[start:end + 1])
+        except json.JSONDecodeError:
+            return None
+    if not isinstance(parsed, dict):
+        return None
+    return {
+        "threads": [t for t in parsed.get("threads", []) if isinstance(t, dict) and t.get("title")],
+        "decisions": [d for d in parsed.get("decisions", []) if isinstance(d, str)],
+        "closures": [c for c in parsed.get("closures", []) if isinstance(c, str)],
+        "mood": parsed.get("mood", "neutral"),
+    }
+
+
+class LlmEnhancer:
+    def __init__(self, call_llm: Callable[[str], str], logger, batch_size: int = 3):
+        self.call_llm = call_llm
+        self.logger = logger
+        self.batch_size = batch_size
+        self._batch: list[dict] = []
+
+    def add_message(self, content: str, sender: str) -> Optional[dict]:
+        """Queue a message; returns an analysis dict when the batch fires."""
+        self._batch.append({"sender": sender, "content": content[:2000]})
+        if len(self._batch) < self.batch_size:
+            return None
+        return self.flush()
+
+    def flush(self) -> Optional[dict]:
+        if not self._batch:
+            return None
+        batch, self._batch = self._batch, []
+        transcript = "\n".join(f"[{m['sender']}] {m['content']}" for m in batch)
+        prompt = f"{SYSTEM_PROMPT}\n\nMESSAGES:\n{transcript}"
+        try:
+            raw = self.call_llm(prompt)
+        except Exception as exc:  # noqa: BLE001 — silent regex-only fallback
+            self.logger.debug(f"LLM enhance failed (regex-only fallback): {exc}")
+            return None
+        analysis = parse_analysis(raw)
+        if analysis is None:
+            self.logger.debug("LLM enhance returned unparseable output")
+        return analysis

@@ -1,0 +1,307 @@
+"""Language packs + pattern registry (RFC-004; reference:
+cortex/src/patterns/lang-*.ts ×10, registry.ts, patterns.ts).
+
+Each pack carries decision/close/wait/topic signal regexes, a topic
+blacklist, high-impact keywords, mood regexes, and noise prefixes. The
+registry merges the selected packs (``"both"`` = en+de, ``"all"`` = all 10)
+plus custom user patterns, and pre-compiles the merged sets once.
+Requirement R-033: all-language matching must stay <2 ms/message — hence the
+single merged+compiled pattern lists, no per-message compilation.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+MOODS = ("frustrated", "excited", "tense", "productive", "exploratory")
+
+
+@dataclass(frozen=True)
+class LanguagePack:
+    code: str
+    name: str
+    decision: tuple[str, ...]
+    close: tuple[str, ...]
+    wait: tuple[str, ...]
+    topic: tuple[str, ...]  # each with one capture group for the topic
+    topic_blacklist: tuple[str, ...]
+    high_impact: tuple[str, ...]
+    moods: dict = field(default_factory=dict)  # mood → pattern
+    noise_prefixes: tuple[str, ...] = ()
+    flags: int = re.IGNORECASE
+
+
+PACKS: dict[str, LanguagePack] = {}
+
+
+def _pack(**kw) -> None:
+    pack = LanguagePack(**kw)
+    PACKS[pack.code] = pack
+
+
+_pack(
+    code="en", name="English",
+    decision=(r"(?:decided|decision|agreed|let'?s do|the plan is|approach:|we(?:'ll| will) go with)",),
+    close=(r"(?:^|\s)(?:is |it'?s |that'?s |all )?(?:done|fixed|solved|closed|resolved)(?:\s|[.!]|$)",
+           r"(?:^|\s)(?:it |that )works(?:\s|[.!]|$)", r"✅"),
+    wait=(r"(?:waiting (?:for|on)|blocked (?:by|on)|need\b.*\bfirst)",),
+    topic=(r"(?:back to|now about|regarding|let'?s (?:talk about|discuss|look at))\s+(?:the\s+)?(\w[\w\s-]{3,40})",),
+    topic_blacklist=("it", "that", "this", "the", "them", "what", "which", "there",
+                     "nothing", "something", "everything", "me", "you", "him", "her",
+                     "us", "today", "tomorrow", "yesterday"),
+    high_impact=("architecture", "security", "migration", "delete", "production",
+                 "deploy", "breaking", "major", "critical", "strategy", "budget", "contract"),
+    moods={"frustrated": r"(?:fuck|shit|damn|sucks|annoying)",
+           "excited": r"(?:nice|awesome|brilliant|sick|great news)",
+           "tense": r"(?:careful|risky|urgent)",
+           "productive": r"(?:done|fixed|works|deployed|shipped)",
+           "exploratory": r"(?:what if|idea|maybe|experiment)"},
+    noise_prefixes=("i", "we", "he", "she", "it", "nothing", "something"),
+)
+
+_pack(
+    code="de", name="Deutsch",
+    decision=(r"(?:entschieden|beschlossen|machen wir|wir machen|der plan ist|ansatz:)",),
+    close=(r"(?:^|\s)(?:ist |schon )?(?:erledigt|gefixt|gelöst|fertig|behoben)(?:\s|[.!]|$)",
+           r"(?:^|\s)(?:es |das )funktioniert(?:\s|[.!]|$)"),
+    wait=(r"(?:warte(?:n)? auf|blockiert durch|brauche(?:n)?\b.*\berst)",),
+    topic=(r"(?:zurück zu|jetzt zu|bzgl\.?|wegen|lass uns (?:über|mal))\s+(?:dem?|die|das)?\s*(\w[\w\s-]{3,40})",),
+    topic_blacklist=("das", "die", "der", "es", "was", "hier", "dort", "nichts",
+                     "etwas", "alles", "mir", "dir", "ihm", "uns", "heute", "morgen",
+                     "gestern", "noch", "schon", "jetzt", "dann", "also", "aber", "oder"),
+    high_impact=("architektur", "sicherheit", "migration", "löschen", "produktion",
+                 "kritisch", "strategie", "vertrag"),
+    moods={"frustrated": r"(?:mist|nervig|genervt|schon wieder|zum kotzen)",
+           "excited": r"(?:geil|krass|boom|perfekt|mega)",
+           "tense": r"(?:vorsicht|heikel|kritisch|dringend|achtung|gefährlich)",
+           "productive": r"(?:erledigt|fertig|gebaut|läuft)",
+           "exploratory": r"(?:was wäre wenn|könnte man|idee|vielleicht)"},
+    noise_prefixes=("ich", "wir", "du", "er", "sie", "es", "nichts", "etwas"),
+)
+
+_pack(
+    code="fr", name="Français",
+    decision=(r"(?:décidé|décision|convenu|on (?:fait|va faire)|le plan est|approche\s*:)",),
+    close=(r"(?:^|\s)(?:c'?est )?(?:fait|réglé|résolu|terminé|corrigé|fini)(?:\s|[.!]|$)",
+           r"(?:^|\s)ça (?:marche|fonctionne)(?:\s|[.!]|$)"),
+    wait=(r"(?:en attente de|attends?\b.*\b(?:de|que)|bloqué par|besoin de\b.*\bd'abord)",),
+    topic=(r"(?:revenons (?:à|sur)|concernant|à propos de|parlons de)\s+(?:l[ae']\s*|les\s+)?(\w[\w\s-]{3,40})",),
+    topic_blacklist=("ça", "cela", "ceci", "le", "la", "les", "quoi", "rien",
+                     "tout", "moi", "toi", "lui", "nous", "aujourd'hui", "demain", "hier"),
+    high_impact=("architecture", "sécurité", "migration", "supprimer", "production",
+                 "déploiement", "critique", "stratégie", "budget", "contrat"),
+    moods={"frustrated": r"(?:merde|putain|chiant|galère)",
+           "excited": r"(?:génial|super|excellent|parfait)",
+           "tense": r"(?:attention|risqué|urgent|prudent)",
+           "productive": r"(?:fait|réglé|déployé|corrigé)",
+           "exploratory": r"(?:et si|idée|peut-être|essayons)"},
+    noise_prefixes=("je", "nous", "il", "elle", "on", "rien"),
+)
+
+_pack(
+    code="es", name="Español",
+    decision=(r"(?:decidido|decisión|acordado|hagamos|vamos a hacer|el plan es|enfoque\s*:)",),
+    close=(r"(?:^|\s)(?:está |ya )?(?:hecho|arreglado|resuelto|terminado|listo|solucionado)(?:\s|[.!]|$)",
+           r"(?:^|\s)(?:eso |ya )funciona(?:\s|[.!]|$)"),
+    wait=(r"(?:esperando (?:a|por)|bloqueado por|necesito\b.*\bprimero)",),
+    topic=(r"(?:volviendo a|sobre|respecto a|hablemos de)\s+(?:el\s+|la\s+|los\s+)?(\w[\w\s-]{3,40})",),
+    topic_blacklist=("eso", "esto", "el", "la", "los", "qué", "nada", "algo",
+                     "todo", "mí", "ti", "él", "nosotros", "hoy", "mañana", "ayer"),
+    high_impact=("arquitectura", "seguridad", "migración", "borrar", "producción",
+                 "desplegar", "crítico", "estrategia", "presupuesto", "contrato"),
+    moods={"frustrated": r"(?:mierda|joder|molesto|fastidio)",
+           "excited": r"(?:genial|increíble|perfecto|excelente)",
+           "tense": r"(?:cuidado|arriesgado|urgente)",
+           "productive": r"(?:hecho|arreglado|desplegado|funciona)",
+           "exploratory": r"(?:y si|idea|quizás|experimento)"},
+    noise_prefixes=("yo", "nosotros", "él", "ella", "nada", "algo"),
+)
+
+_pack(
+    code="pt", name="Português",
+    decision=(r"(?:decidido|decisão|combinado|vamos fazer|o plano é|abordagem\s*:)",),
+    close=(r"(?:^|\s)(?:está |já )?(?:feito|consertado|resolvido|concluído|pronto|fechado)(?:\s|[.!]|$)",
+           r"(?:^|\s)(?:isso |já )funciona(?:\s|[.!]|$)"),
+    wait=(r"(?:esperando (?:por|o)|aguardando|bloqueado por|preciso\b.*\bprimeiro)",),
+    topic=(r"(?:voltando (?:a|ao)|sobre|a respeito de|vamos falar de)\s+(?:o\s+|a\s+|os\s+)?(\w[\w\s-]{3,40})",),
+    topic_blacklist=("isso", "isto", "o", "a", "os", "quê", "nada", "algo",
+                     "tudo", "mim", "ti", "ele", "nós", "hoje", "amanhã", "ontem"),
+    high_impact=("arquitetura", "segurança", "migração", "apagar", "produção",
+                 "implantar", "crítico", "estratégia", "orçamento", "contrato"),
+    moods={"frustrated": r"(?:merda|droga|chato|saco)",
+           "excited": r"(?:ótimo|incrível|perfeito|excelente|massa)",
+           "tense": r"(?:cuidado|arriscado|urgente)",
+           "productive": r"(?:feito|consertado|implantado|funciona)",
+           "exploratory": r"(?:e se|ideia|talvez|experimento)"},
+    noise_prefixes=("eu", "nós", "ele", "ela", "nada", "algo"),
+)
+
+_pack(
+    code="it", name="Italiano",
+    decision=(r"(?:deciso|decisione|concordato|facciamo|il piano è|approccio\s*:)",),
+    close=(r"(?:^|\s)(?:è |già )?(?:fatto|sistemato|risolto|finito|chiuso|completato)(?:\s|[.!]|$)",
+           r"(?:^|\s)(?:questo |ora )funziona(?:\s|[.!]|$)"),
+    wait=(r"(?:in attesa di|aspetto\b|bloccato da|serve\b.*\bprima)",),
+    topic=(r"(?:tornando a|riguardo a|parliamo di|vediamo)\s+(?:il\s+|la\s+|i\s+)?(\w[\w\s-]{3,40})",),
+    topic_blacklist=("questo", "quello", "il", "la", "i", "cosa", "niente",
+                     "qualcosa", "tutto", "me", "te", "lui", "noi", "oggi", "domani", "ieri"),
+    high_impact=("architettura", "sicurezza", "migrazione", "cancellare", "produzione",
+                 "deploy", "critico", "strategia", "budget", "contratto"),
+    moods={"frustrated": r"(?:merda|cavolo|fastidioso|palle)",
+           "excited": r"(?:fantastico|ottimo|perfetto|grandioso)",
+           "tense": r"(?:attenzione|rischioso|urgente)",
+           "productive": r"(?:fatto|sistemato|deployato|funziona)",
+           "exploratory": r"(?:e se|idea|forse|esperimento)"},
+    noise_prefixes=("io", "noi", "lui", "lei", "niente", "qualcosa"),
+)
+
+_pack(
+    code="zh", name="中文", flags=0,
+    decision=(r"(?:决定|已决定|方案[是为]|我们[用采]|确定了|就这么[定办])",
+              r"(?:敲定|拍板|最终[选方]|采用|选择了)"),
+    close=(r"(?:完成|搞定|解决了|已[关修]|修好了|结束了)",
+           r"(?:好了|没问题了|可以了|OK了|行了)"),
+    wait=(r"(?:等待|被.*阻塞|需要.*才能|还差|卡在|依赖于|前提是)",),
+    topic=(r"(?:关于|回到|讨论|说[说到]|看看)\s*([一-鿿\w]{2,20})",
+           r"(?:至于|针对|聊聊)\s*([一-鿿\w]{2,20})"),
+    topic_blacklist=("这个", "那个", "什么", "哪个", "这里", "那里", "我", "你", "他",
+                     "她", "我们", "他们", "没有", "东西", "事情", "今天", "明天", "昨天"),
+    high_impact=("架构", "安全", "迁移", "删除", "生产", "部署", "关键", "策略",
+                 "预算", "合同", "重大"),
+    moods={"frustrated": r"(?:靠|妈的|烦死|崩溃|要命)",
+           "excited": r"(?:太好了|牛|厉害|完美|太棒了)",
+           "tense": r"(?:小心|危险|紧急|注意|风险)",
+           "productive": r"(?:搞定|完成|修好|部署了|上线了)",
+           "exploratory": r"(?:如果|或许|想法|试试|可以考虑)"},
+    noise_prefixes=("我", "你", "他", "她", "我们", "没有"),
+)
+
+_pack(
+    code="ja", name="日本語", flags=0,
+    decision=(r"(?:決定|決めました|決まりました|方針は|计划|プランは|にしましょう|で行きましょう)",),
+    close=(r"(?:完了|終わりました|解決しました|直しました|できました|修正済み)",),
+    wait=(r"(?:待ち|待っています|ブロックされて|が必要です|依存して)",),
+    topic=(r"(?:について|に関して|の話|を見ましょう)\s*([぀-ヿ一-鿿\w]{2,20})",
+           r"([぀-ヿ一-鿿\w]{2,20})\s*(?:について|に関して)"),
+    topic_blacklist=("これ", "それ", "あれ", "何", "私", "あなた", "彼", "彼女",
+                     "今日", "明日", "昨日", "もの", "こと"),
+    high_impact=("アーキテクチャ", "セキュリティ", "移行", "削除", "本番", "デプロイ",
+                 "重要", "戦略", "予算", "契約"),
+    moods={"frustrated": r"(?:くそ|イライラ|最悪|うざい)",
+           "excited": r"(?:素晴らしい|最高|完璧|すごい)",
+           "tense": r"(?:注意|危険|緊急|リスク)",
+           "productive": r"(?:完了|修正|デプロイ|動きました)",
+           "exploratory": r"(?:もし|アイデア|たぶん|試して)"},
+    noise_prefixes=("私", "僕", "彼", "彼女", "何も"),
+)
+
+_pack(
+    code="ko", name="한국어", flags=0,
+    decision=(r"(?:결정|정했|합의|하기로 했|계획은|방침은|으로 갑시다)",),
+    close=(r"(?:완료|끝났|해결했|고쳤|됐습니다|수정했)",),
+    wait=(r"(?:기다리|대기 중|막혀|차단|필요합니다.*먼저|의존)",),
+    topic=(r"(?:관해서?|대해서?|이야기|돌아가서|봅시다)\s*([가-힯\w]{2,20})",
+           r"([가-힯\w]{2,20})\s*(?:에 관해|에 대해)"),
+    topic_blacklist=("이것", "그것", "저것", "무엇", "나", "너", "우리", "그",
+                     "오늘", "내일", "어제", "것"),
+    high_impact=("아키텍처", "보안", "마이그레이션", "삭제", "프로덕션", "배포",
+                 "중요", "전략", "예산", "계약"),
+    moods={"frustrated": r"(?:젠장|짜증|최악|빡치)",
+           "excited": r"(?:대박|멋지|완벽|최고)",
+           "tense": r"(?:조심|위험|긴급|주의)",
+           "productive": r"(?:완료|수정|배포|됩니다)",
+           "exploratory": r"(?:만약|아이디어|아마|실험)"},
+    noise_prefixes=("나", "너", "그", "그녀", "우리", "아무것도"),
+)
+
+_pack(
+    code="ru", name="Русский",
+    decision=(r"(?:решено|решили|договорились|план таков|давай(?:те)? сделаем|подход\s*:)",),
+    close=(r"(?:^|\s)(?:уже )?(?:готово|сделано|исправлено|решено|закрыто|починил)(?:\s|[.!]|$)",
+           r"(?:^|\s)(?:это |теперь )работает(?:\s|[.!]|$)"),
+    wait=(r"(?:жд[уём]\b|ожидаем|заблокировано|нужно\b.*\bсначала|зависит от)",),
+    topic=(r"(?:вернёмся к|насчёт|по поводу|давай(?:те)? обсудим|поговорим о)\s+(\w[\w\s-]{3,40})",),
+    topic_blacklist=("это", "то", "что", "ничего", "всё", "я", "ты", "он", "она",
+                     "мы", "сегодня", "завтра", "вчера"),
+    high_impact=("архитектура", "безопасность", "миграция", "удалить", "продакшн",
+                 "деплой", "критично", "стратегия", "бюджет", "контракт"),
+    moods={"frustrated": r"(?:блин|чёрт|бесит|достало)",
+           "excited": r"(?:отлично|круто|супер|идеально)",
+           "tense": r"(?:осторожно|рискованно|срочно)",
+           "productive": r"(?:готово|сделано|задеплоил|работает)",
+           "exploratory": r"(?:а что если|идея|может быть|эксперимент)"},
+    noise_prefixes=("я", "мы", "он", "она", "ничего", "что-то"),
+)
+
+BUILTIN_LANGUAGES = tuple(PACKS)
+
+# Universal emoji moods, language-independent (reference registry.ts BASE_MOOD)
+BASE_MOODS = {
+    "frustrated": r"(?:wtf|argh)",
+    "excited": r"(?:🎯|🚀)",
+    "tense": r"(?:⚠️|‼️)",
+    "productive": r"(?:✅)",
+    "exploratory": r"(?:🤔|💡)",
+}
+
+
+def resolve_language_codes(selection) -> list[str]:
+    """``"both"`` = en+de (historical default), ``"all"`` = all 10."""
+    if selection in (None, "both"):
+        return ["en", "de"]
+    if selection == "all":
+        return list(BUILTIN_LANGUAGES)
+    if isinstance(selection, str):
+        return [selection]
+    return [c for c in selection if c in PACKS]
+
+
+class MergedPatterns:
+    """Pre-compiled merged view over the selected packs + custom patterns."""
+
+    def __init__(self, codes: list[str], custom: Optional[dict] = None):
+        self.codes = [c for c in codes if c in PACKS]
+        packs = [PACKS[c] for c in self.codes]
+        custom = custom or {}
+
+        def compile_all(attr: str) -> list[re.Pattern]:
+            out = []
+            for pack in packs:
+                out += [re.compile(p, pack.flags) for p in getattr(pack, attr)]
+            out += [re.compile(p, re.IGNORECASE) for p in custom.get(attr, [])]
+            return out
+
+        self.decision = compile_all("decision")
+        self.close = compile_all("close")
+        self.wait = compile_all("wait")
+        self.topic = compile_all("topic")
+        self.topic_blacklist = {w.lower() for pack in packs for w in pack.topic_blacklist}
+        self.high_impact = [w.lower() for pack in packs for w in pack.high_impact]
+        self.noise_prefixes = {w.lower() for pack in packs for w in pack.noise_prefixes}
+        self.moods: dict[str, list[re.Pattern]] = {m: [] for m in MOODS}
+        for mood, base in BASE_MOODS.items():
+            self.moods[mood].append(re.compile(base, re.IGNORECASE))
+        for pack in packs:
+            for mood, pattern in pack.moods.items():
+                self.moods[mood].append(re.compile(pattern, pack.flags))
+
+    def detect_mood(self, text: str) -> str:
+        for mood in MOODS:
+            if any(rx.search(text) for rx in self.moods[mood]):
+                return mood
+        return "neutral"
+
+    def is_noise_topic(self, topic: str) -> bool:
+        t = topic.strip().lower()
+        if len(t) < 3:
+            return True
+        if t in self.topic_blacklist:
+            return True
+        first = t.split()[0] if t.split() else t
+        return first in self.noise_prefixes
+
+    def infer_priority(self, text: str) -> str:
+        lower = text.lower()
+        return "high" if any(kw in lower for kw in self.high_impact) else "medium"

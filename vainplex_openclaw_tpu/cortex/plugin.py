@@ -1,0 +1,225 @@
+"""Cortex plugin: hook wiring + /cortexstatus + agent tools
+(reference: cortex/index.ts:11-90, src/hooks.ts:57-258).
+
+Hook layout: message_received/message_sent @100 feed the trackers;
+agent_end @150 is a fallback ingest that only fires if message_sent never
+did for the session; session_start @10 injects boot context;
+before_compaction @5 runs the snapshot pipeline. Tracker instances are held
+per-workspace in a map (multi-workspace gateways). Per-hook fire/error
+diagnostics come from the kernel's HookBus stats.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..config.loader import load_plugin_config
+from ..core.api import PluginCommand
+from .boot_context import BootContextGenerator
+from .commitment_tracker import CommitmentTracker
+from .decision_tracker import DecisionTracker
+from .llm_enhance import LlmEnhancer
+from .patterns import MergedPatterns, resolve_language_codes
+from .pre_compaction import PreCompaction
+from .thread_tracker import ThreadTracker
+from .tools import register_cortex_tools
+
+DEFAULTS = {
+    "enabled": True,
+    "workspace": None,
+    "languages": "both",  # "both"=en+de, "all"=10, or explicit list
+    "customPatterns": {},
+    "threads": {"enabled": True, "pruneDays": 7, "maxThreads": 50},
+    "decisions": {"enabled": True, "dedupeWindowHours": 24},
+    "commitments": {"enabled": True, "overdueDays": 7},
+    "bootContext": {"enabled": True, "maxChars": 16_000, "maxThreads": 10,
+                    "decisionDays": 3, "maxDecisions": 10},
+    "preCompaction": {"maxSnapshotMessages": 15},
+    "narrative": {"enabled": True},
+    "llmEnhance": {"enabled": False, "batchSize": 3},
+    "registerTools": True,
+}
+
+
+class _WorkspaceTrackers:
+    def __init__(self, workspace: str, config: dict, patterns: MergedPatterns,
+                 logger, clock, wall_timers: bool, call_llm=None):
+        self.workspace = workspace
+        self.threads = ThreadTracker(workspace, config["threads"], patterns, logger, clock)
+        self.decisions = DecisionTracker(workspace, config["decisions"], patterns, logger, clock)
+        self.commitments = CommitmentTracker(workspace, config["commitments"], logger,
+                                             clock, wall_timers=wall_timers)
+        self.pre_compaction = PreCompaction(workspace, config, logger, self.threads,
+                                            self.decisions, self.commitments, clock)
+        self.message_sent_fired = False
+        # One enhancer per workspace: batches must not mix content across
+        # workspaces (cross-workspace leak + misattributed analysis otherwise).
+        self.enhancer = None
+        if config.get("llmEnhance", {}).get("enabled") and call_llm is not None:
+            self.enhancer = LlmEnhancer(call_llm, logger,
+                                        config["llmEnhance"].get("batchSize", 3))
+
+
+class CortexPlugin:
+    id = "cortex"
+
+    def __init__(self, workspace: Optional[str] = None,
+                 clock: Callable[[], float] = time.time,
+                 call_llm=None, wall_timers: bool = True):
+        self._workspace_override = workspace
+        self.clock = clock
+        self.call_llm = call_llm
+        self.wall_timers = wall_timers
+        self.config: dict = {}
+        self.patterns: Optional[MergedPatterns] = None
+        self._trackers: dict[str, _WorkspaceTrackers] = {}
+        self._api = None
+
+    def register(self, api) -> None:
+        self.config = load_plugin_config(self.id, api.plugin_config,
+                                         defaults=DEFAULTS, logger=api.logger)
+        if not self.config.get("enabled", True):
+            api.logger.info("disabled via config")
+            return
+        self._api = api
+        self.logger = api.logger
+        codes = resolve_language_codes(self.config.get("languages"))
+        self.patterns = MergedPatterns(codes, self.config.get("customPatterns"))
+        api.logger.info(f"patterns loaded: {','.join(codes)}")
+
+        api.on("message_received", self._make_ingest("user"), priority=100)
+        api.on("message_sent", self._on_message_sent, priority=100)
+        api.on("agent_end", self._on_agent_end, priority=150)
+        api.on("session_start", self._on_session_start, priority=10)
+        api.on("before_compaction", self._on_before_compaction, priority=5)
+        api.on("gateway_stop", self._on_gateway_stop, priority=900)
+
+        api.register_command(PluginCommand(
+            name="cortexstatus", description="Cortex tracker status",
+            handler=lambda ctx: {"text": self.status_text()}))
+
+        if self.config.get("registerTools", True) and hasattr(api, "register_tool"):
+            register_cortex_tools(api, self._workspace_for)
+
+    # ── workspace/tracker resolution ─────────────────────────────────
+
+    def _workspace_for(self, ctx: dict) -> str:
+        return str(ctx.get("workspace") or self._workspace_override
+                   or self.config.get("workspace") or ".")
+
+    def trackers(self, ctx: dict) -> _WorkspaceTrackers:
+        ws = self._workspace_for(ctx)
+        if ws not in self._trackers:
+            self._trackers[ws] = _WorkspaceTrackers(ws, self.config, self.patterns,
+                                                    self.logger, self.clock,
+                                                    self.wall_timers, self.call_llm)
+        return self._trackers[ws]
+
+    # ── hook handlers (every one fail-open) ──────────────────────────
+
+    def _process(self, trackers: _WorkspaceTrackers, content: str, sender: str) -> None:
+        if self.config["threads"].get("enabled", True):
+            trackers.threads.process_message(content, sender)
+        if self.config["decisions"].get("enabled", True):
+            trackers.decisions.process_message(content, sender)
+        if self.config["commitments"].get("enabled", True):
+            trackers.commitments.process_message(content, sender)
+        if trackers.enhancer is not None:
+            analysis = trackers.enhancer.add_message(content, sender)
+            if analysis:
+                trackers.threads.apply_llm_analysis(analysis)
+                if analysis.get("decisions"):
+                    trackers.decisions.add_llm_decisions(analysis["decisions"])
+
+    def _make_ingest(self, sender: str):
+        def handler(event: dict, ctx: dict):
+            try:
+                self._process(self.trackers(ctx), event.get("content") or "", sender)
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(f"ingest failed: {exc}")
+            return None
+
+        return handler
+
+    def _on_message_sent(self, event: dict, ctx: dict):
+        try:
+            trackers = self.trackers(ctx)
+            trackers.message_sent_fired = True
+            self._process(trackers, event.get("content") or "", "agent")
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"message_sent failed: {exc}")
+        return None
+
+    def _on_agent_end(self, event: dict, ctx: dict):
+        """Fallback ingest: only when message_sent never fired (reference
+        hooks.ts:167-213 — some channels skip message_sent)."""
+        try:
+            trackers = self.trackers(ctx)
+            if trackers.message_sent_fired:
+                return None
+            content = event.get("final_message") or event.get("content") or ""
+            if content:
+                self._process(trackers, content, "agent")
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"agent_end failed: {exc}")
+        return None
+
+    def _on_session_start(self, event: dict, ctx: dict):
+        try:
+            if not self.config.get("bootContext", {}).get("enabled", True):
+                return None
+            ws = self._workspace_for(ctx)
+            boot = BootContextGenerator(ws, self.config.get("bootContext", {}),
+                                        self.logger, self.clock)
+            # Regenerate fresh every session start (reference hooks.ts:170-181)
+            # — a stale pre-compaction BOOTSTRAP.md must not freeze context,
+            # and staleness warnings only surface through regeneration.
+            context = boot.generate()
+            boot.write()
+            return {"prepend_context": context} if context else None
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"session_start failed: {exc}")
+            return None
+
+    def _on_before_compaction(self, event: dict, ctx: dict):
+        try:
+            trackers = self.trackers(ctx)
+            result = trackers.pre_compaction.run(event.get("messages"))
+            return {"snapshotted": result.messages_snapshotted,
+                    "warnings": result.warnings}
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"before_compaction failed: {exc}")
+            return None
+
+    def _on_gateway_stop(self, event: dict, ctx: dict):
+        for trackers in self._trackers.values():
+            try:
+                trackers.threads.flush()
+                trackers.decisions.flush()
+                trackers.commitments.flush()
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(f"flush failed: {exc}")
+        return None
+
+    # ── status ───────────────────────────────────────────────────────
+
+    def status_text(self) -> str:
+        lines = ["🧠 cortex:"]
+        if not self._trackers:
+            lines.append("  (no workspaces active yet)")
+        for ws, trackers in self._trackers.items():
+            c = trackers.threads.counts()
+            lines.append(f"  {ws}: open={c['open']} closed={c['closed']} "
+                         f"mood={c['mood']} events={c['events']} "
+                         f"decisions={len(trackers.decisions.decisions)} "
+                         f"commitments={len(trackers.commitments.open_commitments())}")
+        if self._api is not None:
+            stats = self._api._gateway.bus.stats
+            fired = {h: s.fired for h, s in stats.items() if s.fired}
+            errors = {h: s.errors for h, s in stats.items() if s.errors}
+            lines.append(f"  hooks fired: {fired}")
+            if errors:
+                lines.append(f"  hook errors: {errors}")
+        return "\n".join(lines)

@@ -1,0 +1,65 @@
+"""Cortex storage conventions (reference: cortex/src/storage.ts:10-45).
+
+State under ``<workspace>/memory/reboot/``; atomic writes; read-only
+workspaces flip components to in-memory mode instead of crashing.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from ..storage.atomic import read_json, write_json_atomic
+from ..storage.workspace import is_file_older_than, is_writable, reboot_dir
+
+__all__ = ["ensure_reboot_dir", "is_file_older_than", "load_json", "load_text",
+           "reboot_dir", "save_json", "save_text"]
+
+
+def ensure_reboot_dir(workspace: str | Path, logger=None) -> bool:
+    ok = is_writable(reboot_dir(workspace))
+    if not ok and logger is not None:
+        logger.warn("Workspace not writable — running in-memory only")
+    return ok
+
+
+def load_json(path: str | Path, default: Any = None) -> Any:
+    return read_json(path, default if default is not None else {})
+
+
+def save_json(path: str | Path, obj: Any, logger=None) -> bool:
+    try:
+        write_json_atomic(path, obj)
+        return True
+    except OSError as exc:
+        if logger is not None:
+            logger.warn(f"save failed for {path}: {exc}")
+        return False
+
+
+def load_text(path: str | Path) -> str:
+    try:
+        return Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return ""
+
+
+def save_text(path: str | Path, text: str, logger=None) -> bool:
+    try:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(text, encoding="utf-8")
+        tmp.replace(path)
+        return True
+    except OSError as exc:
+        if logger is not None:
+            logger.warn(f"save failed for {path}: {exc}")
+        return False
+
+
+def iso_now(clock=time.time) -> str:
+    t = time.gmtime(clock() if callable(clock) else clock)
+    return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+            f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")

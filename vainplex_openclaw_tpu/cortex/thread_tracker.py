@@ -1,0 +1,223 @@
+"""Thread tracker (reference: cortex/src/thread-tracker.ts).
+
+Regex signal extraction (decision/close/wait/topic) → create/close/annotate
+threads; fuzzy match = ≥2 significant-word title overlap; noise-topic filter;
+mood detection; priority from high-impact keywords; prune closed threads
+older than ``pruneDays`` and cap at ``maxThreads`` (open threads survive
+first); persists ``threads.json`` v2 with an integrity block
+``{last_event_timestamp, events_processed}`` consumed by boot-context
+staleness warnings.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Optional
+
+from .patterns import MergedPatterns
+from .storage import ensure_reboot_dir, iso_now, load_json, reboot_dir, save_json
+
+
+@dataclass
+class ThreadSignals:
+    decisions: list[str] = field(default_factory=list)
+    closures: int = 0
+    waits: list[str] = field(default_factory=list)
+    topics: list[str] = field(default_factory=list)
+
+
+def extract_signals(text: str, patterns: MergedPatterns) -> ThreadSignals:
+    """Context windows: decisions capture 50 chars before / 100 after the
+    match; waits capture 80 chars forward (reference extractSignals)."""
+    signals = ThreadSignals()
+    for rx in patterns.decision:
+        for m in rx.finditer(text):
+            start = max(0, m.start() - 50)
+            end = min(len(text), m.end() + 100)
+            signals.decisions.append(text[start:end].strip())
+    for rx in patterns.close:
+        if rx.search(text):
+            signals.closures += 1
+    for rx in patterns.wait:
+        for m in rx.finditer(text):
+            end = min(len(text), m.end() + 80)
+            signals.waits.append(text[m.start():end].strip())
+    for rx in patterns.topic:
+        for m in rx.finditer(text):
+            if m.groups() and m.group(1):
+                signals.topics.append(m.group(1).strip())
+    return signals
+
+
+def matches_thread(title: str, text: str, min_overlap: int = 2) -> bool:
+    """≥ min_overlap shared words (len>2) between thread title and text."""
+    title_words = {w for w in title.lower().split() if len(w) > 2}
+    text_words = {w for w in text.lower().split() if len(w) > 2}
+    return len(title_words & text_words) >= min_overlap
+
+
+class ThreadTracker:
+    def __init__(self, workspace: str | Path, config: dict, patterns: MergedPatterns,
+                 logger, clock: Callable[[], float] = time.time):
+        self.config = {"enabled": True, "pruneDays": 7, "maxThreads": 50, **(config or {})}
+        self.patterns = patterns
+        self.logger = logger
+        self.clock = clock
+        self.path = reboot_dir(workspace) / "threads.json"
+        self.writeable = ensure_reboot_dir(workspace, logger)
+        data = load_json(self.path)
+        if isinstance(data, list):  # legacy format: bare array
+            data = {"threads": data}
+        self.threads: list[dict] = data.get("threads") or []
+        self.session_mood: str = data.get("session_mood", "neutral")
+        self.events_processed: int = (data.get("integrity") or {}).get("events_processed", 0)
+        self.last_event_timestamp: str = ""
+        self.dirty = False
+
+    # ── processing ───────────────────────────────────────────────────
+
+    def process_message(self, content: str, sender: str = "user") -> None:
+        if not content:
+            return
+        signals = extract_signals(content, self.patterns)
+        mood = self.patterns.detect_mood(content)
+        now = iso_now(self.clock)
+        self.events_processed += 1
+        self.last_event_timestamp = now
+        if mood != "neutral":
+            self.session_mood = mood
+
+        self._create_from_topics(signals.topics, sender, mood, now)
+        if signals.closures:
+            self._close_matching(content, now)
+        self._apply_decisions(signals.decisions, now)
+        self._apply_waits(signals.waits, content, now)
+        self._apply_mood(mood, content)
+
+        self.dirty = True
+        self._prune_and_cap()
+        self.persist()
+
+    def _exists(self, title: str) -> bool:
+        return any(t["title"].lower() == title.lower() or matches_thread(t["title"], title)
+                   for t in self.threads)
+
+    def _create_from_topics(self, topics: list[str], sender: str, mood: str, now: str) -> None:
+        for topic in topics:
+            if self.patterns.is_noise_topic(topic) or self._exists(topic):
+                continue
+            self.threads.append({
+                "id": str(uuid.uuid4()), "title": topic, "status": "open",
+                "priority": self.patterns.infer_priority(topic),
+                "summary": f"Topic detected from {sender}", "decisions": [],
+                "waiting_for": None, "mood": mood, "last_activity": now, "created": now,
+            })
+
+    def _close_matching(self, content: str, now: str) -> None:
+        for t in self.threads:
+            if t["status"] == "open" and matches_thread(t["title"], content):
+                t["status"] = "closed"
+                t["last_activity"] = now
+
+    def _apply_decisions(self, decisions: list[str], now: str) -> None:
+        for ctx in decisions:
+            for t in self.threads:
+                if t["status"] == "open" and matches_thread(t["title"], ctx):
+                    short = ctx[:100]
+                    if short not in t["decisions"]:
+                        t["decisions"].append(short)
+                        t["last_activity"] = now
+
+    def _apply_waits(self, waits: list[str], content: str, now: str) -> None:
+        for wait_ctx in waits:
+            for t in self.threads:
+                if t["status"] == "open" and matches_thread(t["title"], content):
+                    t["waiting_for"] = wait_ctx[:100]
+                    t["last_activity"] = now
+
+    def _apply_mood(self, mood: str, content: str) -> None:
+        if mood == "neutral":
+            return
+        for t in self.threads:
+            if t["status"] == "open" and matches_thread(t["title"], content):
+                t["mood"] = mood
+
+    def apply_llm_analysis(self, analysis: dict) -> None:
+        """Merge an LLM conversation-analysis result (threads/closures/mood)."""
+        now = iso_now(self.clock)
+        for lt in analysis.get("threads", []):
+            title = lt.get("title", "")
+            if not title or self.patterns.is_noise_topic(title) or self._exists(title):
+                continue
+            self.threads.append({
+                "id": str(uuid.uuid4()), "title": title,
+                "status": lt.get("status", "open"),
+                "priority": self.patterns.infer_priority(title),
+                "summary": lt.get("summary") or "LLM-detected", "decisions": [],
+                "waiting_for": None, "mood": analysis.get("mood", "neutral"),
+                "last_activity": now, "created": now,
+            })
+        for closure in analysis.get("closures", []):
+            for t in self.threads:
+                if t["status"] == "open" and matches_thread(t["title"], closure):
+                    t["status"] = "closed"
+                    t["last_activity"] = now
+        mood = analysis.get("mood")
+        if mood and mood != "neutral":
+            self.session_mood = mood
+        self.dirty = True
+        self.persist()
+
+    # ── retention & persistence ──────────────────────────────────────
+
+    def _prune_and_cap(self) -> None:
+        cutoff_ts = self.clock() - self.config["pruneDays"] * 86400
+        cutoff = iso_now(lambda: cutoff_ts)
+        self.threads = [t for t in self.threads
+                        if not (t["status"] == "closed" and t["last_activity"] < cutoff)]
+        if len(self.threads) > self.config["maxThreads"]:
+            open_threads = [t for t in self.threads if t["status"] == "open"]
+            closed = sorted((t for t in self.threads if t["status"] == "closed"),
+                            key=lambda t: t["last_activity"])
+            budget = max(0, self.config["maxThreads"] - len(open_threads))
+            self.threads = open_threads + closed[len(closed) - budget:]
+
+    def _build_data(self) -> dict:
+        return {
+            "version": 2,
+            "updated": iso_now(self.clock),
+            "threads": self.threads,
+            "integrity": {
+                "last_event_timestamp": self.last_event_timestamp or iso_now(self.clock),
+                "events_processed": self.events_processed,
+                "source": "hooks",
+            },
+            "session_mood": self.session_mood,
+        }
+
+    def persist(self) -> None:
+        if not self.writeable:
+            return
+        if not save_json(self.path, self._build_data(), self.logger):
+            self.writeable = False
+            self.logger.warn("Workspace not writable — running in-memory only")
+        else:
+            self.dirty = False
+
+    def flush(self) -> bool:
+        if not self.dirty:
+            return True
+        return save_json(self.path, self._build_data(), self.logger)
+
+    # ── queries ──────────────────────────────────────────────────────
+
+    def open_threads(self) -> list[dict]:
+        return [t for t in self.threads if t["status"] == "open"]
+
+    def counts(self) -> dict:
+        open_n = len(self.open_threads())
+        return {"open": open_n, "closed": len(self.threads) - open_n,
+                "mood": self.session_mood, "events": self.events_processed}
