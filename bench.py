@@ -1,47 +1,99 @@
 """Headline benchmark. Prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Current headline: event-store publish throughput through the full hook →
-envelope → transport path, vs the reference's published NATS sequential
-publish rate (~3,800 msg/s, nats-eventstore/README.md:256-263 /
-BASELINE.md). Once the trace analyzer lands this switches to its
-events/min pipeline metric (reference requirement ≥10k events/min).
+Headline: trace-analyzer end-to-end throughput (fetch → normalize → chains →
+7 signal detectors) in events/min, vs the reference's requirement R-037 of
+≥10,000 events/min on one core (cortex RFC-005, BASELINE.md). The synthetic
+history mixes realistic chains: corrections, doom loops, tool failures,
+hallucinated completions, multi-agent sessions.
+
+Secondary metrics (printed to stderr for humans; the driver parses only the
+stdout line): event-store publish throughput vs the reference's NATS
+sequential baseline.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 
-def bench_event_publish(n: int = 50_000) -> dict:
+def synth_events(n_chains: int = 400) -> list[dict]:
+    sys.path.insert(0, "tests")
+    from trace_helpers import EventFactory
+
+    raws: list[dict] = []
+    for c in range(n_chains):
+        f = EventFactory(agent=f"agent{c % 4}", session=f"s{c}")
+        raws.append(f.msg_in(f"please fix the deployment issue number {c}"))
+        raws.append(f.msg_out("looking into it now"))
+        for _ in range(3):
+            raws += f.failing_call("exec", {"command": f"kubectl rollout status app{c % 7}"},
+                                   "error: deployment exceeded progress deadline")
+        raws.append(f.msg_out("I've successfully restarted the deployment."))
+        raws.append(f.msg_in("no, that's wrong — it is still failing and this is useless"))
+        raws.append(f.msg_out("my apologies, let me fix that properly"))
+        raws += [f.tool_call("read", {"path": f"/var/log/app{c}.log"}),
+                 f.tool_result("read")]
+        raws.append(f.msg_out("the root cause is a bad liveness probe"))
+    return raws
+
+
+def bench_trace_analyzer() -> dict:
+    import tempfile
+
+    from vainplex_openclaw_tpu.core.api import list_logger
+    from vainplex_openclaw_tpu.cortex.trace_analyzer import MemoryTraceSource, TraceAnalyzer
+
+    raws = synth_events()
+    with tempfile.TemporaryDirectory() as tmp:
+        # warmup (regex compilation, imports)
+        TraceAnalyzer({"languages": ["en", "de"]}, tmp, list_logger(),
+                      source=MemoryTraceSource(raws[:200])).run()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        analyzer = TraceAnalyzer({"languages": ["en", "de"]}, tmp, list_logger(),
+                                 source=MemoryTraceSource(raws))
+        t0 = time.perf_counter()
+        report = analyzer.run()
+        dt = time.perf_counter() - t0
+
+    stats = report["runStats"]
+    assert stats["events"] == len(raws), "pipeline must process every event"
+    assert stats["signals"] > 0, "pipeline must find the planted signals"
+    events_per_minute = stats["events"] / (dt / 60.0)
+    baseline = 10_000.0  # events/min, requirement R-037
+    return {
+        "metric": "trace_analyzer_throughput",
+        "value": round(events_per_minute, 0),
+        "unit": "events/min",
+        "vs_baseline": round(events_per_minute / baseline, 1),
+    }
+
+
+def bench_event_publish(n: int = 20_000) -> dict:
     from vainplex_openclaw_tpu.core import Gateway
     from vainplex_openclaw_tpu.events import EventStorePlugin, MemoryTransport
 
     gw = Gateway()
     plugin = EventStorePlugin(transport=MemoryTransport(max_msgs=n + 1))
     gw.load(plugin, plugin_config={"enabled": True, "transport": "memory"})
-    ctx = {"agent_id": "main", "session_key": "main", "run_id": "warm"}
-    gw.message_received("warmup", ctx)
-
-    handler_regs = gw.bus.handlers_for("message_received")
-    assert handler_regs, "event store must be wired"
+    gw.message_received("warmup", {"agent_id": "main", "session_key": "main"})
     t0 = time.perf_counter()
     for i in range(n):
-        gw.message_received(f"message {i} with some payload text", {
-            "agent_id": "main", "session_key": "main", "message_id": f"m{i}",
-        })
+        gw.message_received(f"message {i} with some payload text",
+                            {"agent_id": "main", "session_key": "main",
+                             "message_id": f"m{i}"})
     dt = time.perf_counter() - t0
-    assert plugin.transport.stats.published >= n
+    # Guard against measuring a no-op: hooks must actually have published.
+    assert plugin.transport.stats.published >= n, "event store not wired/publishing"
     rate = n / dt
-    baseline = 3800.0  # NATS sequential publish msg/s (BASELINE.md)
-    return {
-        "metric": "event_store_publish_throughput",
-        "value": round(rate, 1),
-        "unit": "msg/s",
-        "vs_baseline": round(rate / baseline, 2),
-    }
+    return {"metric": "event_store_publish_throughput", "value": round(rate, 1),
+            "unit": "msg/s", "vs_baseline": round(rate / 3800.0, 2)}
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench_event_publish()))
+    secondary = bench_event_publish()
+    print(f"secondary: {json.dumps(secondary)}", file=sys.stderr)
+    print(json.dumps(bench_trace_analyzer()))

@@ -1,0 +1,53 @@
+"""Trace-analyzer test factories (reference:
+cortex/test/trace-analyzer/helpers.ts:23-145 — makeEvent/makeChain with
+deterministic ts/seq counters, MockTraceSource with failOnConnect)."""
+
+from __future__ import annotations
+
+BASE_TS = 1_700_000_000_000.0
+
+
+class EventFactory:
+    """Builds raw Schema-A event dicts with monotonically advancing ts/seq."""
+
+    def __init__(self, agent="main", session="s1", start_ts=BASE_TS, step_ms=1000.0):
+        self.agent = agent
+        self.session = session
+        self.ts = start_ts
+        self.step = step_ms
+        self.seq = 0
+
+    def _next(self, etype, payload, **overrides):
+        self.seq += 1
+        self.ts += self.step
+        return {"id": f"e{self.seq}", "ts": overrides.get("ts", self.ts),
+                "agent": overrides.get("agent", self.agent),
+                "session": overrides.get("session", self.session),
+                "type": etype, "payload": payload, "seq": self.seq}
+
+    def msg_in(self, content, **kw):
+        return self._next("msg.in", {"content": content}, **kw)
+
+    def msg_out(self, content, **kw):
+        return self._next("msg.out", {"content": content}, **kw)
+
+    def tool_call(self, tool, params=None, **kw):
+        return self._next("tool.call", {"tool_name": tool, "params": params or {}}, **kw)
+
+    def tool_result(self, tool, error=None, result="ok", **kw):
+        return self._next("tool.result",
+                          {"tool_name": tool, "error": error,
+                           "result": None if error else result}, **kw)
+
+    def failing_call(self, tool, params, error):
+        return [self.tool_call(tool, params), self.tool_result(tool, error=error)]
+
+    def session_start(self, **kw):
+        return self._next("session.start", {}, **kw)
+
+    def session_end(self, **kw):
+        return self._next("session.end", {}, **kw)
+
+    def gap(self, minutes: float):
+        self.ts += minutes * 60_000
+        return self

@@ -59,7 +59,15 @@ class CommitmentTracker:
         now = iso_now(self.clock)
         found = detect_commitments(content)
         for what in found:
-            if any(c["what"] == what and c["status"] == "open" for c in self.commitments):
+            # restating an open OR overdue promise is not a new commitment —
+            # it reopens the overdue one instead of duplicating it
+            existing = next((c for c in self.commitments
+                             if c["what"] == what and c["status"] in ("open", "overdue")),
+                            None)
+            if existing is not None:
+                if existing["status"] == "overdue":
+                    existing["status"] = "open"
+                    existing["created"] = now
                 continue
             self.commitments.append({
                 "id": str(uuid.uuid4()), "what": what, "sender": sender,

@@ -9,8 +9,9 @@ back silently to regex-only on any failure.
 
 from __future__ import annotations
 
-import json
 from typing import Callable, Optional
+
+from ..utils.llm_json import parse_llm_json
 
 SYSTEM_PROMPT = (
     "You analyze agent-user conversations. Given the messages, respond with "
@@ -22,21 +23,8 @@ SYSTEM_PROMPT = (
 
 
 def parse_analysis(raw: str) -> Optional[dict]:
-    text = raw.strip()
-    if text.startswith("```"):
-        text = "\n".join(ln for ln in text.splitlines()
-                         if not ln.strip().startswith("```")).strip()
-    try:
-        parsed = json.loads(text)
-    except json.JSONDecodeError:
-        start, end = text.find("{"), text.rfind("}")
-        if start == -1 or end <= start:
-            return None
-        try:
-            parsed = json.loads(text[start:end + 1])
-        except json.JSONDecodeError:
-            return None
-    if not isinstance(parsed, dict):
+    parsed = parse_llm_json(raw)
+    if parsed is None:
         return None
     return {
         "threads": [t for t in parsed.get("threads", []) if isinstance(t, dict) and t.get("title")],

@@ -40,6 +40,7 @@ DEFAULTS = {
     "narrative": {"enabled": True},
     "llmEnhance": {"enabled": False, "batchSize": 3},
     "registerTools": True,
+    "traceAnalyzer": {"enabled": False},
 }
 
 
@@ -67,11 +68,13 @@ class CortexPlugin:
 
     def __init__(self, workspace: Optional[str] = None,
                  clock: Callable[[], float] = time.time,
-                 call_llm=None, wall_timers: bool = True):
+                 call_llm=None, wall_timers: bool = True, trace_source=None):
         self._workspace_override = workspace
         self.clock = clock
         self.call_llm = call_llm
         self.wall_timers = wall_timers
+        self.trace_source = trace_source  # DI'd TraceSource (event-store bridge)
+        self.trace_analyzer = None
         self.config: dict = {}
         self.patterns: Optional[MergedPatterns] = None
         self._trackers: dict[str, _WorkspaceTrackers] = {}
@@ -102,6 +105,19 @@ class CortexPlugin:
 
         if self.config.get("registerTools", True) and hasattr(api, "register_tool"):
             register_cortex_tools(api, self._workspace_for)
+
+        ta_cfg = self.config.get("traceAnalyzer", {})
+        if ta_cfg.get("enabled"):
+            from .trace_analyzer.analyzer import TraceAnalyzer, register_trace_analyzer
+
+            ws = self._workspace_for({})
+            self.trace_analyzer = TraceAnalyzer(
+                ta_cfg, ws, api.logger, source=self.trace_source,
+                triage_llm=self.call_llm if ta_cfg.get("classify", {}).get("enabled") else None,
+                deep_llm=self.call_llm if ta_cfg.get("classify", {}).get("enabled") else None,
+                clock=self.clock)
+            register_trace_analyzer(api, self.trace_analyzer,
+                                    wall_timers=self.wall_timers)
 
     # ── workspace/tracker resolution ─────────────────────────────────
 

@@ -199,6 +199,10 @@ class ThreadTracker:
         }
 
     def persist(self) -> None:
+        # Write-per-message is deliberate reference parity (thread-tracker.ts
+        # processMessage → persist()): threads.json must survive a crash at
+        # any point — it feeds boot context. Commitments, which are lower
+        # stakes, use the debounced path instead.
         if not self.writeable:
             return
         if not save_json(self.path, self._build_data(), self.logger):

@@ -1,0 +1,35 @@
+"""Trace analyzer: batch failure-analysis over the agent event history
+(RFC-005; reference: cortex/src/trace-analyzer/ ~3.5k LoC).
+
+Never in the message hot path (R-010). Pipeline: fetch events from a
+TraceSource → normalize (dual schema) → reconstruct conversation chains →
+run 7 signal detectors → optional 2-stage LLM classification → generate
+deduped outputs (soul rules / governance policies / cortex patterns) →
+report + incremental state.
+
+Throughput requirement R-037: ≥10,000 events/min on one core — this
+implementation's chain/signal scan runs at several hundred× that (see
+bench.py), with the doom-loop similarity math vectorizable onto TPU via
+ops/similarity.py for large windows.
+"""
+
+from .analyzer import TraceAnalyzer
+from .chains import ConversationChain, reconstruct_chains
+from .events import NormalizedEvent, detect_schema, map_event_type, normalize_event
+from .signals import FailureSignal, detect_all_signals
+from .source import MemoryTraceSource, TransportTraceSource, create_nats_trace_source
+
+__all__ = [
+    "ConversationChain",
+    "FailureSignal",
+    "MemoryTraceSource",
+    "NormalizedEvent",
+    "TraceAnalyzer",
+    "TransportTraceSource",
+    "create_nats_trace_source",
+    "detect_all_signals",
+    "detect_schema",
+    "map_event_type",
+    "normalize_event",
+    "reconstruct_chains",
+]

@@ -1,0 +1,170 @@
+"""TraceAnalyzer orchestrator + gateway wiring
+(reference: cortex/src/trace-analyzer/analyzer.ts 9-step run,
+hooks.ts scheduled interval + /trace-analyze command).
+
+Run: load state → source → fetch batches (incremental from last seq) →
+reconstruct chains → detect signals → optional classify → outputs → report
+→ persist state → close. No source → graceful empty report.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from ...core.api import PluginCommand, PluginService
+from .chains import reconstruct_chains
+from .classifier import classify_findings
+from .outputs import generate_outputs
+from .report import ProcessingState, assemble_report, rule_effectiveness, save_report
+from .signal_patterns import compile_signal_patterns
+from .signals import detect_all_signals
+from .source import create_nats_trace_source
+
+DEFAULT_ANALYZER_CONFIG = {
+    "enabled": True,
+    "languages": ["en", "de"],
+    "fetchBatchSize": 500,
+    "maxEventsPerRun": 100_000,
+    "gapMinutes": 30,
+    "maxEventsPerChain": 1000,
+    "signals": {},            # per-signal {enabled, severity}
+    "classify": {"enabled": False, "useLocalTriage": False},
+    "scheduleMinutes": 0,     # 0 = manual only
+    "natsUrl": None,
+    "stream": "CLAW_EVENTS",
+}
+
+
+class TraceAnalyzer:
+    def __init__(self, config: dict, state_dir: str | Path, logger,
+                 source=None, triage_llm=None, deep_llm=None,
+                 clock: Callable[[], float] = time.time):
+        from ...config.loader import deep_merge
+
+        self.config = deep_merge(DEFAULT_ANALYZER_CONFIG, config or {})
+        self.state_dir = Path(state_dir)
+        self.logger = logger
+        self.clock = clock
+        self._source = source
+        self.triage_llm = triage_llm
+        self.deep_llm = deep_llm
+        self.patterns = compile_signal_patterns(self.config["languages"])
+
+    def _get_source(self):
+        if self._source is not None:
+            return self._source
+        url = self.config.get("natsUrl")
+        if url:
+            return create_nats_trace_source(url, self.config.get("stream"), self.logger)
+        return None
+
+    def run(self) -> dict:
+        start = time.perf_counter()
+        state = ProcessingState.load(self.state_dir)
+        source = self._get_source()
+
+        if source is None:
+            self.logger.warn("trace analyzer: no event source; emitting empty report")
+            report = assemble_report(
+                {"events": 0, "chains": 0, "signals": 0, "durationMs": 0.0,
+                 "eventsPerMinute": 0.0, "incrementalFromSeq": state.last_processed_seq},
+                [], [], [], [], self.clock)
+            save_report(report, self.state_dir)
+            return report
+
+        try:
+            events = list(source.fetch(
+                start_seq=state.last_processed_seq,
+                batch_size=self.config["fetchBatchSize"],
+                max_events=self.config["maxEventsPerRun"]))
+            chains = reconstruct_chains(events,
+                                        gap_minutes=self.config["gapMinutes"],
+                                        max_events_per_chain=self.config["maxEventsPerChain"])
+            signals = detect_all_signals(chains, self.patterns,
+                                         self.config.get("signals"), self.logger)
+
+            classified = []
+            ccfg = self.config.get("classify", {})
+            if signals and (ccfg.get("enabled") or self.triage_llm or self.deep_llm):
+                chains_by_id = {c.id: c for c in chains}
+                classified = classify_findings(
+                    signals, chains_by_id, self.triage_llm, self.deep_llm,
+                    self.logger, use_local_triage=ccfg.get("useLocalTriage", False))
+            else:
+                from .classifier import ClassifiedFinding
+
+                classified = [ClassifiedFinding(s, True, s.severity) for s in signals]
+
+            outputs = generate_outputs(classified)
+
+            signal_counts: dict = {}
+            for s in signals:
+                signal_counts[s.signal] = signal_counts.get(s.signal, 0) + 1
+            effectiveness = rule_effectiveness(state, signal_counts)
+
+            duration_ms = (time.perf_counter() - start) * 1000
+            events_per_minute = (len(events) / (duration_ms / 60_000)) if duration_ms > 0 else 0.0
+            run_stats = {
+                "events": len(events), "chains": len(chains), "signals": len(signals),
+                "durationMs": round(duration_ms, 2),
+                "eventsPerMinute": round(events_per_minute, 1),
+                "incrementalFromSeq": state.last_processed_seq,
+            }
+            report = assemble_report(run_stats, signals, classified, outputs,
+                                     effectiveness, self.clock)
+            save_report(report, self.state_dir)
+
+            if events:
+                state.last_processed_seq = max(e.seq for e in events)
+                state.last_processed_ts = max(e.ts for e in events)
+            state.total_events_processed += len(events)
+            state.total_runs += 1
+            state.save(self.state_dir)
+            self.logger.info(
+                f"trace analysis: {len(events)} events → {len(chains)} chains → "
+                f"{len(signals)} signals ({run_stats['eventsPerMinute']:.0f} ev/min)")
+            return report
+        finally:
+            source.close()
+
+
+def register_trace_analyzer(api, analyzer: TraceAnalyzer,
+                            wall_timers: bool = True) -> None:
+    """Wire the analyzer: /trace-analyze command + optional schedule."""
+    api.register_command(PluginCommand(
+        name="trace-analyze", description="Run trace analysis now",
+        handler=lambda ctx: {"text": _summary_text(analyzer.run())}))
+
+    minutes = analyzer.config.get("scheduleMinutes") or 0
+    if minutes > 0 and wall_timers:
+        import threading
+
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(minutes * 60):
+                try:
+                    analyzer.run()
+                except Exception as exc:  # noqa: BLE001
+                    api.logger.error(f"scheduled trace analysis failed: {exc}")
+
+        thread = threading.Thread(target=loop, daemon=True, name="trace-analyzer")
+        api.register_service(PluginService(
+            id="trace-analyzer",
+            start=lambda ctx: thread.start(),
+            stop=lambda ctx: stop.set()))
+
+
+def _summary_text(report: dict) -> str:
+    rs = report["runStats"]
+    lines = [f"🔍 trace analysis: {rs['events']} events → {rs['chains']} chains → "
+             f"{rs['signals']} signals in {rs['durationMs']}ms "
+             f"({rs['eventsPerMinute']:.0f} ev/min)"]
+    for signal, stats in report["signalStats"].items():
+        lines.append(f"  {signal}: {stats['count']}")
+    for output in report["outputs"][:5]:
+        lines.append(f"  → [{output['actionType']}] {output['actionText'][:80]} "
+                     f"(×{output['observations']})")
+    return "\n".join(lines)

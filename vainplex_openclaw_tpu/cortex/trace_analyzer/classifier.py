@@ -1,0 +1,173 @@
+"""Stage-2 classification: fast triage then deep analysis
+(reference: cortex/src/trace-analyzer/classifier.ts:33-372).
+
+Both steps run behind DI'd ``call_llm`` callables (triage may use a smaller/
+faster model — per-field LLM config merge in the reference). The TPU-native
+twist: ``local_triage`` scores findings with the CortexEncoder on-device
+instead of HTTP, so routine triage never leaves the chip; the deep step
+remains LLM-shaped.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .redactor import redact_chain, redact_text
+from .signals import FailureSignal
+
+ACTION_TYPES = ("soul_rule", "governance_policy", "cortex_pattern", "manual_review")
+
+KNOWN_FALSE_POSITIVES = (
+    "user said no to a yes/no question",
+    "test environment failure",
+    "user changed their mind (not a correction)",
+)
+
+
+@dataclass
+class ClassifiedFinding:
+    signal: FailureSignal
+    kept: bool
+    severity: str
+    root_cause: str = ""
+    action_type: str = "manual_review"
+    action_text: str = ""
+    confidence: float = 0.0
+    fact_correction: Optional[dict] = None
+
+    def to_dict(self) -> dict:
+        return {**self.signal.to_dict(), "kept": self.kept, "severity": self.severity,
+                "rootCause": self.root_cause, "actionType": self.action_type,
+                "actionText": self.action_text, "confidence": self.confidence,
+                "factCorrection": self.fact_correction}
+
+
+def format_chain_as_transcript(chain) -> str:
+    redacted = redact_chain(chain)
+    lines = []
+    for e in redacted["events"]:
+        if e["type"] in ("msg.in", "msg.out"):
+            who = "USER" if e["type"] == "msg.in" else "AGENT"
+            lines.append(f"[{who}] {e['content']}")
+        elif e["type"] == "tool.call":
+            lines.append(f"[TOOL CALL] {e['tool_name']}")
+        elif e["type"] == "tool.result":
+            status = f"ERROR: {e['tool_error']}" if e["tool_error"] else "ok"
+            lines.append(f"[TOOL RESULT] {e['tool_name']}: {status}")
+    return "\n".join(lines)
+
+
+from ...utils.llm_json import parse_llm_json as _parse_json  # shared LLM-JSON parser
+
+
+def triage_prompt(finding: FailureSignal) -> str:
+    fps = "\n".join(f"- {fp}" for fp in KNOWN_FALSE_POSITIVES)
+    return (
+        "You triage agent-failure findings. Known false positives:\n"
+        f"{fps}\n\n"
+        f"FINDING: {finding.signal} ({finding.severity})\n"
+        f"{finding.summary}\nEvidence: {json.dumps(finding.evidence)}\n\n"
+        'Respond ONLY JSON: {"keep": bool, "severity": '
+        '"info"|"low"|"medium"|"high"|"critical"}'
+    )
+
+
+def deep_prompt(finding: FailureSignal, chain) -> str:
+    transcript = format_chain_as_transcript(chain) if chain is not None else ""
+    return (
+        "You analyze a confirmed agent failure. Produce a root cause and one "
+        "corrective action.\n\n"
+        f"FINDING: {finding.signal}: {finding.summary}\n"
+        f"TRANSCRIPT:\n{redact_text(transcript)[:4000]}\n\n"
+        'Respond ONLY JSON: {"rootCause": str, "actionType": "soul_rule"|'
+        '"governance_policy"|"cortex_pattern"|"manual_review", "actionText": str, '
+        '"confidence": 0.0-1.0, "factCorrection": {"subject": str, "predicate": '
+        'str, "value": str} | null}'
+    )
+
+
+SEVERITY_RANK = {"info": 0, "low": 1, "medium": 2, "high": 3, "critical": 4}
+
+
+def local_triage(findings: list[FailureSignal], min_severity: str = "medium"):
+    """On-device triage: CortexEncoder severity/keep heads score each
+    finding's text — no HTTP, fully batched (TPU path)."""
+    import jax
+
+    from ...models import EncoderConfig, encode_texts, forward, init_params
+
+    cfg = EncoderConfig()
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    texts = [f"{f.signal} {f.summary} {' '.join(map(str, f.evidence))}" for f in findings]
+    tokens = encode_texts(texts, cfg.seq_len, cfg.vocab_size)
+    out = forward(params, tokens, cfg)
+    keep_logits = out["keep"]
+    import numpy as np
+
+    keep = np.asarray(keep_logits).argmax(axis=-1).astype(bool)
+    # Untrained model → keep everything at its rule severity; once distilled
+    # (models/train.py) the keep head prunes. Rule floor guarantees recall:
+    floor = SEVERITY_RANK[min_severity]
+    decisions = []
+    for i, f in enumerate(findings):
+        rule_keep = SEVERITY_RANK.get(f.severity, 2) >= floor
+        decisions.append(bool(keep[i]) or rule_keep)
+    return decisions
+
+
+def classify_findings(findings: list[FailureSignal], chains_by_id: dict,
+                      triage_llm: Optional[Callable[[str], str]] = None,
+                      deep_llm: Optional[Callable[[str], str]] = None,
+                      logger=None,
+                      use_local_triage: bool = False) -> list[ClassifiedFinding]:
+    """Triage (keep? severity?) then deep analysis per kept finding. With no
+    LLMs configured, findings pass through as manual_review at rule severity."""
+    out: list[ClassifiedFinding] = []
+
+    local_keep = None
+    if use_local_triage and findings:
+        try:
+            local_keep = local_triage(findings)
+        except Exception as exc:  # noqa: BLE001 — fall back to rule severity
+            if logger is not None:
+                logger.warn(f"local triage failed: {exc}")
+
+    for idx, finding in enumerate(findings):
+        kept, severity = True, finding.severity
+        if triage_llm is not None:
+            try:
+                parsed = _parse_json(triage_llm(triage_prompt(finding)))
+                if parsed is not None:
+                    kept = bool(parsed.get("keep", True))
+                    severity = parsed.get("severity") or severity
+            except Exception as exc:  # noqa: BLE001
+                if logger is not None:
+                    logger.warn(f"triage failed for {finding.signal}: {exc}")
+        elif local_keep is not None:
+            kept = local_keep[idx]
+
+        cf = ClassifiedFinding(finding, kept, severity)
+        if kept and deep_llm is not None:
+            try:
+                parsed = _parse_json(deep_llm(deep_prompt(
+                    finding, chains_by_id.get(finding.chain_id))))
+                if parsed is not None:
+                    cf.root_cause = str(parsed.get("rootCause") or "")
+                    at = parsed.get("actionType")
+                    cf.action_type = at if at in ACTION_TYPES else "manual_review"
+                    cf.action_text = str(parsed.get("actionText") or "")
+                    try:
+                        cf.confidence = max(0.0, min(1.0, float(parsed.get("confidence", 0))))
+                    except (TypeError, ValueError):
+                        cf.confidence = 0.0
+                    fc = parsed.get("factCorrection")
+                    if isinstance(fc, dict) and all(k in fc for k in
+                                                    ("subject", "predicate", "value")):
+                        cf.fact_correction = fc
+            except Exception as exc:  # noqa: BLE001
+                if logger is not None:
+                    logger.warn(f"deep analysis failed for {finding.signal}: {exc}")
+        out.append(cf)
+    return out

@@ -55,22 +55,10 @@ def build_prompt(text: str, facts: list) -> str:
 
 def parse_response(raw: str) -> Optional[dict]:
     """JSON parse tolerant of ```json fences and surrounding prose."""
-    text = raw.strip()
-    if text.startswith("```"):
-        lines = text.splitlines()
-        body = [ln for ln in lines if not ln.strip().startswith("```")]
-        text = "\n".join(body).strip()
-    try:
-        parsed = json.loads(text)
-    except json.JSONDecodeError:
-        start, end = text.find("{"), text.rfind("}")
-        if start == -1 or end <= start:
-            return None
-        try:
-            parsed = json.loads(text[start:end + 1])
-        except json.JSONDecodeError:
-            return None
-    if not isinstance(parsed, dict) or parsed.get("verdict") not in ("pass", "flag", "block"):
+    from ...utils.llm_json import parse_llm_json
+
+    parsed = parse_llm_json(raw)
+    if parsed is None or parsed.get("verdict") not in ("pass", "flag", "block"):
         return None
     issues = parsed.get("issues") or []
     parsed["issues"] = [i for i in issues if isinstance(i, dict)

@@ -1,0 +1,19 @@
+"""TPU numeric kernels for the framework's batch surfaces."""
+
+from .similarity import (
+    batch_levenshtein_ratio,
+    hashed_multi_hot,
+    jaccard_matrix,
+    jaccard_similarity,
+    levenshtein_ratio,
+    param_similarity,
+)
+
+__all__ = [
+    "batch_levenshtein_ratio",
+    "hashed_multi_hot",
+    "jaccard_matrix",
+    "jaccard_similarity",
+    "levenshtein_ratio",
+    "param_similarity",
+]

@@ -1,0 +1,212 @@
+"""Similarity kernels for doom-loop / repeat-failure detection.
+
+The reference computes these scalar-at-a-time in TS
+(cortex/src/trace-analyzer/signals/doom-loop.ts:53-136): Levenshtein ratio
+for exec command strings (capped at 500 chars), Jaccard over key=value pairs
+for other tool params. Those exact semantics live here in plain Python for
+the common case (a handful of consecutive attempts), plus batched
+TPU-friendly formulations for large windows:
+
+- ``jaccard_matrix``: hash each param-set into a multi-hot vector; the full
+  pairwise Jaccard matrix is then one ``X @ X.T`` on the MXU plus
+  elementwise math — O(N²·D) as a single fused matmul instead of N² Python
+  loops.
+- ``batch_levenshtein_ratio``: classic DP re-expressed as a ``lax.scan``
+  over rows of the (padded, fixed-length) token grid, vmapped over the pair
+  batch — static shapes, no data-dependent control flow.
+
+Both JAX paths are jitted once per shape; callers batch to fixed sizes.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+VOLATILE_KEYS = frozenset({"timeout", "timestamp", "ts"})
+LEVENSHTEIN_CAP = 500
+
+
+# ── reference-exact scalar paths ─────────────────────────────────────
+
+
+def jaccard_similarity(a: dict, b: dict) -> float:
+    a_entries = {f"{k}={json.dumps(v, sort_keys=True, default=str)}"
+                 for k, v in (a or {}).items() if k not in VOLATILE_KEYS}
+    b_entries = {f"{k}={json.dumps(v, sort_keys=True, default=str)}"
+                 for k, v in (b or {}).items() if k not in VOLATILE_KEYS}
+    union = a_entries | b_entries
+    if not union:
+        return 1.0
+    return len(a_entries & b_entries) / len(union)
+
+
+def levenshtein_distance(a: str, b: str) -> int:
+    sa, sb = a[:LEVENSHTEIN_CAP], b[:LEVENSHTEIN_CAP]
+    if sa == sb:
+        return 0
+    if not sa:
+        return len(sb)
+    if not sb:
+        return len(sa)
+    prev = list(range(len(sa) + 1))
+    for i, cb in enumerate(sb, 1):
+        curr = [i]
+        for j, ca in enumerate(sa, 1):
+            cost = 0 if cb == ca else 1
+            curr.append(min(prev[j] + 1, curr[j - 1] + 1, prev[j - 1] + cost))
+        prev = curr
+    return prev[len(sa)]
+
+
+def levenshtein_ratio(a: str, b: str) -> float:
+    max_len = max(len(a[:LEVENSHTEIN_CAP]), len(b[:LEVENSHTEIN_CAP]))
+    if max_len == 0:
+        return 1.0
+    return 1.0 - levenshtein_distance(a, b) / max_len
+
+
+def param_similarity(a: dict, b: dict) -> float:
+    """Levenshtein for exec commands, Jaccard otherwise (doom-loop.ts:118-131)."""
+    a_cmd = a.get("command") if isinstance(a.get("command"), str) else ""
+    b_cmd = b.get("command") if isinstance(b.get("command"), str) else ""
+    if a_cmd and b_cmd:
+        return levenshtein_ratio(a_cmd, b_cmd)
+    return jaccard_similarity(a or {}, b or {})
+
+
+# ── batched TPU paths ────────────────────────────────────────────────
+
+
+def hashed_multi_hot(param_sets: list[dict], dim: int = 1024) -> np.ndarray:
+    """Hash each param-set's key=value entries into a {0,1}^dim vector."""
+    X = np.zeros((len(param_sets), dim), dtype=np.float32)
+    for i, params in enumerate(param_sets):
+        for k, v in (params or {}).items():
+            if k in VOLATILE_KEYS:
+                continue
+            h = hash(f"{k}={json.dumps(v, sort_keys=True, default=str)}")
+            X[i, h % dim] = 1.0
+    return X
+
+
+def jaccard_matrix(param_sets: list[dict], dim: int = 1024,
+                   use_jax: Optional[bool] = None) -> np.ndarray:
+    """Full pairwise Jaccard matrix over N param sets.
+
+    JAX path for large N (one MXU matmul); numpy fallback for tiny inputs
+    where dispatch overhead dominates. Hash collisions can slightly inflate
+    similarity — acceptable for loop *detection* (threshold 0.8).
+    """
+    X = hashed_multi_hot(param_sets, dim)
+    if use_jax is None:
+        use_jax = len(param_sets) >= 64
+    if use_jax:
+        return np.asarray(_jaccard_matrix_jax(X))
+    inter = X @ X.T
+    counts = X.sum(axis=1)
+    union = counts[:, None] + counts[None, :] - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        sim = np.where(union > 0, inter / union, 1.0)
+    return sim
+
+
+def _jaccard_matrix_jax_impl(X):
+    import jax.numpy as jnp
+
+    inter = X @ X.T
+    counts = X.sum(axis=1)
+    union = counts[:, None] + counts[None, :] - inter
+    return jnp.where(union > 0, inter / union, 1.0)
+
+
+_jaccard_jit = None
+
+
+def _jaccard_matrix_jax(X: np.ndarray):
+    global _jaccard_jit
+    if _jaccard_jit is None:
+        import jax
+
+        _jaccard_jit = jax.jit(_jaccard_matrix_jax_impl)
+    return _jaccard_jit(X)
+
+
+def _tokenize_fixed(strings: list[str], length: int) -> np.ndarray:
+    out = np.zeros((len(strings), length), dtype=np.int32)
+    for i, s in enumerate(strings):
+        b = s[:LEVENSHTEIN_CAP].encode("utf-8", "replace")[:length]
+        out[i, :len(b)] = np.frombuffer(b, dtype=np.uint8).astype(np.int32) + 1  # 0 = pad
+    return out
+
+
+_batch_lev_jit = None
+
+
+def _batch_levenshtein_jax(A: np.ndarray, B: np.ndarray, len_a: np.ndarray,
+                           len_b: np.ndarray):
+    """Batched Levenshtein distance: lax.scan over DP rows, vmap over pairs."""
+    global _batch_lev_jit
+    if _batch_lev_jit is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def one_pair(a, b, la, lb):
+            L = a.shape[0]
+            init_row = jnp.arange(L + 1, dtype=jnp.int32)
+
+            def step(prev_row, bi_idx):
+                bi, i = bi_idx
+                # positions beyond len_b must not change the row
+                def compute(prev_row):
+                    cost = jnp.where(a == bi, 0, 1)
+
+                    def inner(carry, j):
+                        left = carry  # curr[j-1]
+                        up = prev_row[j]          # prev[j]
+                        diag = prev_row[j - 1]    # prev[j-1]
+                        val = jnp.minimum(jnp.minimum(up + 1, left + 1),
+                                          diag + cost[j - 1])
+                        return val, val
+
+                    _, tail = lax.scan(inner, i, jnp.arange(1, L + 1))
+                    return jnp.concatenate([jnp.array([i], dtype=jnp.int32), tail])
+
+                new_row = lax.cond(i <= lb, compute, lambda r: r, prev_row)
+                return new_row, None
+
+            final_row, _ = lax.scan(step, init_row,
+                                    (b, jnp.arange(1, L + 1, dtype=jnp.int32)))
+            return final_row[la]
+
+        _batch_lev_jit = jax.jit(jax.vmap(one_pair))
+    return _batch_lev_jit(A, B, len_a, len_b)
+
+
+def batch_levenshtein_ratio(pairs: list[tuple[str, str]], length: int = 128,
+                            use_jax: Optional[bool] = None) -> np.ndarray:
+    """Levenshtein ratios for a batch of string pairs.
+
+    The JAX path pads/tokenizes to ``length`` (similarity over the first
+    ``length`` bytes — fine for loop detection on commands); the scalar path
+    is exact up to the 500-char cap.
+    """
+    if use_jax is None:
+        use_jax = len(pairs) >= 32
+    if not use_jax:
+        return np.array([levenshtein_ratio(a, b) for a, b in pairs], dtype=np.float32)
+    a_strs = [p[0] for p in pairs]
+    b_strs = [p[1] for p in pairs]
+    A = _tokenize_fixed(a_strs, length)
+    B = _tokenize_fixed(b_strs, length)
+    len_a = (A > 0).sum(axis=1).astype(np.int32)
+    len_b = (B > 0).sum(axis=1).astype(np.int32)
+    dist = np.asarray(_batch_levenshtein_jax(A, B, len_a, len_b))
+    max_len = np.maximum(len_a, len_b)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.where(max_len > 0, 1.0 - dist / max_len, 1.0)
+    return ratio.astype(np.float32)

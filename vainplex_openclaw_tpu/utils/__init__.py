@@ -1,0 +1,5 @@
+"""Shared small utilities."""
+
+from .llm_json import parse_llm_json
+
+__all__ = ["parse_llm_json"]
