@@ -221,6 +221,19 @@ class TestDecisionTracker:
         d.process_message("we decided to delete the production database", "user")
         assert d.decisions[0]["impact"] == "high"
 
+    def test_impact_keywords_in_why_clause_count(self, tmp_path):
+        d = self.make(tmp_path)
+        d.process_message("we decided to switch hosts because production is on fire", "user")
+        rec = d.decisions[0]
+        assert rec["impact"] == "high"  # "production" lives in the why clause
+        assert "because" not in rec["what"]
+
+    def test_decisions_differing_only_in_why_are_distinct(self, tmp_path):
+        d = self.make(tmp_path)
+        d.process_message("we decided to keep the flag because legal requires it", "user")
+        d.process_message("we decided to keep the flag because users keep complaining loudly", "user")
+        assert len(d.decisions) == 2
+
     def test_dedupe_window(self, tmp_path):
         clk = FakeClock()
         d = self.make(tmp_path, clock=clk)

@@ -1,0 +1,230 @@
+"""Knowledge engine tests (reference: knowledge-engine test suite — entity
+extractor, fact store, embeddings, maintenance, hooks; run serially there
+via node --test)."""
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.knowledge import KnowledgeEnginePlugin
+from vainplex_openclaw_tpu.knowledge.embeddings import (
+    ChromaEmbeddings,
+    LocalEmbeddings,
+    construct_chroma_payload,
+)
+from vainplex_openclaw_tpu.knowledge.entity_extractor import EntityExtractor, canonicalize
+from vainplex_openclaw_tpu.knowledge.fact_store import FactStore
+from vainplex_openclaw_tpu.knowledge.llm_enhancer import KnowledgeLlmEnhancer
+from vainplex_openclaw_tpu.knowledge.maintenance import Maintenance
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock, make_gateway
+
+
+def extractor():
+    return EntityExtractor(list_logger(), clock=FakeClock())
+
+
+class TestEntityExtractor:
+    def test_email_url_dates(self):
+        entities = extractor().extract(
+            "Mail anna@example.org, docs at https://docs.example.org/guide, "
+            "due 2026-08-01, meeting 12.03.2026, also March 5th, 2026 and "
+            "3. März 2026")
+        types = {e.type for e in entities}
+        assert {"email", "url", "date"} <= types
+        dates = [e for e in entities if e.type == "date"]
+        assert len(dates) >= 4
+
+    def test_proper_nouns_with_exclusions(self):
+        entities = extractor().extract("The meeting with Klaus Schmidt about Berlin")
+        values = {e.value for e in entities if e.type == "unknown"}
+        assert "Klaus Schmidt" in values and "Berlin" in values
+        assert "The" not in values
+
+    def test_organization_canonicalization(self):
+        entities = extractor().extract("We partner with Acme Corp. and Siemens AG today")
+        orgs = {e.value for e in entities if e.type == "organization"}
+        assert "Acme" in orgs and "Siemens" in orgs
+        assert canonicalize("Acme Corp.", "organization") == "Acme"
+
+    def test_product_names(self):
+        entities = extractor().extract("Upgrade to Postgres 16.2 and the Falcon IX launcher")
+        products = {e.value for e in entities if e.type == "product"}
+        assert any("16.2" in p or "Postgres" in p for p in products)
+
+    def test_mention_merging_counts(self):
+        entities = extractor().extract("Grafana is nice. I love Grafana. Grafana rocks")
+        grafana = next(e for e in entities if e.value == "Grafana")
+        assert grafana.count >= 2
+
+    def test_importance_scores(self):
+        entities = extractor().extract("Contact sales@acme.io about Kubernetes")
+        email = next(e for e in entities if e.type == "email")
+        noun = next(e for e in entities if e.value == "Kubernetes")
+        assert email.importance > noun.importance
+
+
+class TestFactStore:
+    def make(self, tmp_path, **cfg):
+        store = FactStore(tmp_path, cfg or None, list_logger(),
+                          clock=FakeClock(), wall_timers=False)
+        store.load()
+        return store
+
+    def test_add_query_persist_roundtrip(self, tmp_path):
+        s = self.make(tmp_path)
+        s.add_fact("anna", "works-at", "Acme")
+        s.add_fact("anna", "likes", "coffee")
+        assert len(s.query(subject="anna")) == 2
+        assert s.query(text="coffee")[0].object == "coffee"
+        s.flush()
+        data = read_json(tmp_path / "knowledge" / "facts.json")
+        assert len(data["facts"]) == 2
+
+        s2 = self.make(tmp_path)
+        assert s2.count() == 2
+
+    def test_dedupe_boosts_relevance(self, tmp_path):
+        s = self.make(tmp_path)
+        f1 = s.add_fact("anna", "works-at", "Acme")
+        f1.relevance = 0.5
+        f2 = s.add_fact("anna", "works-at", "Acme")
+        assert f2.id == f1.id and f2.relevance == 0.7
+        assert s.count() == 1
+
+    def test_decay_and_prune_threshold(self, tmp_path):
+        s = self.make(tmp_path, decayFactor=0.5, pruneBelowRelevance=0.2)
+        s.add_fact("a", "b", "c")
+        assert s.decay_facts() == 0  # 1.0 → 0.5
+        assert s.decay_facts() == 0  # 0.5 → 0.25
+        assert s.decay_facts() == 1  # 0.25 → 0.125 < 0.2 → pruned
+        assert s.count() == 0
+
+    def test_max_facts_cap_drops_least_relevant(self, tmp_path):
+        s = self.make(tmp_path, maxFacts=3)
+        for i in range(3):
+            s.add_fact(f"s{i}", "p", "o")
+        s.facts[s.query(subject="s0")[0].id].relevance = 0.1
+        s.add_fact("s3", "p", "o")
+        assert s.count() == 3
+        assert s.query(subject="s0") == []
+
+    def test_requires_load(self, tmp_path):
+        s = FactStore(tmp_path, None, list_logger(), wall_timers=False)
+        with pytest.raises(RuntimeError):
+            s.add_fact("a", "b", "c")
+
+
+class TestEmbeddings:
+    def test_chroma_payload_and_endpoint(self, tmp_path):
+        store = FactStore(tmp_path, None, list_logger(), clock=FakeClock(),
+                          wall_timers=False)
+        store.load()
+        fact = store.add_fact("anna", "works-at", "Acme")
+        payload = construct_chroma_payload([fact])
+        assert payload["documents"] == ["anna works at Acme."]
+        assert payload["metadatas"][0]["subject"] == "anna"
+
+        posts = []
+        emb = ChromaEmbeddings(
+            {"enabled": True, "collectionName": "kb",
+             "endpoint": "http://db:8000/api/v2/collections/{name}/upsert"},
+            list_logger(), http_post=lambda url, p, timeout=15.0: posts.append((url, p)))
+        assert emb.sync([fact]) == 1
+        assert posts[0][0] == "http://db:8000/api/v2/collections/kb/upsert"
+
+    def test_chroma_failure_is_soft(self, tmp_path):
+        def down(url, p, timeout=15.0):
+            raise ConnectionError("no chroma")
+
+        log = list_logger()
+        emb = ChromaEmbeddings({"enabled": True, "endpoint": "http://x/{name}"},
+                               log, http_post=down)
+
+        class F:
+            id = "1"
+            subject = "a"
+            predicate = "b"
+            object = "c"
+            source = "s"
+            created_at = ""
+
+        assert emb.sync([F()]) == 0
+        assert any("sync failed" in m for m in log.messages("error"))
+
+    def test_local_embeddings_semantic_search(self, tmp_path):
+        store = FactStore(tmp_path, None, list_logger(), clock=FakeClock(),
+                          wall_timers=False)
+        store.load()
+        facts = [store.add_fact("anna", "works-at", "Acme Corporation"),
+                 store.add_fact("deploy", "uses", "kubernetes cluster"),
+                 store.add_fact("coffee", "is", "popular beverage")]
+        emb = LocalEmbeddings(list_logger())
+        assert emb.sync(facts) == 3 and emb.count() == 3
+        results = emb.search("kubernetes deployment", k=2)
+        assert results[0]["document"] == "deploy uses kubernetes cluster."
+        # re-sync same facts replaces, not duplicates
+        assert emb.sync(facts) == 3 and emb.count() == 3
+
+
+class TestMaintenance:
+    def test_manual_ticks(self, tmp_path):
+        store = FactStore(tmp_path, {"decayFactor": 0.5, "pruneBelowRelevance": 0.3},
+                          list_logger(), clock=FakeClock(), wall_timers=False)
+        store.load()
+        store.add_fact("a", "b", "c")
+        emb = LocalEmbeddings(list_logger())
+        m = Maintenance(store, emb, list_logger(), wall_timers=False)
+        assert m.run_embeddings_sync() == 1
+        assert m.run_embeddings_sync() == 0  # nothing new
+        store.add_fact("d", "e", "f")
+        assert m.run_embeddings_sync() == 1
+        m.run_decay()
+        assert m.run_decay() == 2  # both drop below 0.3 on second tick
+
+
+class TestPlugin:
+    def load(self, workspace, config=None, call_llm=None):
+        gw, _ = make_gateway()
+        plugin = KnowledgeEnginePlugin(workspace=str(workspace), clock=gw.clock,
+                                       call_llm=call_llm, wall_timers=False)
+        gw.load(plugin, plugin_config={"enabled": True, **(config or {})})
+        gw.start()
+        return gw, plugin
+
+    def test_message_flow_extracts_facts(self, workspace, openclaw_home):
+        gw, plugin = self.load(workspace)
+        gw.message_received("Contact anna@example.org at Acme GmbH about the launch",
+                            {"session_key": "s"})
+        facts = plugin.fact_store.query(subject="conversation")
+        objects = {f.object for f in facts}
+        assert "anna@example.org" in objects and "Acme" in objects
+
+    def test_llm_facts_merge(self, workspace, openclaw_home):
+        llm = lambda p: '{"facts": [{"subject": "anna", "predicate": "role", "object": "CTO"}]}'  # noqa: E731
+        gw, plugin = self.load(workspace, config={"llm": {"enabled": True, "batchSize": 1}},
+                               call_llm=llm)
+        gw.message_received("anna is our CTO", {"session_key": "s"})
+        assert plugin.fact_store.query(subject="anna")[0].object == "CTO"
+        assert plugin.fact_store.query(subject="anna")[0].source == "extracted-llm"
+
+    def test_status_command_and_search(self, workspace, openclaw_home):
+        gw, plugin = self.load(workspace)
+        gw.message_received("Talk to bob@corp.io about Postgres 16", {"session_key": "s"})
+        text = gw.command("/knowledge")["text"]
+        assert "facts" in text
+        search = gw.command("/knowledge", args="bob")["text"]
+        assert "bob@corp.io" in search
+
+    def test_flush_on_gateway_stop(self, workspace, openclaw_home):
+        gw, plugin = self.load(workspace)
+        gw.message_received("Reach me at x@y.dev", {"session_key": "s"})
+        gw.stop()
+        data = read_json(workspace / "knowledge" / "facts.json")
+        assert data and any(f["object"] == "x@y.dev" for f in data["facts"])
+
+    def test_disabled(self, workspace, openclaw_home):
+        gw, _ = make_gateway()
+        plugin = KnowledgeEnginePlugin(workspace=str(workspace))
+        gw.load(plugin, plugin_config={"enabled": False})
+        assert gw.bus.handlers_for("message_received") == []

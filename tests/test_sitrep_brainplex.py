@@ -1,0 +1,257 @@
+"""Sitrep + brainplex tests (reference: sitrep aggregator/collector tests,
+brainplex scanner/configurator/writer/integration tests (272) — init flow in
+dry-run against temp dirs)."""
+
+import json
+
+from vainplex_openclaw_tpu.brainplex.cli import Output, parse_args, plan_installation, run_init
+from vainplex_openclaw_tpu.brainplex.configurator import default_config_for, generate_configs
+from vainplex_openclaw_tpu.brainplex.scanner import (
+    extract_agents,
+    find_config,
+    parse_config,
+    scan,
+)
+from vainplex_openclaw_tpu.brainplex.writer import update_openclaw_config, write_config
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.sitrep import SitrepPlugin, generate_sitrep
+from vainplex_openclaw_tpu.sitrep.aggregator import rollup_health
+from vainplex_openclaw_tpu.sitrep.collectors import safe_collect
+from vainplex_openclaw_tpu.storage.atomic import read_json, write_json_atomic
+
+from helpers import FakeClock, make_gateway
+
+
+class TestSitrep:
+    def test_collectors_and_health_rollup(self, tmp_path):
+        # seed cortex threads + an audit denial
+        write_json_atomic(tmp_path / "memory" / "reboot" / "threads.json", {
+            "threads": [{"title": "deploy", "status": "open", "priority": "high",
+                         "waiting_for": "approval"}]})
+        (tmp_path / "governance" / "audit").mkdir(parents=True)
+        (tmp_path / "governance" / "audit" / "2026-07-29.jsonl").write_text(
+            json.dumps({"verdict": "deny", "reason": "credential guard",
+                        "context": {"toolName": "read"}}) + "\n")
+        config = {"collectors": {"threads": {"enabled": True},
+                                 "errors": {"enabled": True}}}
+        report = generate_sitrep(config, {"workspace": str(tmp_path)},
+                                 list_logger(), FakeClock())
+        assert report["health"] == "degraded"  # blocked thread + denial = warn
+        assert report["collectors"]["threads"]["summary"] == "1 open (1 blocked)"
+        assert report["collectors"]["errors"]["items"][0]["tool"] == "read"
+
+    def test_custom_collector_and_error_isolation(self, tmp_path):
+        config = {"collectors": {},
+                  "customCollectors": [
+                      {"id": "echo", "command": "echo '[{\"x\": 1}]'"},
+                      {"id": "boom", "command": "exit 3"}]}
+        report = generate_sitrep(config, {"workspace": str(tmp_path)},
+                                 list_logger(), FakeClock())
+        assert report["collectors"]["custom:echo"]["items"] == [{"x": 1}]
+        assert report["collectors"]["custom:boom"]["status"] == "error"
+        assert report["health"] == "unhealthy"
+
+    def test_safe_collect_catches_crashes(self):
+        def boom(cfg, ctx):
+            raise RuntimeError("collector exploded")
+
+        result = safe_collect("x", boom, {"enabled": True}, {}, list_logger())
+        assert result["status"] == "error" and "exploded" in result["summary"]
+        assert safe_collect("x", boom, {"enabled": False}, {}, list_logger())["status"] == "skipped"
+
+    def test_rollup(self):
+        assert rollup_health({"a": {"status": "ok"}}) == "healthy"
+        assert rollup_health({"a": {"status": "warn"}}) == "degraded"
+        assert rollup_health({"a": {"status": "ok"}, "b": {"status": "error"}}) == "unhealthy"
+
+    def test_plugin_writes_sitrep_with_rotation(self, tmp_path, openclaw_home):
+        gw, _ = make_gateway()
+        plugin = SitrepPlugin(workspace=str(tmp_path), clock=gw.clock, wall_timers=False)
+        gw.load(plugin, plugin_config={"enabled": True, "intervalMinutes": 0})
+        gw.start()
+        assert (tmp_path / "sitrep.json").exists()  # initial report on start
+        text = gw.command("/sitrep")["text"]
+        assert "sitrep:" in text
+        assert (tmp_path / "sitrep.previous.json").exists()  # rotated
+
+    def test_plugin_uses_eventstore_status(self, tmp_path, openclaw_home):
+        from vainplex_openclaw_tpu.events import EventStorePlugin, MemoryTransport
+
+        gw, _ = make_gateway()
+        gw.load(EventStorePlugin(transport=MemoryTransport()),
+                plugin_config={"enabled": True})
+        plugin = SitrepPlugin(workspace=str(tmp_path), clock=gw.clock, wall_timers=False)
+        gw.load(plugin, plugin_config={"enabled": True,
+                                       "collectors": {"nats": {"enabled": True}}})
+        gw.start()
+        report = read_json(tmp_path / "sitrep.json")
+        assert "MemoryTransport" in report["collectors"]["nats"]["summary"]
+
+
+class TestBrainplexScanner:
+    def test_json5_tolerant_parse(self):
+        content = """{
+          // agents configured here
+          "agents": {"list": ["main", "viola"],}, /* trailing comma above */
+        }"""
+        config = parse_config(content)
+        assert config["agents"]["list"] == ["main", "viola"]
+
+    def test_walk_up_discovery_and_home_fallback(self, tmp_path):
+        (tmp_path / "proj" / "sub").mkdir(parents=True)
+        write_json_atomic(tmp_path / "proj" / "openclaw.json", {})
+        found = find_config(tmp_path / "proj" / "sub", home=tmp_path / "nohome")
+        assert found == tmp_path / "proj" / "openclaw.json"
+        # nested .openclaw/ form
+        (tmp_path / "p2" / ".openclaw").mkdir(parents=True)
+        write_json_atomic(tmp_path / "p2" / ".openclaw" / "openclaw.json", {})
+        assert find_config(tmp_path / "p2", home=tmp_path / "nohome") is not None
+        # home fallback
+        home = tmp_path / "home"
+        (home / ".openclaw").mkdir(parents=True)
+        write_json_atomic(home / ".openclaw" / "openclaw.json", {})
+        lonely = tmp_path / "lonely"
+        lonely.mkdir()
+        assert find_config(lonely, home=home) == home / ".openclaw" / "openclaw.json"
+        assert find_config(lonely, home=tmp_path / "nohome2") is None
+
+    def test_agent_extraction_four_shapes(self):
+        assert extract_agents({"agents": [{"id": "a"}, {"name": "b"}, "c"]}) == ["a", "b", "c"]
+        assert extract_agents({"agents": {"list": ["main"]}}) == ["main"]
+        assert extract_agents({"agents": {"definitions": [{"id": "x"}]}}) == ["x"]
+        assert extract_agents({"agents": {"main": {}, "defaults": {}}}) == ["main"]
+        assert extract_agents({}) == []
+
+
+class TestBrainplexInit:
+    def make_install(self, tmp_path, config=None):
+        root = tmp_path / "install"
+        root.mkdir()
+        write_json_atomic(root / "openclaw.json",
+                          config or {"agents": {"list": ["main", "viola"]}})
+        return root
+
+    def args(self, **over):
+        return {"command": "init", "full": False, "dry_run": False, "config": None,
+                "no_color": True, "verbose": True, "yes": True, **over}
+
+    def out(self, tmp_path):
+        import io
+
+        stream = io.StringIO()
+        return Output(color=False, verbose=True, stream=stream), stream
+
+    def test_parse_args(self):
+        args = parse_args(["init", "--full", "--dry-run", "--config", "/x", "-y"])
+        assert args["command"] == "init" and args["full"] and args["dry_run"]
+        assert args["config"] == "/x" and args["yes"]
+
+    def test_plan_skips_existing(self):
+        plan = plan_installation({"existing_plugins": ["governance"]}, full=True)
+        assert "governance" in plan["already"]
+        assert "cortex" in plan["install"] and "knowledge-engine" in plan["install"]
+
+    def test_dry_run_writes_nothing(self, tmp_path):
+        root = self.make_install(tmp_path)
+        out, stream = self.out(tmp_path)
+        code = run_init(self.args(dry_run=True), start_dir=str(root),
+                        home=tmp_path / "nohome", out=out)
+        assert code == 0
+        assert "dry run" in stream.getvalue()
+        assert not (root / "plugins").exists()
+        assert "plugins" not in (read_json(root / "openclaw.json") or {})
+
+    def test_full_init_writes_configs_and_merges(self, tmp_path):
+        root = self.make_install(tmp_path)
+        out, stream = self.out(tmp_path)
+        code = run_init(self.args(full=True), start_dir=str(root),
+                        home=tmp_path / "nohome", out=out)
+        assert code == 0
+        gov = read_json(root / "plugins" / "governance" / "config.json")
+        assert gov["trust"]["defaults"]["main"] == 30
+        merged = read_json(root / "openclaw.json")
+        assert set(merged["plugins"]) >= {"governance", "cortex", "eventstore",
+                                          "knowledge-engine", "sitrep"}
+        # second run: everything already configured, nothing rewritten
+        out2, stream2 = self.out(tmp_path)
+        assert run_init(self.args(full=True), start_dir=str(root),
+                        home=tmp_path / "nohome", out=out2) == 0
+        assert "nothing to do" in stream2.getvalue()
+
+    def test_never_overwrites_existing_config(self, tmp_path):
+        root = self.make_install(tmp_path)
+        custom = {"enabled": False, "custom": True}
+        write_json_atomic(root / "plugins" / "governance" / "config.json", custom)
+        out, _ = self.out(tmp_path)
+        run_init(self.args(), start_dir=str(root), home=tmp_path / "nohome", out=out)
+        assert read_json(root / "plugins" / "governance" / "config.json") == custom
+
+    def test_openclaw_json_backup_created(self, tmp_path):
+        root = self.make_install(tmp_path)
+        out, _ = self.out(tmp_path)
+        run_init(self.args(), start_dir=str(root), home=tmp_path / "nohome", out=out)
+        backups = list(root.glob("openclaw.json.backup-*"))
+        assert len(backups) == 1
+        assert read_json(backups[0]) == {"agents": {"list": ["main", "viola"]}}
+
+    def test_no_config_found_fails(self, tmp_path):
+        lonely = tmp_path / "lonely"
+        lonely.mkdir()
+        out, stream = self.out(tmp_path)
+        code = run_init(self.args(), start_dir=str(lonely),
+                        home=tmp_path / "nohome", out=out)
+        assert code == 1 and "no openclaw.json" in stream.getvalue()
+
+    def test_confirmation_abort(self, tmp_path):
+        root = self.make_install(tmp_path)
+        out, stream = self.out(tmp_path)
+        code = run_init(self.args(yes=False), start_dir=str(root),
+                        home=tmp_path / "nohome", out=out, confirm=lambda p: False)
+        assert code == 1 and "aborted" in stream.getvalue()
+
+    def test_installed_suite_actually_boots(self, tmp_path, openclaw_home):
+        """The init flow's output is a working gateway config: load every
+        enabled plugin from the generated files."""
+        root = self.make_install(tmp_path)
+        out, _ = self.out(tmp_path)
+        run_init(self.args(full=True), start_dir=str(root),
+                 home=tmp_path / "nohome", out=out)
+        merged = read_json(root / "openclaw.json")
+
+        from vainplex_openclaw_tpu.core import Gateway
+        from vainplex_openclaw_tpu.cortex import CortexPlugin
+        from vainplex_openclaw_tpu.events import EventStorePlugin
+        from vainplex_openclaw_tpu.governance import GovernancePlugin
+        from vainplex_openclaw_tpu.knowledge import KnowledgeEnginePlugin
+        from vainplex_openclaw_tpu.sitrep import SitrepPlugin
+
+        classes = {"governance": GovernancePlugin, "cortex": CortexPlugin,
+                   "eventstore": EventStorePlugin,
+                   "knowledge-engine": KnowledgeEnginePlugin, "sitrep": SitrepPlugin}
+        gw = Gateway(config=merged)
+        ws = str(tmp_path / "ws")
+        for plugin_id, entry in merged["plugins"].items():
+            cls = classes[plugin_id]
+            if plugin_id == "eventstore":
+                kwargs = {}
+            elif plugin_id == "governance":
+                kwargs = {"workspace": ws}
+            else:
+                kwargs = {"workspace": ws, "wall_timers": False}
+            gw.load(cls(**kwargs), plugin_config=entry)
+        gw.start()
+        d = gw.before_tool_call("read", {"file_path": "/app/.env"},
+                                {"agent_id": "main", "session_key": "agent:main"})
+        assert d.blocked  # credential guard active from generated config
+        gw.stop()
+
+
+class TestDemo:
+    def test_demo_runs_end_to_end(self, capsys, openclaw_home):
+        from vainplex_openclaw_tpu.cortex.demo import main
+
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "scripted bilingual conversation" in out
+        assert "open=" in out          # tracker state
+        assert "BOOTSTRAP" in out      # boot context regenerated

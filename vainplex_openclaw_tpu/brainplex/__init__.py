@@ -1,0 +1,8 @@
+"""brainplex — the installer CLI (reference: packages/brainplex).
+
+Standalone entry point (``python -m vainplex_openclaw_tpu.brainplex.cli`` or
+the ``brainplex`` console script): discovers the OpenClaw install, generates
+per-plugin default configs, plans and executes plugin enablement, and merges
+plugin entries into openclaw.json — atomically, never overwriting existing
+configs, with timestamped backups.
+"""

@@ -1,0 +1,50 @@
+"""Per-plugin default config generation + timezone detection
+(reference: brainplex/src/configurator.ts)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+# CORE plugins ship in this package; OPTIONAL adds the knowledge engine
+# (reference installer.ts:22-34 — membrane/leuko live in separate repos
+# there; our suite bundles the equivalents that exist here).
+CORE_PLUGINS = ("governance", "cortex", "eventstore", "sitrep")
+OPTIONAL_PLUGINS = ("knowledge-engine",)
+
+
+def detect_timezone() -> str:
+    try:
+        return time.strftime("%Z") or "UTC"
+    except Exception:  # noqa: BLE001
+        return "UTC"
+
+
+def default_config_for(plugin_id: str, agents: Optional[list[str]] = None) -> dict:
+    agents = agents or []
+    if plugin_id == "governance":
+        return {
+            "enabled": True,
+            "failMode": "open",
+            "timezone": detect_timezone(),
+            "builtinPolicies": {"credentialGuard": True, "productionSafeguard": True,
+                                "rateLimiter": {"maxPerMinute": 15}, "nightMode": False},
+            "trust": {"enabled": True,
+                      "defaults": {**{a: 30 for a in agents}, "*": 10}},
+            "redaction": {"enabled": True},
+        }
+    if plugin_id == "cortex":
+        return {"enabled": True, "languages": "both",
+                "bootContext": {"enabled": True},
+                "traceAnalyzer": {"enabled": True}}
+    if plugin_id == "eventstore":
+        return {"enabled": True, "transport": "memory", "prefix": "claw"}
+    if plugin_id == "knowledge-engine":
+        return {"enabled": True, "embeddings": {"backend": "local"}}
+    if plugin_id == "sitrep":
+        return {"enabled": True, "intervalMinutes": 30}
+    return {"enabled": True}
+
+
+def generate_configs(plugin_ids: list[str], agents: list[str]) -> dict[str, dict]:
+    return {pid: default_config_for(pid, agents) for pid in plugin_ids}

@@ -46,14 +46,23 @@ class DecisionTracker:
                 start = max(0, m.start() - 50)
                 end = min(len(content), m.end() + 100)
                 what = content[start:end].strip()
-                if self._is_duplicate(what):
-                    continue
                 why_match = _WHY_RE.search(content, m.end())
+                why = why_match.group(1).strip() if why_match else None
+                if why_match is not None and why_match.start() < end:
+                    # don't repeat the why-clause inside the what window
+                    what = content[start:why_match.start()].strip()
+                # dedupe and impact both consider the full what+why text:
+                # decisions differing only in rationale are distinct, and
+                # high-impact keywords in the rationale still count
+                # (reference decision-tracker.ts infers from what + why)
+                full_text = f"{what} {why}" if why else what
+                if self._is_duplicate(full_text):
+                    continue
                 self.decisions.append({
                     "id": str(uuid.uuid4()),
                     "what": what,
-                    "why": why_match.group(1).strip() if why_match else None,
-                    "impact": self._infer_impact(what),
+                    "why": why,
+                    "impact": self._infer_impact(full_text),
                     "sender": sender,
                     "date": now[:10],
                     "timestamp": now,
@@ -67,14 +76,16 @@ class DecisionTracker:
     def _infer_impact(self, text: str) -> str:
         return self.patterns.infer_priority(text)  # high-impact keywords → "high"
 
-    def _is_duplicate(self, what: str) -> bool:
+    def _is_duplicate(self, text: str) -> bool:
+        """Compare the candidate's full what+why text against stored ones."""
         cutoff_ts = self.clock() - self.config["dedupeWindowHours"] * 3600
         cutoff = iso_now(lambda: cutoff_ts)
-        words = {w for w in what.lower().split() if len(w) > 2}
+        words = {w for w in text.lower().split() if len(w) > 2}
         for d in reversed(self.decisions):
             if d["timestamp"] < cutoff:
                 break
-            d_words = {w for w in d["what"].lower().split() if len(w) > 2}
+            stored = f"{d['what']} {d['why']}" if d.get("why") else d["what"]
+            d_words = {w for w in stored.lower().split() if len(w) > 2}
             union = words | d_words
             if union and len(words & d_words) / len(union) > 0.6:
                 return True

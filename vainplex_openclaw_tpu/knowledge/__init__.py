@@ -1,0 +1,11 @@
+"""Knowledge engine (reference: packages/openclaw-knowledge-engine).
+
+Regex NER over conversation messages → canonical entities; subject-
+predicate-object fact store with relevance decay; optional LLM fact
+extraction; embeddings sync (ChromaDB-shaped HTTP, plus a local on-device
+CortexEncoder index — the TPU-native path); maintenance timers.
+"""
+
+from .plugin import KnowledgeEnginePlugin
+
+__all__ = ["KnowledgeEnginePlugin"]

@@ -1,0 +1,156 @@
+"""Embeddings sync (reference: knowledge-engine/src/embeddings.ts:6-82).
+
+Two backends:
+- ``chroma``: the reference behavior — facts become
+  ``"subject predicate object."`` documents POSTed to a ChromaDB-v2-shaped
+  endpoint (``{name}`` substituted, string-only metadata), via a DI'd
+  ``http_post``.
+- ``local``: the TPU-native path — the CortexEncoder embeds the documents
+  on-device into an in-memory matrix with cosine top-k search; no HTTP, no
+  external vector DB. This is the default in zero-egress environments.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def _default_http_post(url: str, payload: dict, timeout: float = 15.0) -> dict:
+    from urllib.request import Request, urlopen
+
+    req = Request(url, data=json.dumps(payload).encode(),
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=timeout) as resp:  # noqa: S310 — operator-configured endpoint
+        body = resp.read().decode()
+        return json.loads(body) if body else {}
+
+
+def fact_document(fact) -> str:
+    return f"{fact.subject} {fact.predicate.replace('-', ' ')} {fact.object}."
+
+
+def construct_chroma_payload(facts: list) -> dict:
+    payload = {"ids": [], "documents": [], "metadatas": []}
+    for fact in facts:
+        payload["ids"].append(fact.id)
+        payload["documents"].append(fact_document(fact))
+        payload["metadatas"].append({  # v2 requires string-only metadata
+            "subject": fact.subject, "predicate": fact.predicate,
+            "object": fact.object, "source": fact.source,
+            "createdAt": fact.created_at,
+        })
+    return payload
+
+
+class ChromaEmbeddings:
+    def __init__(self, config: dict, logger, http_post: Callable = _default_http_post):
+        self.config = config
+        self.logger = logger
+        self.http_post = http_post
+
+    def enabled(self) -> bool:
+        return bool(self.config.get("enabled"))
+
+    def _endpoint(self) -> str:
+        url = (self.config.get("endpoint") or "").replace(
+            "{name}", self.config.get("collectionName", "facts"))
+        import re
+
+        return re.sub(r"([^:])//", r"\1/", url)
+
+    def sync(self, facts: list) -> int:
+        if not self.enabled() or not facts:
+            return 0
+        try:
+            self.http_post(self._endpoint(), construct_chroma_payload(facts))
+            self.logger.info(f"Synced {len(facts)} facts to ChromaDB")
+            return len(facts)
+        except Exception as exc:  # noqa: BLE001 — embeddings are best-effort
+            self.logger.error(f"Embeddings sync failed: {exc}")
+            return 0
+
+
+class LocalEmbeddings:
+    """On-device fact embeddings: CortexEncoder vector ⊕ hashed bag-of-tokens,
+    cosine top-k by one matmul. The bag-of-tokens half guarantees lexical
+    grounding while the encoder is untrained; once distilled
+    (models/train.py) the learned half carries semantics. Lazy model init
+    (first sync pays compile)."""
+
+    def __init__(self, logger, seed: int = 11, learned_weight: float = 0.5):
+        self.logger = logger
+        self.seed = seed
+        self.learned_weight = learned_weight
+        self._model = None
+        self._ids: list[str] = []
+        self._vectors: Optional[np.ndarray] = None
+        self._docs: dict[str, str] = {}
+
+    def enabled(self) -> bool:
+        return True
+
+    def _embed(self, texts: list[str]) -> np.ndarray:
+        if self._model is None:
+            import jax
+
+            from ..models import EncoderConfig, init_params
+
+            cfg = EncoderConfig()
+            self._model = (cfg, init_params(jax.random.PRNGKey(self.seed), cfg))
+        cfg, params = self._model
+        from ..models import encode_texts, forward
+
+        tokens = encode_texts(texts, cfg.seq_len, cfg.vocab_size)
+        out = forward(params, tokens, cfg)
+        learned = np.asarray(out["embedding"], dtype=np.float32)  # already L2-normed
+
+        bow = np.zeros((len(texts), cfg.vocab_size), dtype=np.float32)
+        for i, row in enumerate(tokens):
+            ids = row[row > 1]  # drop PAD/CLS
+            np.add.at(bow[i], ids, 1.0)
+        norms = np.linalg.norm(bow, axis=1, keepdims=True)
+        bow = np.where(norms > 0, bow / np.maximum(norms, 1e-9), bow)
+
+        w = self.learned_weight
+        return np.concatenate([learned * np.sqrt(w), bow * np.sqrt(1.0 - w)], axis=1)
+
+    def sync(self, facts: list) -> int:
+        if not facts:
+            return 0
+        docs = [fact_document(f) for f in facts]
+        vectors = self._embed(docs)
+        for fact, doc in zip(facts, docs):
+            self._docs[fact.id] = doc
+        new_ids = [f.id for f in facts]
+        if self._vectors is None:
+            self._ids, self._vectors = new_ids, vectors
+        else:
+            keep = [i for i, fid in enumerate(self._ids) if fid not in set(new_ids)]
+            self._ids = [self._ids[i] for i in keep] + new_ids
+            self._vectors = np.concatenate([self._vectors[keep], vectors]) \
+                if keep else vectors
+        return len(facts)
+
+    def search(self, query: str, k: int = 5) -> list[dict]:
+        if self._vectors is None or not self._ids:
+            return []
+        q = self._embed([query])[0]
+        scores = self._vectors @ q
+        order = np.argsort(-scores)[:k]
+        return [{"id": self._ids[i], "document": self._docs.get(self._ids[i], ""),
+                 "score": float(scores[i])} for i in order]
+
+    def count(self) -> int:
+        return len(self._ids)
+
+
+def create_embeddings(config: dict, logger, http_post: Callable = _default_http_post):
+    backend = (config or {}).get("backend", "local")
+    if backend == "chroma":
+        return ChromaEmbeddings(config, logger, http_post)
+    if backend == "local":
+        return LocalEmbeddings(logger)
+    return None

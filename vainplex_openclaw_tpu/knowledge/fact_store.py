@@ -1,0 +1,151 @@
+"""Fact store: subject–predicate–object triples with relevance lifecycle
+(reference: knowledge-engine/src/fact-store.ts:11-264).
+
+Content-dedupe boosts relevance on re-add; relevance decays on a
+maintenance schedule; pruning drops the least relevant facts above the cap;
+persistence is a debounced atomic write of ``facts.json``.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..storage.atomic import AtomicStorage
+
+DEFAULT_STORE_CONFIG = {
+    "maxFacts": 2000,
+    "writeDebounceMs": 2000,
+    "relevanceBoost": 0.2,
+    "decayFactor": 0.95,
+    "pruneBelowRelevance": 0.05,
+}
+
+
+@dataclass
+class Fact:
+    id: str
+    subject: str
+    predicate: str
+    object: str
+    source: str = "extracted-regex"
+    created_at: str = ""
+    last_accessed: str = ""
+    relevance: float = 1.0
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "subject": self.subject, "predicate": self.predicate,
+                "object": self.object, "source": self.source,
+                "createdAt": self.created_at, "lastAccessed": self.last_accessed,
+                "relevance": self.relevance}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fact":
+        return cls(id=d.get("id") or str(uuid.uuid4()),
+                   subject=d.get("subject", ""), predicate=d.get("predicate", ""),
+                   object=d.get("object", ""), source=d.get("source", "unknown"),
+                   created_at=d.get("createdAt", ""),
+                   last_accessed=d.get("lastAccessed", ""),
+                   relevance=float(d.get("relevance", 1.0)))
+
+
+class FactStore:
+    def __init__(self, workspace: str | Path, config: Optional[dict] = None,
+                 logger=None, clock: Callable[[], float] = time.time,
+                 wall_timers: bool = True):
+        self.config = {**DEFAULT_STORE_CONFIG, **(config or {})}
+        self.logger = logger
+        self.clock = clock
+        self.storage = AtomicStorage(Path(workspace) / "knowledge", wall=wall_timers)
+        self.facts: dict[str, Fact] = {}
+        self.loaded = False
+
+    def _iso(self) -> str:
+        t = time.gmtime(self.clock())
+        return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+                f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+
+    def load(self) -> None:
+        if self.loaded:
+            return
+        data = self.storage.load("facts.json")
+        if isinstance(data, dict) and isinstance(data.get("facts"), list):
+            self.facts = {f["id"]: Fact.from_dict(f) for f in data["facts"] if f.get("id")}
+            if self.logger:
+                self.logger.info(f"Loaded {len(self.facts)} facts from storage")
+        self.loaded = True
+
+    def _commit(self) -> None:
+        self.storage.save_debounced(
+            "facts.json",
+            lambda: {"version": 1, "updated": self._iso(),
+                     "facts": [f.to_dict() for f in self.facts.values()]},
+            delay_s=self.config["writeDebounceMs"] / 1000.0)
+
+    def flush(self) -> None:
+        if self.loaded:
+            self.storage.flush_all()
+
+    def add_fact(self, subject: str, predicate: str, object_: str,
+                 source: str = "extracted-regex") -> Fact:
+        if not self.loaded:
+            raise RuntimeError("FactStore not loaded; call load() first")
+        now = self._iso()
+        for fact in self.facts.values():
+            if (fact.subject == subject and fact.predicate == predicate
+                    and fact.object == object_):
+                fact.relevance = min(1.0, fact.relevance + self.config["relevanceBoost"])
+                fact.last_accessed = now
+                self._commit()
+                return fact
+        fact = Fact(id=str(uuid.uuid4()), subject=subject, predicate=predicate,
+                    object=object_, source=source, created_at=now,
+                    last_accessed=now, relevance=1.0)
+        self.facts[fact.id] = fact
+        self._prune()
+        self._commit()
+        return fact
+
+    def query(self, subject: Optional[str] = None, predicate: Optional[str] = None,
+              text: Optional[str] = None, limit: int = 50) -> list[Fact]:
+        out = []
+        needle = (text or "").lower()
+        for fact in self.facts.values():
+            if subject and fact.subject.lower() != subject.lower():
+                continue
+            if predicate and fact.predicate.lower() != predicate.lower():
+                continue
+            if needle and needle not in f"{fact.subject} {fact.predicate} {fact.object}".lower():
+                continue
+            out.append(fact)
+        out.sort(key=lambda f: -f.relevance)
+        return out[:limit]
+
+    def decay_facts(self) -> int:
+        """One decay tick: relevance *= decayFactor; prune below threshold."""
+        factor = self.config["decayFactor"]
+        threshold = self.config["pruneBelowRelevance"]
+        dead = []
+        for fact in self.facts.values():
+            fact.relevance *= factor
+            if fact.relevance < threshold:
+                dead.append(fact.id)
+        for fid in dead:
+            del self.facts[fid]
+        if dead or self.facts:
+            self._commit()
+        return len(dead)
+
+    def _prune(self) -> None:
+        cap = self.config["maxFacts"]
+        if len(self.facts) <= cap:
+            return
+        ordered = sorted(self.facts.values(), key=lambda f: f.relevance)
+        for fact in ordered[: len(self.facts) - cap]:
+            del self.facts[fact.id]
+
+    def count(self) -> int:
+        return len(self.facts)

@@ -1,0 +1,62 @@
+"""Maintenance: relevance decay + embeddings sync on interval timers
+(reference: knowledge-engine/src/maintenance.ts:32-90 — unref'd timers; here
+daemon threads, or manual ``run_*`` ticks when wall timers are off)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Maintenance:
+    def __init__(self, fact_store, embeddings, logger,
+                 decay_hours: float = 24.0, sync_minutes: float = 30.0,
+                 wall_timers: bool = True):
+        self.fact_store = fact_store
+        self.embeddings = embeddings
+        self.logger = logger
+        self.decay_hours = decay_hours
+        self.sync_minutes = sync_minutes
+        self.wall_timers = wall_timers
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._synced_ids: set = set()
+
+    def run_decay(self) -> int:
+        pruned = self.fact_store.decay_facts()
+        if pruned:
+            self.logger.info(f"decay pruned {pruned} stale facts")
+        return pruned
+
+    def run_embeddings_sync(self) -> int:
+        if self.embeddings is None or not self.embeddings.enabled():
+            return 0
+        pending = [f for f in self.fact_store.facts.values()
+                   if f.id not in self._synced_ids]
+        if not pending:
+            return 0
+        n = self.embeddings.sync(pending)
+        if n:
+            self._synced_ids.update(f.id for f in pending[:n])
+        return n
+
+    def _loop(self, interval_s: float, fn) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(f"maintenance tick failed: {exc}")
+
+    def start(self) -> None:
+        if not self.wall_timers:
+            return
+        for interval, fn, name in ((self.decay_hours * 3600, self.run_decay, "ke-decay"),
+                                   (self.sync_minutes * 60, self.run_embeddings_sync,
+                                    "ke-embeddings")):
+            t = threading.Thread(target=self._loop, args=(interval, fn),
+                                 daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,160 @@
+"""Knowledge engine plugin (reference: knowledge-engine/index.ts:7-39,
+src/hooks.ts:19-124).
+
+Hook layout: session_start @200 loads the store + starts maintenance;
+message_received/message_sent @100 extract entities→facts (+ optional LLM
+batch); gateway_stop @900 flushes and stops timers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..config.loader import load_plugin_config
+from ..core.api import PluginCommand, PluginService
+from .embeddings import create_embeddings
+from .entity_extractor import EntityExtractor
+from .fact_store import FactStore
+from .llm_enhancer import KnowledgeLlmEnhancer
+from .maintenance import Maintenance
+
+DEFAULTS = {
+    "enabled": True,
+    "workspace": None,
+    "storage": {"maxFacts": 2000, "writeDebounceMs": 2000},
+    "extraction": {"minImportance": 0.5, "mentionPredicate": "mentioned"},
+    "llm": {"enabled": False, "batchSize": 3},
+    "embeddings": {"backend": "local", "enabled": True,
+                   "endpoint": "http://localhost:8000/api/v2/collections/{name}/upsert",
+                   "collectionName": "openclaw-facts"},
+    "maintenance": {"decayHours": 24, "syncMinutes": 30},
+}
+
+
+class KnowledgeEnginePlugin:
+    id = "knowledge-engine"
+
+    def __init__(self, workspace: Optional[str] = None,
+                 clock: Callable[[], float] = time.time,
+                 call_llm=None, wall_timers: bool = True, http_post=None):
+        self._workspace_override = workspace
+        self.clock = clock
+        self.call_llm = call_llm
+        self.wall_timers = wall_timers
+        self.http_post = http_post
+        self.config: dict = {}
+        self.extractor: Optional[EntityExtractor] = None
+        self.fact_store: Optional[FactStore] = None
+        self.embeddings = None
+        self.maintenance: Optional[Maintenance] = None
+        self.enhancer: Optional[KnowledgeLlmEnhancer] = None
+
+    def register(self, api) -> None:
+        self.config = load_plugin_config(self.id, api.plugin_config,
+                                         defaults=DEFAULTS, logger=api.logger)
+        if not self.config.get("enabled", True):
+            api.logger.info("disabled via config")
+            return
+        self.logger = api.logger
+        workspace = (self._workspace_override or self.config.get("workspace")
+                     or api.config.get("workspace") or ".")
+        self.extractor = EntityExtractor(api.logger, clock=self.clock)
+        self.fact_store = FactStore(workspace, self.config.get("storage"),
+                                    api.logger, clock=self.clock,
+                                    wall_timers=self.wall_timers)
+        kwargs = {"http_post": self.http_post} if self.http_post else {}
+        self.embeddings = create_embeddings(self.config.get("embeddings"),
+                                            api.logger, **kwargs)
+        mcfg = self.config.get("maintenance", {})
+        self.maintenance = Maintenance(self.fact_store, self.embeddings, api.logger,
+                                       decay_hours=mcfg.get("decayHours", 24),
+                                       sync_minutes=mcfg.get("syncMinutes", 30),
+                                       wall_timers=self.wall_timers)
+        if self.config.get("llm", {}).get("enabled") and self.call_llm is not None:
+            self.enhancer = KnowledgeLlmEnhancer(self.call_llm, api.logger,
+                                                 self.config["llm"].get("batchSize", 3))
+
+        api.on("session_start", self._on_session_start, priority=200)
+        api.on("message_received", self._on_message, priority=100)
+        api.on("message_sent", self._on_message, priority=100)
+        api.on("gateway_stop", self._on_gateway_stop, priority=900)
+        api.register_service(PluginService(
+            id="knowledge-engine",
+            start=lambda ctx: self._ensure_loaded(),
+            stop=lambda ctx: self._shutdown()))
+        api.register_command(PluginCommand(
+            name="knowledge", description="Knowledge engine status + search",
+            accepts_args=True,
+            handler=lambda ctx: {"text": self.status_text(ctx.get("args", ""))}))
+
+    # ── lifecycle ────────────────────────────────────────────────────
+
+    def _ensure_loaded(self) -> None:
+        if not self.fact_store.loaded:
+            self.fact_store.load()
+            self.maintenance.start()
+
+    def _shutdown(self) -> None:
+        if self.maintenance is not None:
+            self.maintenance.stop()
+        if self.fact_store is not None:
+            self.fact_store.flush()
+
+    # ── hooks ────────────────────────────────────────────────────────
+
+    def _on_session_start(self, event: dict, ctx: dict):
+        try:
+            self._ensure_loaded()
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"session_start failed: {exc}")
+        return None
+
+    def _on_message(self, event: dict, ctx: dict):
+        try:
+            content = event.get("content") or ""
+            if not content:
+                return None
+            self._ensure_loaded()
+            min_importance = self.config.get("extraction", {}).get("minImportance", 0.5)
+            predicate = self.config.get("extraction", {}).get("mentionPredicate", "mentioned")
+            for entity in self.extractor.extract(content):
+                if entity.importance < min_importance:
+                    continue
+                self.fact_store.add_fact("conversation", predicate, entity.value,
+                                         source="extracted-regex")
+            if self.enhancer is not None:
+                facts = self.enhancer.add_to_batch(content)
+                for f in facts or []:
+                    self.fact_store.add_fact(f["subject"], f["predicate"], f["object"],
+                                             source="extracted-llm")
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"message extraction failed: {exc}")
+        return None
+
+    def _on_gateway_stop(self, event: dict, ctx: dict):
+        try:
+            self._shutdown()
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(f"gateway_stop failed: {exc}")
+        return None
+
+    # ── status ───────────────────────────────────────────────────────
+
+    def status_text(self, args: str = "") -> str:
+        self._ensure_loaded()
+        query = args.strip()
+        if query:
+            results = self.fact_store.query(text=query, limit=5)
+            lines = [f"📚 facts matching {query!r}:"]
+            lines += [f"  {f.subject} {f.predicate} {f.object} "
+                      f"(rel={f.relevance:.2f}, {f.source})" for f in results]
+            if hasattr(self.embeddings, "search") and self.embeddings.count():
+                lines.append("  semantic:")
+                lines += [f"    {r['document']} ({r['score']:.2f})"
+                          for r in self.embeddings.search(query, k=3)]
+            return "\n".join(lines)
+        n_vec = self.embeddings.count() if hasattr(self.embeddings, "count") else "n/a"
+        return (f"📚 knowledge: {self.fact_store.count()} facts, "
+                f"{n_vec} embedded "
+                f"(backend={self.config.get('embeddings', {}).get('backend')})")

@@ -1,0 +1,56 @@
+"""Sitrep aggregation + health rollup (reference:
+openclaw-sitrep/src/aggregator.ts:19-44 + service.ts)."""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..storage.atomic import read_json, write_json_atomic
+from .collectors import BUILTIN_COLLECTORS, run_custom_collector, safe_collect
+
+HEALTH_ORDER = {"ok": 0, "skipped": 0, "warn": 1, "error": 2}
+
+
+def rollup_health(results: dict) -> str:
+    worst = 0
+    for result in results.values():
+        worst = max(worst, HEALTH_ORDER.get(result.get("status"), 1))
+    return ("healthy", "degraded", "unhealthy")[worst]
+
+
+def generate_sitrep(config: dict, ctx: dict, logger,
+                    clock: Callable[[], float] = time.time) -> dict:
+    results: dict = {}
+    collectors_cfg = config.get("collectors", {})
+    for name, fn in BUILTIN_COLLECTORS.items():
+        results[name] = safe_collect(name, fn, collectors_cfg.get(name, {"enabled": False}),
+                                     ctx, logger)
+    for definition in config.get("customCollectors", []):
+        start = time.perf_counter()
+        try:
+            result = run_custom_collector(definition)
+        except Exception as exc:  # noqa: BLE001
+            result = {"status": "error", "items": [], "summary": f"error: {exc}",
+                      "error": str(exc)}
+        result["duration_ms"] = round((time.perf_counter() - start) * 1000, 2)
+        results[f"custom:{definition.get('id', '?')}"] = result
+
+    t = time.gmtime(clock())
+    return {
+        "generatedAt": (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+                        f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z"),
+        "health": rollup_health(results),
+        "collectors": results,
+    }
+
+
+def write_sitrep(report: dict, workspace: str | Path) -> Path:
+    """Write sitrep.json, rotating the previous one to sitrep.previous.json."""
+    path = Path(workspace) / "sitrep.json"
+    previous = read_json(path)
+    if previous is not None:
+        write_json_atomic(path.with_name("sitrep.previous.json"), previous)
+    write_json_atomic(path, report)
+    return path

@@ -1,0 +1,137 @@
+"""Sitrep collectors (reference: openclaw-sitrep/src/collectors/*).
+
+Six built-ins — systemd_timers (shells out to systemctl), nats (event-store
+status probe), goals, threads (reads Cortex threads.json), errors (audit
+denials + hook errors), calendar — plus custom shell-command collectors.
+Each runs through ``safe_collect`` so a broken collector degrades to an
+error entry, never a crashed sitrep.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..storage.atomic import read_json, read_jsonl
+
+
+def collect_systemd_timers(config: dict, ctx: dict) -> dict:
+    try:
+        proc = subprocess.run(
+            ["systemctl", "list-timers", "--no-pager", "--no-legend"],
+            capture_output=True, text=True, timeout=config.get("timeoutS", 5))
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return {"status": "error", "items": [], "summary": f"systemctl unavailable: {exc}"}
+    items = []
+    for line in proc.stdout.splitlines():
+        parts = line.split()
+        if len(parts) >= 2:
+            items.append({"raw": line.strip(), "unit": next(
+                (p for p in parts if p.endswith(".timer")), parts[-1])})
+    return {"status": "ok", "items": items, "summary": f"{len(items)} timers"}
+
+
+def collect_nats(config: dict, ctx: dict) -> dict:
+    status_fn = ctx.get("eventstore_status")
+    if status_fn is None:
+        return {"status": "skipped", "items": [], "summary": "no event store wired"}
+    s = status_fn()
+    health = "ok" if s.get("healthy") else "warn"
+    return {"status": health,
+            "items": [s],
+            "summary": (f"{s.get('transport', '?')} published={s.get('published', 0)} "
+                        f"failures={s.get('publish_failures', 0)}")}
+
+
+def collect_goals(config: dict, ctx: dict) -> dict:
+    path = Path(config.get("path") or (Path(ctx.get("workspace", ".")) / "goals.json"))
+    data = read_json(path)
+    if data is None:
+        return {"status": "skipped", "items": [], "summary": "no goals file"}
+    goals = data.get("goals", data) if isinstance(data, dict) else data
+    items = [g for g in goals if isinstance(g, dict)]
+    open_goals = [g for g in items if g.get("status", "open") == "open"]
+    return {"status": "ok", "items": items, "summary": f"{len(open_goals)} open goals"}
+
+
+def collect_threads(config: dict, ctx: dict) -> dict:
+    """Reads the Cortex threads.json directly — the suite's file-mediated
+    cross-plugin convention."""
+    workspace = Path(ctx.get("workspace", "."))
+    data = read_json(workspace / "memory" / "reboot" / "threads.json")
+    if not isinstance(data, dict):
+        return {"status": "skipped", "items": [], "summary": "no thread data"}
+    threads = data.get("threads") or []
+    open_threads = [t for t in threads if t.get("status") == "open"]
+    waiting = [t for t in open_threads if t.get("waiting_for")]
+    return {"status": "warn" if waiting else "ok",
+            "items": [{"title": t["title"], "priority": t.get("priority"),
+                       "waiting_for": t.get("waiting_for")} for t in open_threads],
+            "summary": f"{len(open_threads)} open ({len(waiting)} blocked)"}
+
+
+def collect_errors(config: dict, ctx: dict) -> dict:
+    workspace = Path(ctx.get("workspace", "."))
+    audit_dir = workspace / "governance" / "audit"
+    denials = []
+    if audit_dir.exists():
+        files = sorted(audit_dir.glob("*.jsonl"))[-2:]
+        for f in files:
+            for rec in read_jsonl(f):
+                if rec.get("verdict") == "deny":
+                    denials.append({"reason": rec.get("reason"),
+                                    "tool": (rec.get("context") or {}).get("toolName")})
+    status = "warn" if denials else "ok"
+    return {"status": status, "items": denials[-20:],
+            "summary": f"{len(denials)} recent policy denials"}
+
+
+def collect_calendar(config: dict, ctx: dict) -> dict:
+    path = config.get("path")
+    if not path:
+        return {"status": "skipped", "items": [], "summary": "no calendar configured"}
+    data = read_json(path)
+    events = (data or {}).get("events", []) if isinstance(data, dict) else (data or [])
+    return {"status": "ok", "items": events[:20], "summary": f"{len(events)} events"}
+
+
+BUILTIN_COLLECTORS: dict[str, Callable] = {
+    "systemd_timers": collect_systemd_timers,
+    "nats": collect_nats,
+    "goals": collect_goals,
+    "threads": collect_threads,
+    "errors": collect_errors,
+    "calendar": collect_calendar,
+}
+
+
+def run_custom_collector(definition: dict, timeout_s: float = 10.0) -> dict:
+    proc = subprocess.run(definition["command"], shell=True, capture_output=True,
+                          text=True, timeout=definition.get("timeoutS", timeout_s))
+    output = proc.stdout.strip()
+    try:
+        items = json.loads(output)
+        if not isinstance(items, list):
+            items = [items]
+    except json.JSONDecodeError:
+        items = [{"raw": line} for line in output.splitlines()[:20]]
+    status = "ok" if proc.returncode == 0 else "error"
+    return {"status": status, "items": items,
+            "summary": f"exit={proc.returncode}, {len(items)} items"}
+
+
+def safe_collect(name: str, fn: Callable, config: dict, ctx: dict, logger) -> dict:
+    if not config.get("enabled", False):
+        return {"status": "skipped", "items": [], "summary": "disabled", "duration_ms": 0}
+    start = time.perf_counter()
+    try:
+        result = fn(config, ctx)
+    except Exception as exc:  # noqa: BLE001 — one collector must not kill the sitrep
+        logger.warn(f"collector {name} failed: {exc}")
+        result = {"status": "error", "items": [], "summary": f"error: {exc}",
+                  "error": str(exc)}
+    result["duration_ms"] = round((time.perf_counter() - start) * 1000, 2)
+    return result

@@ -1,0 +1,89 @@
+"""Sitrep plugin: interval generation service + /sitrep command
+(reference: openclaw-sitrep/src/service.ts:28-68)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..config.loader import load_plugin_config
+from ..core.api import PluginCommand, PluginService
+from .aggregator import generate_sitrep, write_sitrep
+
+DEFAULTS = {
+    "enabled": True,
+    "workspace": None,
+    "intervalMinutes": 30,
+    "collectors": {
+        "systemd_timers": {"enabled": False},
+        "nats": {"enabled": True},
+        "goals": {"enabled": True},
+        "threads": {"enabled": True},
+        "errors": {"enabled": True},
+        "calendar": {"enabled": False},
+    },
+    "customCollectors": [],
+}
+
+
+class SitrepPlugin:
+    id = "sitrep"
+
+    def __init__(self, workspace: Optional[str] = None,
+                 clock: Callable[[], float] = time.time, wall_timers: bool = True):
+        self._workspace_override = workspace
+        self.clock = clock
+        self.wall_timers = wall_timers
+        self.config: dict = {}
+        self._stop = threading.Event()
+        self._gateway = None
+
+    def register(self, api) -> None:
+        self.config = load_plugin_config(self.id, api.plugin_config,
+                                         defaults=DEFAULTS, logger=api.logger)
+        if not self.config.get("enabled", True):
+            api.logger.info("disabled via config")
+            return
+        self.logger = api.logger
+        self._gateway = api._gateway
+        api.register_service(PluginService(id="sitrep", start=self._start,
+                                           stop=lambda ctx: self._stop.set()))
+        api.register_command(PluginCommand(
+            name="sitrep", description="Generate a situation report now",
+            handler=lambda ctx: {"text": self.sitrep_text()}))
+
+    def _ctx(self) -> dict:
+        ctx = {"workspace": (self._workspace_override or self.config.get("workspace")
+                             or ".")}
+        if self._gateway is not None and "eventstore.status" in self._gateway.methods:
+            ctx["eventstore_status"] = lambda: self._gateway.call_method("eventstore.status")
+        return ctx
+
+    def generate(self) -> dict:
+        report = generate_sitrep(self.config, self._ctx(), self.logger, self.clock)
+        write_sitrep(report, self._ctx()["workspace"])
+        return report
+
+    def _start(self, ctx) -> None:
+        self.generate()  # initial sitrep on start (reference service.ts:32)
+        minutes = self.config.get("intervalMinutes") or 0
+        if minutes > 0 and self.wall_timers:
+            def loop():
+                while not self._stop.wait(minutes * 60):
+                    try:
+                        self.generate()
+                    except Exception as exc:  # noqa: BLE001
+                        self.logger.error(f"sitrep generation failed: {exc}")
+
+            threading.Thread(target=loop, daemon=True, name="sitrep").start()
+
+    def sitrep_text(self) -> str:
+        report = self.generate()
+        lines = [f"📋 sitrep: {report['health']} ({report['generatedAt']})"]
+        for name, result in report["collectors"].items():
+            if result["status"] == "skipped":
+                continue
+            icon = {"ok": "✅", "warn": "⚠️", "error": "❌"}.get(result["status"], "•")
+            lines.append(f"  {icon} {name}: {result['summary']}")
+        return "\n".join(lines)
