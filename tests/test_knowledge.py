@@ -228,3 +228,44 @@ class TestPlugin:
         plugin = KnowledgeEnginePlugin(workspace=str(workspace))
         gw.load(plugin, plugin_config={"enabled": False})
         assert gw.bus.handlers_for("message_received") == []
+
+
+class TestRegressions:
+    """Fixes from review: entity-id slugs, pruned-fact index reconciliation,
+    partial LLM batch flush on shutdown."""
+
+    def test_multiword_entity_id_is_dashed(self):
+        extractor = EntityExtractor(list_logger(), clock=FakeClock())
+        entities = extractor.extract("I spoke with Klaus Schmidt yesterday")
+        ids = {e.id for e in entities}
+        assert any(i.endswith(":klaus-schmidt") for i in ids), ids
+        assert not any(" " in i for i in ids)
+
+    def test_pruned_facts_leave_embedding_index(self, tmp_path):
+        store = FactStore(tmp_path, {"decayFactor": 0.1, "pruneBelowRelevance": 0.3},
+                          list_logger(), clock=FakeClock(), wall_timers=False)
+        store.load()
+        store.add_fact("redis", "is", "down")
+        emb = LocalEmbeddings(list_logger())
+        m = Maintenance(store, emb, list_logger(), wall_timers=False)
+        assert m.run_embeddings_sync() == 1
+        assert emb.count() == 1
+        store.decay_facts()  # relevance * 0.1 → pruned
+        assert store.count() == 0
+        m.run_embeddings_sync()
+        assert emb.count() == 0
+        assert emb.search("redis") == []
+
+    def test_partial_llm_batch_flushed_on_stop(self, workspace, openclaw_home):
+        llm = lambda p: '{"facts": [{"subject": "anna", "predicate": "role", "object": "CTO"}]}'  # noqa: E731
+        gw, _ = make_gateway()
+        plugin = KnowledgeEnginePlugin(workspace=str(workspace), clock=gw.clock,
+                                       call_llm=llm, wall_timers=False)
+        gw.load(plugin, plugin_config={"enabled": True,
+                                       "llm": {"enabled": True, "batchSize": 5}})
+        gw.start()
+        gw.message_received("anna is our CTO", {"session_key": "s"})
+        assert plugin.fact_store.query(subject="anna") == []  # still batched
+        gw.stop()
+        facts = plugin.fact_store.query(subject="anna")
+        assert facts and facts[0].object == "CTO"

@@ -255,3 +255,64 @@ class TestDemo:
         assert "scripted bilingual conversation" in out
         assert "open=" in out          # tracker state
         assert "BOOTSTRAP" in out      # boot context regenerated
+
+
+class TestBrainplexRegressions:
+    """Fixes from review: JSON5 merge safety, --config honored, no wipe."""
+
+    def args(self, **over):
+        return {"command": "init", "full": False, "dry_run": False, "config": None,
+                "no_color": True, "verbose": True, "yes": True, **over}
+
+    def out(self):
+        import io
+
+        stream = io.StringIO()
+        return Output(color=False, verbose=True, stream=stream), stream
+
+    def test_json5_config_survives_merge(self, tmp_path):
+        root = tmp_path / "install"
+        root.mkdir()
+        (root / "openclaw.json").write_text(
+            '{\n  // my agents\n  "agents": {"list": ["main"]},\n'
+            '  "theme": "dark",\n}\n', encoding="utf-8")
+        out, _ = self.out()
+        assert run_init(self.args(), start_dir=str(root),
+                        home=tmp_path / "nohome", out=out) == 0
+        merged = read_json(root / "openclaw.json")
+        assert merged["theme"] == "dark"           # user settings preserved
+        assert merged["agents"] == {"list": ["main"]}
+        assert "governance" in merged["plugins"]
+        backups = list(root.glob("openclaw.json.backup-*"))
+        assert "// my agents" in backups[0].read_text()  # raw original backed up
+
+    def test_unparseable_config_never_wiped(self, tmp_path):
+        bad = tmp_path / "openclaw.json"
+        bad.write_text("{definitely not json", encoding="utf-8")
+        result = update_openclaw_config(bad, {"governance": {"enabled": True}})
+        assert result["action"] == "error"
+        assert bad.read_text() == "{definitely not json"
+
+    def test_explicit_config_flag_honored(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        custom = proj / "custom.json"
+        write_json_atomic(custom, {"agents": {"list": ["solo"]}})
+        # decoy discoverable config elsewhere that must NOT be touched
+        decoy_home = tmp_path / "home" / ".openclaw"
+        decoy_home.mkdir(parents=True)
+        write_json_atomic(decoy_home / "openclaw.json", {"agents": {"list": ["decoy"]}})
+        out, stream = self.out()
+        code = run_init(self.args(config=str(custom)), start_dir=str(tmp_path),
+                        home=tmp_path / "home", out=out)
+        assert code == 0
+        assert "solo" in stream.getvalue()
+        assert "plugins" in read_json(custom)
+        assert "plugins" not in read_json(decoy_home / "openclaw.json")
+
+    def test_explicit_config_missing_errors(self, tmp_path):
+        out, stream = self.out()
+        code = run_init(self.args(config=str(tmp_path / "nope.json")),
+                        start_dir=str(tmp_path), home=tmp_path / "nohome", out=out)
+        assert code == 1
+        assert "unreadable" in stream.getvalue()

@@ -101,18 +101,14 @@ def run_init(args: dict, start_dir: Optional[str] = None,
     out = out or Output(color=not args["no_color"], verbose=args["verbose"])
     start_dir = start_dir or os.getcwd()
 
-    # 1-2: scan environment
-    result = scan(start_dir, home=home)
+    # 1-2: scan environment (an explicit --config path is read directly,
+    # never replaced by discovery)
+    result = scan(start_dir, home=home, config_path=args["config"])
     out.info(f"runtime: {result['runtime']}" +
              ("" if result["runtime_ok"] else "  (unsupported!)"))
     if not result["runtime_ok"]:
         out.error("unsupported runtime version")
         return 1
-    if args["config"]:
-        result["config_path"] = args["config"]
-        fresh = scan(Path(args["config"]).parent, home=home)
-        if fresh["config_path"]:
-            result.update(fresh)
     if result["config_path"] is None:
         out.error("no openclaw.json found (walked up to root and ~/.openclaw)")
         return 1

@@ -79,9 +79,22 @@ def extract_agents(config: dict) -> list[str]:
     return []
 
 
-def scan(start_dir: str | Path, home: Optional[Path] = None) -> dict:
+def scan(start_dir: str | Path, home: Optional[Path] = None,
+         config_path: Optional[str | Path] = None) -> dict:
+    """Scan the environment. An explicit ``config_path`` skips discovery and
+    is read directly (missing/unparseable file surfaces as ``parse_error``)."""
     runtime_ok, runtime = check_runtime()
-    config_path = find_config(start_dir, home)
+    if config_path is not None:
+        config_path = Path(config_path)
+        if not config_path.exists():
+            return {
+                "runtime": runtime, "runtime_ok": runtime_ok,
+                "config_path": str(config_path), "config": {},
+                "parse_error": "file not found", "agents": [],
+                "existing_plugins": [],
+            }
+    else:
+        config_path = find_config(start_dir, home)
     config: dict = {}
     parse_error = None
     if config_path is not None:

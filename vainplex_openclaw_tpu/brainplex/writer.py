@@ -9,7 +9,8 @@ import time
 from pathlib import Path
 from typing import Callable, Optional
 
-from ..storage.atomic import read_json, write_json_atomic
+from ..storage.atomic import write_json_atomic
+from .scanner import parse_config
 
 
 def backup_path(path: Path, clock: Callable[[], float] = time.time) -> Path:
@@ -37,7 +38,17 @@ def update_openclaw_config(path: str | Path, plugin_entries: dict,
     """Merge plugin pointer entries into openclaw.json (existing entries
     win), with a timestamped backup of the original first."""
     path = Path(path)
-    existing = read_json(path, {}) or {}
+    raw = path.read_text(encoding="utf-8") if path.exists() else ""
+    if raw.strip():
+        try:
+            existing = parse_config(raw)
+        except (json.JSONDecodeError, ValueError):
+            # Never merge over a config we failed to parse — a wipe here
+            # would destroy the user's agents/settings.
+            return {"path": str(path), "action": "error", "added": [],
+                    "error": "could not parse existing openclaw.json; not modifying it"}
+    else:
+        existing = {}
     plugins = dict(existing.get("plugins") or {})
     added = []
     for plugin_id, entry in plugin_entries.items():
@@ -50,7 +61,7 @@ def update_openclaw_config(path: str | Path, plugin_entries: dict,
         return {"path": str(path), "action": "would-update", "added": added}
     if path.exists():
         backup = backup_path(path, clock)
-        backup.write_text(json.dumps(existing, indent=2), encoding="utf-8")
+        backup.write_text(raw, encoding="utf-8")
     merged = {**existing, "plugins": plugins}
     write_json_atomic(path, merged)
     return {"path": str(path), "action": "updated", "added": added}

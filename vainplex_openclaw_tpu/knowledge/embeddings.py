@@ -143,6 +143,20 @@ class LocalEmbeddings:
         return [{"id": self._ids[i], "document": self._docs.get(self._ids[i], ""),
                  "score": float(scores[i])} for i in order]
 
+    def remove(self, ids) -> int:
+        """Drop pruned facts from the index so search never returns them."""
+        dead = set(ids)
+        if self._vectors is None or not dead:
+            return 0
+        keep = [i for i, fid in enumerate(self._ids) if fid not in dead]
+        removed = len(self._ids) - len(keep)
+        if removed:
+            self._ids = [self._ids[i] for i in keep]
+            self._vectors = self._vectors[keep] if keep else None
+            for fid in dead:
+                self._docs.pop(fid, None)
+        return removed
+
     def count(self) -> int:
         return len(self._ids)
 

@@ -118,7 +118,8 @@ class EntityExtractor:
         canonical = canonicalize(value, entity_type)
         if not canonical:
             return
-        entity_id = f"{entity_type}:{re.sub(r'\\s+', '-', canonical.lower())}"
+        slug = re.sub(r"\s+", "-", canonical.lower())
+        entity_id = f"{entity_type}:{slug}"
         existing = found.get(entity_id)
         if existing is not None:
             if value not in existing.mentions:

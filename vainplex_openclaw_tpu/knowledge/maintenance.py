@@ -31,13 +31,20 @@ class Maintenance:
     def run_embeddings_sync(self) -> int:
         if self.embeddings is None or not self.embeddings.enabled():
             return 0
+        # Reconcile prunes first (decay / maxFacts cap) so the index never
+        # keeps serving facts the store has deleted.
+        current = set(self.fact_store.facts.keys())
+        dead = self._synced_ids - current
+        if dead and hasattr(self.embeddings, "remove"):
+            self.embeddings.remove(dead)
+        self._synced_ids &= current
         pending = [f for f in self.fact_store.facts.values()
                    if f.id not in self._synced_ids]
         if not pending:
             return 0
         n = self.embeddings.sync(pending)
         if n:
-            self._synced_ids.update(f.id for f in pending[:n])
+            self._synced_ids.update(f.id for f in pending)
         return n
 
     def _loop(self, interval_s: float, fn) -> None:

@@ -98,6 +98,11 @@ class KnowledgeEnginePlugin:
     def _shutdown(self) -> None:
         if self.maintenance is not None:
             self.maintenance.stop()
+        if self.enhancer is not None and self.fact_store is not None:
+            # Flush a partial LLM batch so short sessions still extract facts.
+            for f in self.enhancer.send_batch() or []:
+                self.fact_store.add_fact(f["subject"], f["predicate"], f["object"],
+                                         source="extracted-llm")
         if self.fact_store is not None:
             self.fact_store.flush()
 

@@ -1,6 +1,7 @@
 """Flagship numeric models backing the framework's analysis surfaces."""
 
 from .encoder import EncoderConfig, forward, init_params
+from .long_context import forward_long
 from .tokenizer import encode_texts
 
-__all__ = ["EncoderConfig", "encode_texts", "forward", "init_params"]
+__all__ = ["EncoderConfig", "encode_texts", "forward", "forward_long", "init_params"]

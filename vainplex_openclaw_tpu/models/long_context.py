@@ -1,0 +1,83 @@
+"""Long-context path for the flagship encoder: sequence-parallel forward.
+
+``forward_long`` runs the exact same computation as ``models.encoder.forward``
+but sharded over a (dp, sp) mesh: tokens are split along the sequence axis,
+every transformer block uses ring attention (parallel/ring_attention.py) so
+no device ever materialises the full L×L score matrix or even the full
+sequence of activations, and the masked mean-pool is a ``psum`` over the
+``sp`` axis. Activation memory per device scales as L/sp — sequences sp×
+longer than single-chip capacity run unchanged.
+
+Numerically equivalent to the dense forward (tests/test_parallel.py asserts
+parity); positions are recovered per-shard with ``axis_index``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax ≥ 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..parallel.ring_attention import ring_attention_local
+from .encoder import EncoderConfig, _rmsnorm
+
+
+def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
+                 mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp") -> dict:
+    """tokens [B, L] int32, L divisible by the sp axis size → same outputs as
+    ``encoder.forward``: {severity, keep, mood, embedding} with batch sharded
+    over dp and sequence memory spread over sp."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), P(dp_axis, sp_axis)),
+             out_specs={"severity": P(dp_axis, None), "keep": P(dp_axis, None),
+                        "mood": P(dp_axis, None), "embedding": P(dp_axis, None)},
+             check_vma=False)
+    def run(params, tokens):
+        sp_idx = jax.lax.axis_index(sp_axis)
+        B, L_loc = tokens.shape
+        dt = cfg.dtype
+        mask = tokens > 0
+
+        pos = jax.lax.dynamic_slice_in_dim(
+            params["embed"]["pos"], sp_idx * L_loc, L_loc, axis=0)
+        x = params["embed"]["tok"].astype(dt)[tokens] + pos.astype(dt)[None, :, :]
+
+        H, Dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+        for p in params["blocks"]:
+            h = _rmsnorm(x, p["norm1"]["scale"])
+            a = p["attn"]
+
+            def heads(w):
+                return (h @ w.astype(dt)).reshape(B, L_loc, H, Dh).transpose(0, 2, 1, 3)
+
+            out = ring_attention_local(heads(a["q"]), heads(a["k"]), heads(a["v"]),
+                                       mask, axis_name=sp_axis)
+            out = out.transpose(0, 2, 1, 3).reshape(B, L_loc, cfg.d_model)
+            x = x + out @ a["o"].astype(dt)
+            h = _rmsnorm(x, p["norm2"]["scale"])
+            x = x + jax.nn.gelu(h @ p["mlp"]["w1"].astype(dt)) @ p["mlp"]["w2"].astype(dt)
+
+        x = _rmsnorm(x, params["final_norm"]["scale"])
+        local_sum = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1)
+        pooled = jax.lax.psum(local_sum, sp_axis)
+        count = jax.lax.psum(mask.sum(axis=1), sp_axis)
+        pooled = pooled / jnp.maximum(count, 1)[:, None].astype(jnp.float32)
+
+        heads_p = params["heads"]
+        emb = pooled @ heads_p["embed_proj"]
+        return {
+            "severity": pooled @ heads_p["severity"],
+            "keep": pooled @ heads_p["keep"],
+            "mood": pooled @ heads_p["mood"],
+            "embedding": emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-6),
+        }
+
+    return run(params, tokens)

@@ -1,5 +1,7 @@
 """Device-mesh and sharding utilities for the TPU numeric layer."""
 
 from .mesh import make_mesh, batch_sharding, replicated, shard_params
+from .ring_attention import dense_attention_reference, ring_attention, ring_attention_local
 
-__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params"]
+__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_params",
+           "ring_attention", "ring_attention_local", "dense_attention_reference"]
