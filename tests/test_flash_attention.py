@@ -1,0 +1,84 @@
+"""Pallas flash-attention kernel parity tests (interpreter on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.models import EncoderConfig, encode_texts, forward, init_params
+from vainplex_openclaw_tpu.ops.flash_attention import flash_attention
+from vainplex_openclaw_tpu.parallel.ring_attention import dense_attention_reference
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    key = jax.random.PRNGKey(0)
+    B, H, L, Dh = 2, 4, 64, 32
+    q, k, v = (jax.random.normal(kk, (B, H, L, Dh)) for kk in jax.random.split(key, 3))
+    mask = jnp.arange(L)[None, :] < jnp.array([L, 37])[:, None]
+    return q, k, v, mask
+
+
+class TestFlashAttention:
+    def test_full_mask_parity(self, qkv):
+        q, k, v, _ = qkv
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = dense_attention_reference(q, k, v, jnp.ones(q.shape[:1] + q.shape[2:3], bool))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_padding_mask_parity(self, qkv):
+        q, k, v, mask = qkv
+        out = flash_attention(q, k, v, mask, block_q=16, block_k=16)
+        ref = dense_attention_reference(q, k, v, mask)
+        valid = np.asarray(mask)[:, None, :, None]
+        np.testing.assert_allclose(np.asarray(out) * valid, np.asarray(ref) * valid,
+                                   atol=1e-5)
+
+    def test_causal_parity(self, qkv):
+        q, k, v, _ = qkv
+        full = jnp.ones((q.shape[0], q.shape[2]), bool)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = dense_attention_reference(q, k, v, full, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_asymmetric_blocks(self, qkv):
+        q, k, v, mask = qkv
+        ref = dense_attention_reference(q, k, v, mask)
+        valid = np.asarray(mask)[:, None, :, None]
+        for bq, bk in [(32, 16), (16, 32), (64, 16)]:
+            out = flash_attention(q, k, v, mask, block_q=bq, block_k=bk)
+            np.testing.assert_allclose(np.asarray(out) * valid,
+                                       np.asarray(ref) * valid, atol=1e-5,
+                                       err_msg=f"blocks ({bq},{bk})")
+
+    def test_bf16_inputs(self, qkv):
+        q, k, v, mask = qkv
+        out = flash_attention(*(x.astype(jnp.bfloat16) for x in (q, k, v)), mask,
+                              block_q=16, block_k=16)
+        assert out.dtype == jnp.bfloat16
+        ref = dense_attention_reference(q, k, v, mask)
+        valid = np.asarray(mask)[:, None, :, None]
+        np.testing.assert_allclose(np.asarray(out, dtype=np.float32) * valid,
+                                   np.asarray(ref) * valid, atol=3e-2)
+
+    def test_rejects_indivisible_length(self, qkv):
+        q, k, v, _ = qkv
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, block_q=24, block_k=16)
+
+
+class TestEncoderFlashPath:
+    def test_forward_parity_dense_vs_flash(self):
+        base = dict(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                    n_layers=2, d_ff=128, dtype=jnp.float32)
+        cfg_d = EncoderConfig(**base)
+        cfg_f = EncoderConfig(**base, attn_impl="flash")
+        params = init_params(jax.random.PRNGKey(0), cfg_d)
+        tokens = jnp.asarray(encode_texts(
+            ["the deploy failed with a timeout", "ship it"],
+            seq_len=64, vocab_size=512))
+        dense = forward(params, tokens, cfg_d)
+        flash = forward(params, tokens, cfg_f)
+        for key in ("severity", "keep", "mood", "embedding"):
+            np.testing.assert_allclose(np.asarray(flash[key]), np.asarray(dense[key]),
+                                       atol=2e-4, err_msg=key)

@@ -269,3 +269,32 @@ class TestRegressions:
         gw.stop()
         facts = plugin.fact_store.query(subject="anna")
         assert facts and facts[0].object == "CTO"
+
+
+class TestChromaRemove:
+    def test_remove_posts_to_delete_endpoint(self):
+        calls = []
+        emb = ChromaEmbeddings(
+            {"enabled": True, "collectionName": "facts",
+             "endpoint": "http://x/api/v2/collections/{name}/upsert"},
+            list_logger(), http_post=lambda url, payload: calls.append((url, payload)))
+        assert emb.remove({"f2", "f1"}) == 2
+        url, payload = calls[0]
+        assert url.endswith("/collections/facts/delete")
+        assert payload == {"ids": ["f1", "f2"]}
+
+    def test_remove_with_custom_endpoint_warns(self):
+        logger = list_logger()
+        emb = ChromaEmbeddings({"enabled": True, "endpoint": "http://x/custom"},
+                               logger, http_post=lambda u, p: None)
+        assert emb.remove({"f1"}) == 0
+        assert any("pruned facts remain" in m for lvl, m in logger.records)
+
+    def test_remove_failure_is_soft(self):
+        def boom(url, payload):
+            raise OSError("down")
+
+        emb = ChromaEmbeddings(
+            {"enabled": True, "endpoint": "http://x/api/v2/collections/{name}/upsert"},
+            list_logger(), http_post=boom)
+        assert emb.remove({"f1"}) == 0

@@ -102,3 +102,24 @@ class TestForwardLong:
         mesh = make_mesh(8, axes=("dp", "sp"), shape=(2, 4))
         emb = np.asarray(forward_long(params, tokens, cfg, mesh)["embedding"])
         np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, atol=1e-3)
+
+
+class TestForwardLongMoE:
+    def test_moe_long_context_matches_dense(self):
+        from vainplex_openclaw_tpu.models.train import loss_fn  # noqa: F401 (import check)
+
+        cfg = EncoderConfig(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, dtype=jnp.float32, n_experts=4)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.asarray(encode_texts(
+            ["the deploy failed with a timeout", "we migrate tomorrow",
+             "short", "ok then"], seq_len=64, vocab_size=512))
+        mesh = make_mesh(8, axes=("dp", "sp"), shape=(2, 4))
+        dense = forward(params, tokens, cfg)
+        long = forward_long(params, tokens, cfg, mesh)
+        for key in ("severity", "keep", "mood", "embedding"):
+            np.testing.assert_allclose(np.asarray(long[key]), np.asarray(dense[key]),
+                                       atol=3e-4, err_msg=key)
+        # aux is psum'd over dp+sp, so it matches the whole-batch dense value
+        np.testing.assert_allclose(float(long["moe_aux"]), float(dense["moe_aux"]),
+                                   atol=1e-5)

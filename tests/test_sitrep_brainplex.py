@@ -316,3 +316,22 @@ class TestBrainplexRegressions:
                         start_dir=str(tmp_path), home=tmp_path / "nohome", out=out)
         assert code == 1
         assert "unreadable" in stream.getvalue()
+
+
+class TestBrainplexNonDictConfig:
+    def test_array_config_surfaces_parse_error(self, tmp_path):
+        root = tmp_path / "i"
+        root.mkdir()
+        (root / "openclaw.json").write_text("[]", encoding="utf-8")
+        from vainplex_openclaw_tpu.brainplex.scanner import scan
+
+        result = scan(str(root), home=tmp_path / "nohome")
+        assert result["parse_error"]
+        assert result["agents"] == []
+
+    def test_array_config_not_merged(self, tmp_path):
+        target = tmp_path / "openclaw.json"
+        target.write_text("[1, 2]", encoding="utf-8")
+        result = update_openclaw_config(target, {"governance": {"enabled": True}})
+        assert result["action"] == "error"
+        assert target.read_text() == "[1, 2]"

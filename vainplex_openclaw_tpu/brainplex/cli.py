@@ -147,6 +147,9 @@ def run_init(args: dict, start_dir: Optional[str] = None,
     # 9: merge openclaw.json
     merge = update_openclaw_config(result["config_path"], entries,
                                    dry_run=args["dry_run"])
+    if merge["action"] == "error":
+        out.error(f"openclaw.json not updated: {merge.get('error', 'unknown error')}")
+        return 1
     out.debug(f"openclaw.json: {merge['action']}")
 
     # 10: summary

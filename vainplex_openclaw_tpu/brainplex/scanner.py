@@ -100,6 +100,8 @@ def scan(start_dir: str | Path, home: Optional[Path] = None,
     if config_path is not None:
         try:
             config = parse_config(config_path.read_text(encoding="utf-8"))
+            if not isinstance(config, dict):
+                config, parse_error = {}, "top-level JSON value is not an object"
         except (OSError, json.JSONDecodeError) as exc:
             parse_error = str(exc)
     return {

@@ -42,6 +42,8 @@ def update_openclaw_config(path: str | Path, plugin_entries: dict,
     if raw.strip():
         try:
             existing = parse_config(raw)
+            if not isinstance(existing, dict):
+                raise ValueError("top-level JSON value is not an object")
         except (json.JSONDecodeError, ValueError):
             # Never merge over a config we failed to parse — a wipe here
             # would destroy the user's agents/settings.

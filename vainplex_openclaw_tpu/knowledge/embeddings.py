@@ -72,6 +72,26 @@ class ChromaEmbeddings:
             self.logger.error(f"Embeddings sync failed: {exc}")
             return 0
 
+    def remove(self, ids) -> int:
+        """Best-effort delete of pruned facts from the collection (Chroma v2
+        sibling ``…/delete`` endpoint of the configured upsert URL)."""
+        ids = sorted(ids)
+        if not self.enabled() or not ids:
+            return 0
+        endpoint = self._endpoint()
+        if not endpoint.endswith("/upsert"):
+            self.logger.warn(
+                "cannot derive delete endpoint from custom upsert URL; "
+                f"{len(ids)} pruned facts remain in ChromaDB")
+            return 0
+        try:
+            self.http_post(endpoint[: -len("/upsert")] + "/delete", {"ids": ids})
+            self.logger.info(f"Removed {len(ids)} pruned facts from ChromaDB")
+            return len(ids)
+        except Exception as exc:  # noqa: BLE001 — embeddings are best-effort
+            self.logger.error(f"Embeddings delete failed: {exc}")
+            return 0
+
 
 class LocalEmbeddings:
     """On-device fact embeddings: CortexEncoder vector ⊕ hashed bag-of-tokens,

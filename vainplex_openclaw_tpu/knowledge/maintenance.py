@@ -35,8 +35,12 @@ class Maintenance:
         # keeps serving facts the store has deleted.
         current = set(self.fact_store.facts.keys())
         dead = self._synced_ids - current
-        if dead and hasattr(self.embeddings, "remove"):
-            self.embeddings.remove(dead)
+        if dead:
+            if hasattr(self.embeddings, "remove"):
+                self.embeddings.remove(dead)
+            else:
+                self.logger.warn(f"{len(dead)} pruned facts remain in the "
+                                 "embeddings backend (no remove support)")
         self._synced_ids &= current
         pending = [f for f in self.fact_store.facts.values()
                    if f.id not in self._synced_ids]

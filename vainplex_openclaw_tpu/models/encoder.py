@@ -36,6 +36,9 @@ class EncoderConfig:
     n_severity: int = 4   # info | low | medium | high-critical
     n_mood: int = 5       # frustrated | neutral | satisfied | urgent | confused
     dtype: object = jnp.bfloat16
+    attn_impl: str = "dense"  # "dense" (XLA-fused) | "flash" (Pallas kernel)
+    n_experts: int = 0        # 0 = dense MLP; >0 = MoE FFN (models/moe.py)
+    moe_aux_weight: float = 0.01
 
 
 def _dense_init(key, shape, scale=None):
@@ -58,20 +61,27 @@ def init_params(key: jax.Array, cfg: EncoderConfig) -> dict:
         },
     }
     for _ in range(cfg.n_layers):
-        params["blocks"].append({
+        block = {
             "attn": {
                 "q": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
                 "k": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
                 "v": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
                 "o": _dense_init(next(keys), (cfg.d_model, cfg.d_model)),
             },
-            "mlp": {
-                "w1": _dense_init(next(keys), (cfg.d_model, cfg.d_ff)),
-                "w2": _dense_init(next(keys), (cfg.d_ff, cfg.d_model)),
-            },
             "norm1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
             "norm2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
-        })
+        }
+        if cfg.n_experts > 0:
+            from .moe import MoEConfig, init_moe_params
+
+            block["moe"] = init_moe_params(
+                next(keys), MoEConfig(cfg.d_model, cfg.d_ff, cfg.n_experts))
+        else:
+            block["mlp"] = {
+                "w1": _dense_init(next(keys), (cfg.d_model, cfg.d_ff)),
+                "w2": _dense_init(next(keys), (cfg.d_ff, cfg.d_model)),
+            }
+        params["blocks"].append(block)
     return params
 
 
@@ -81,7 +91,8 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x32 * rms * scale).astype(x.dtype)
 
 
-def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array) -> jax.Array:
+def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
+               impl: str = "dense") -> jax.Array:
     B, L, D = x.shape
     H, Dh = n_heads, D // n_heads
     dt = x.dtype
@@ -90,20 +101,31 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array) -> jax.Arra
         return (x @ w.astype(dt)).reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
 
     q, k, v = heads(p["q"]), heads(p["k"]), heads(p["v"])
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
-    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, mask)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
     return out @ p["o"].astype(dt)
 
 
-def _block(x: jax.Array, p: dict, n_heads: int, mask: jax.Array) -> jax.Array:
-    x = x + _attention(_rmsnorm(x, p["norm1"]["scale"]), p["attn"], n_heads, mask)
+def _block(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
+           impl: str = "dense", cfg: "EncoderConfig" = None) -> tuple[jax.Array, jax.Array]:
+    x = x + _attention(_rmsnorm(x, p["norm1"]["scale"]), p["attn"], n_heads, mask, impl)
     h = _rmsnorm(x, p["norm2"]["scale"])
     dt = x.dtype
+    if "moe" in p:
+        from .moe import MoEConfig, moe_ffn
+
+        y, aux = moe_ffn(h, p["moe"], MoEConfig(cfg.d_model, cfg.d_ff, cfg.n_experts))
+        return x + y, aux
     h = jax.nn.gelu(h @ p["mlp"]["w1"].astype(dt)) @ p["mlp"]["w2"].astype(dt)
-    return x + h
+    return x + h, jnp.zeros((), jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -112,8 +134,10 @@ def forward(params: dict, tokens: jax.Array, cfg: EncoderConfig) -> dict:
     mask = tokens > 0
     dt = cfg.dtype
     x = params["embed"]["tok"].astype(dt)[tokens] + params["embed"]["pos"].astype(dt)[None, :, :]
+    moe_aux = jnp.zeros((), jnp.float32)
     for p in params["blocks"]:
-        x = _block(x, p, cfg.n_heads, mask)
+        x, aux = _block(x, p, cfg.n_heads, mask, cfg.attn_impl, cfg)
+        moe_aux = moe_aux + aux
     x = _rmsnorm(x, params["final_norm"]["scale"])
     denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1).astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / denom
@@ -124,4 +148,5 @@ def forward(params: dict, tokens: jax.Array, cfg: EncoderConfig) -> dict:
         "keep": pooled @ heads["keep"],
         "mood": pooled @ heads["mood"],
         "embedding": emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-6),
+        "moe_aux": moe_aux,
     }

@@ -38,7 +38,8 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
     @partial(shard_map, mesh=mesh,
              in_specs=(P(), P(dp_axis, sp_axis)),
              out_specs={"severity": P(dp_axis, None), "keep": P(dp_axis, None),
-                        "mood": P(dp_axis, None), "embedding": P(dp_axis, None)},
+                        "mood": P(dp_axis, None), "embedding": P(dp_axis, None),
+                        "moe_aux": P()},
              check_vma=False)
     def run(params, tokens):
         sp_idx = jax.lax.axis_index(sp_axis)
@@ -51,6 +52,7 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
         x = params["embed"]["tok"].astype(dt)[tokens] + pos.astype(dt)[None, :, :]
 
         H, Dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+        moe_aux = jnp.zeros((), jnp.float32)
         for p in params["blocks"]:
             h = _rmsnorm(x, p["norm1"]["scale"])
             a = p["attn"]
@@ -63,7 +65,22 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
             out = out.transpose(0, 2, 1, 3).reshape(B, L_loc, cfg.d_model)
             x = x + out @ a["o"].astype(dt)
             h = _rmsnorm(x, p["norm2"]["scale"])
-            x = x + jax.nn.gelu(h @ p["mlp"]["w1"].astype(dt)) @ p["mlp"]["w2"].astype(dt)
+            if "moe" in p:
+                from .moe import MoEConfig, load_balance_loss, moe_ffn_parts
+
+                mcfg = MoEConfig(cfg.d_model, cfg.d_ff, cfg.n_experts)
+                y, route_sum, prob_sum, count = moe_ffn_parts(h, p["moe"], mcfg)
+                # psum the per-expert sums over BOTH axes so the aux equals
+                # the dense whole-batch value.
+                axes = (dp_axis, sp_axis)
+                route_sum = jax.lax.psum(route_sum, axes)
+                prob_sum = jax.lax.psum(prob_sum, axes)
+                count = jax.lax.psum(count, axes)
+                moe_aux = moe_aux + load_balance_loss(route_sum, prob_sum, count,
+                                                      cfg.n_experts)
+                x = x + y
+            else:
+                x = x + jax.nn.gelu(h @ p["mlp"]["w1"].astype(dt)) @ p["mlp"]["w2"].astype(dt)
 
         x = _rmsnorm(x, params["final_norm"]["scale"])
         local_sum = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1)
@@ -78,6 +95,7 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
             "keep": pooled @ heads_p["keep"],
             "mood": pooled @ heads_p["mood"],
             "embedding": emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-6),
+            "moe_aux": moe_aux,
         }
 
     return run(params, tokens)

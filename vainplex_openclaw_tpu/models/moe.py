@@ -1,0 +1,89 @@
+"""Mixture-of-Experts FFN with expert parallelism over an ``ep`` mesh axis.
+
+The MoE variant of the flagship encoder's MLP: a learned router picks the
+top-1 expert per token, every expert is a (w1, w2) GELU MLP, and expert
+weights are stacked along a leading E axis so sharding E over ``ep``
+(``P("ep", None, None)``) gives GSPMD expert parallelism — each device holds
+E/ep experts and XLA inserts the combine collectives. Dispatch is dense
+(einsum over the one-hot routing matrix): no gather/scatter, static shapes,
+MXU-friendly — the standard TPU formulation for moderate expert counts.
+
+``load_balance_loss`` is the usual Switch-style auxiliary (mean fraction ×
+mean router prob per expert, scaled by E) to keep routing uniform.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 256
+    d_ff: int = 512
+    n_experts: int = 4
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
+    kg, k1, k2 = jax.random.split(key, 3)
+    scale1 = 1.0 / np.sqrt(cfg.d_model)
+    scale2 = 1.0 / np.sqrt(cfg.d_ff)
+    return {
+        "gate": jax.random.normal(kg, (cfg.d_model, cfg.n_experts), jnp.float32) * 0.02,
+        "w1": jax.random.normal(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff),
+                                jnp.float32) * scale1,
+        "w2": jax.random.normal(k2, (cfg.n_experts, cfg.d_ff, cfg.d_model),
+                                jnp.float32) * scale2,
+    }
+
+
+def moe_ffn_parts(x: jax.Array, p: dict, cfg: MoEConfig
+                  ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """x: [B, T, D] → (out, route_sum [E], prob_sum [E], token_count).
+
+    The per-expert sums let callers assemble the load-balance loss over any
+    token population — sequence-parallel callers psum them over sp first so
+    the aux matches the single-device value exactly.
+    """
+    dt = x.dtype
+    logits = (x.astype(jnp.float32) @ p["gate"]).astype(jnp.float32)  # [B,T,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                                  # [B,T]
+    route = jax.nn.one_hot(top, cfg.n_experts, dtype=jnp.float32)     # [B,T,E]
+    # Straight-through top-1 gate value keeps the router differentiable.
+    gate_val = (probs * route).sum(-1, keepdims=True)                 # [B,T,1]
+
+    # Dense dispatch: every expert runs on every token, the one-hot routing
+    # matrix zeroes the rest. Sharding E over ep splits both einsums.
+    h = jnp.einsum("btd,edf->ebtf", x, p["w1"].astype(dt))
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("ebtf,efd->ebtd", h, p["w2"].astype(dt))
+    out = jnp.einsum("ebtd,bte->btd", y.astype(jnp.float32), route)
+    out = (out * gate_val).astype(dt)
+
+    count = jnp.asarray(x.shape[0] * x.shape[1], jnp.float32)
+    return out, route.sum(axis=(0, 1)), probs.sum(axis=(0, 1)), count
+
+
+def moe_ffn(x: jax.Array, p: dict, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+    """x: [B, T, D] → (out [B, T, D], aux load-balance loss scalar)."""
+    out, route_sum, prob_sum, count = moe_ffn_parts(x, p, cfg)
+    return out, load_balance_loss(route_sum, prob_sum, count, cfg.n_experts)
+
+
+def load_balance_loss(route_sum: jax.Array, prob_sum: jax.Array,
+                      count: jax.Array, n_experts: int) -> jax.Array:
+    """Switch-style aux from per-expert sums over `count` tokens."""
+    return n_experts * jnp.sum((route_sum / count) * (prob_sum / count))
+
+
+def moe_sharding_rules(ep_axis: str = "ep") -> list:
+    """shard_params rules placing the expert axis on the ep mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    return [("'w1'", P(ep_axis, None, None)), ("'w2'", P(ep_axis, None, None)),
+            ("gate", P())]

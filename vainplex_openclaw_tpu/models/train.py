@@ -39,7 +39,7 @@ def loss_fn(params: dict, batch: dict, cfg: EncoderConfig) -> jax.Array:
         logits = out[head].astype(jnp.float32)
         losses.append(optax.softmax_cross_entropy_with_integer_labels(
             logits, batch[head]).mean())
-    return sum(losses)
+    return sum(losses) + cfg.moe_aux_weight * out["moe_aux"]
 
 
 @partial(jax.jit, static_argnames=("cfg", "optimizer"), donate_argnums=(0,))

@@ -1,0 +1,116 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Tiled exact attention for the flagship encoder's single-chip hot path: the
+grid runs over (batch·heads, query blocks); each program streams K/V blocks
+from VMEM through the MXU, carrying the online-softmax running max / sum /
+accumulator so the L×L score matrix never materialises. Softmax statistics
+accumulate in fp32 (`preferred_element_type`) regardless of input dtype;
+block shapes are MXU/VPU-aligned (sublane multiples of 8, lane dim padded to
+128 by Mosaic).
+
+On non-TPU backends the same kernel runs under the Pallas interpreter
+(`interpret=True`) so tests validate the exact kernel logic on the CPU mesh;
+`dense_attention_reference` (parallel/ring_attention.py) is the parity
+oracle. Composes with ring attention: rings rotate K/V *across* chips, this
+kernel tiles *within* a chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int,
+                  causal: bool, block_q: int, scale: float):
+    # q_ref: [1, block_q, Dh]; k_ref/v_ref: [1, L, Dh]; bias_ref: [1, L]
+    q = q_ref[0].astype(jnp.float32) * scale
+    L = k_ref.shape[1]
+    Dh = q_ref.shape[2]
+    qi = pl.program_id(1)
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, Dh), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s + bias_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    n_kb = L // block_k
+    if causal:
+        # K/V blocks strictly after the query block are fully masked — skip.
+        n_kb = jnp.minimum(n_kb, ((qi + 1) * block_q + block_k - 1) // block_k)
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """q/k/v: [B, H, L, Dh]; kv_mask: optional [B, L] bool. Returns [B, H, L, Dh].
+
+    L must be divisible by block_q and block_k (callers pad; the padding is
+    excluded via kv_mask). interpret=None auto-selects the Pallas
+    interpreter off-TPU.
+    """
+    B, H, L, Dh = q.shape
+    block_q = min(block_q, L)
+    block_k = min(block_k, L)
+    if L % block_q or L % block_k:
+        raise ValueError(f"L={L} not divisible by blocks ({block_q},{block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    if kv_mask is None:
+        bias = jnp.zeros((B, L), jnp.float32)
+    else:
+        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
+
+    qf = q.reshape(B * H, L, Dh)
+    kf = k.reshape(B * H, L, Dh)
+    vf = v.reshape(B * H, L, Dh)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                               block_q=block_q, scale=1.0 / np.sqrt(Dh))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, L // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, Dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, L, Dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L, Dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L), lambda b, i: (b // H, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dh), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, L, Dh), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, bias)
+    return out.reshape(B, H, L, Dh)

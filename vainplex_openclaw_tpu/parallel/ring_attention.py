@@ -55,8 +55,8 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
     acc = jnp.zeros((B, H, Lq, Dh), jnp.float32)
     q_pos = my_idx * Lq + jnp.arange(Lq)
 
-    def body(i, carry):
-        m, l, acc, k, v, kv_mask = carry
+    def attend(carry, k, v, kv_mask, i):
+        m, l, acc = carry
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
         keep = kv_mask[:, None, None, :]
         if causal:
@@ -72,10 +72,21 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
-        return (m_new, l, acc, _rotate(k, axis_name, sp),
-                _rotate(v, axis_name, sp), _rotate(kv_mask, axis_name, sp))
+        return m_new, l, acc
 
-    m, l, acc, _, _, _ = jax.lax.fori_loop(0, sp, body, (m, l, acc, k, v, kv_mask))
+    def body(i, carry):
+        # Rotate at the top so the loop runs sp-1 rotations total; the local
+        # block was consumed before the loop, and the last block processed
+        # is never re-sent around the ring.
+        m, l, acc, k, v, kv_mask = carry
+        k = _rotate(k, axis_name, sp)
+        v = _rotate(v, axis_name, sp)
+        kv_mask = _rotate(kv_mask, axis_name, sp)
+        m, l, acc = attend((m, l, acc), k, v, kv_mask, i)
+        return m, l, acc, k, v, kv_mask
+
+    carry = attend((m, l, acc), k, v, kv_mask, 0)
+    m, l, acc, _, _, _ = jax.lax.fori_loop(1, sp, body, carry + (k, v, kv_mask))
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
