@@ -1,0 +1,481 @@
+"""Per-signal detector deep tests (reference:
+cortex/test/trace-analyzer/signals/*.test.ts — one file per signal — plus
+the signal language packs under signals/lang/ ×10)."""
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.cortex.trace_analyzer import (
+    MemoryTraceSource,
+    reconstruct_chains,
+)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signal_patterns import (
+    SIGNAL_PACKS,
+    compile_signal_patterns,
+)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import (
+    DETECTOR_REGISTRY,
+    detect_all_signals,
+    detect_corrections,
+    detect_dissatisfied,
+    detect_doom_loops,
+    detect_hallucinations,
+    detect_repeat_failures,
+    detect_tool_failures,
+    detect_unverified_claims,
+    failure_signature,
+)
+
+from trace_helpers import EventFactory
+
+EN = compile_signal_patterns(["en"])
+
+
+def one_chain(raws):
+    chains = reconstruct_chains(MemoryTraceSource(raws).fetch())
+    assert len(chains) == 1, f"expected 1 chain, got {len(chains)}"
+    return chains[0]
+
+
+# ── SIG-CORRECTION ───────────────────────────────────────────────────
+
+
+class TestCorrection:
+    def test_basic_correction(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("The database is now migrated."),
+            f.msg_in("no, that's wrong — the old cluster is still live"),
+        ])
+        sigs = detect_corrections(chain, EN)
+        assert len(sigs) == 1
+        assert sigs[0].signal == "SIG-CORRECTION" and sigs[0].severity == "medium"
+        assert "corrected" in sigs[0].summary
+
+    def test_short_negative_answer_to_question_excluded(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("Should I also delete the staging environment?"),
+            f.msg_in("no."),
+        ])
+        assert detect_corrections(chain, EN) == []
+
+    def test_short_negative_after_assertion_still_counts(self):
+        # "no." after a *statement* (not a question) is a correction
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("I deleted the staging environment."),
+            f.msg_in("no, you got it wrong"),
+        ])
+        assert len(detect_corrections(chain, EN)) == 1
+
+    def test_plain_followup_not_flagged(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("Deployment started."),
+            f.msg_in("great, keep me posted"),
+        ])
+        assert detect_corrections(chain, EN) == []
+
+    def test_multiple_corrections_in_one_chain(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("Config A is active."), f.msg_in("actually, it's config B"),
+            f.msg_out("Right, B is active."), f.msg_in("no, that's not right either"),
+        ])
+        assert len(detect_corrections(chain, EN)) == 2
+
+
+# ── SIG-DISSATISFIED ─────────────────────────────────────────────────
+
+
+class TestDissatisfied:
+    def test_session_ends_dissatisfied(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("please fix the login bug"),
+            f.msg_out("done, try again"),
+            f.msg_in("it still isn't working, this is useless"),
+        ])
+        sigs = detect_dissatisfied(chain, EN)
+        assert len(sigs) == 1 and sigs[0].severity == "high"
+
+    def test_satisfaction_override_wins(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("fix it"),
+            f.msg_out("done"),
+            f.msg_in("it was still broken but works now, thanks"),
+        ])
+        assert detect_dissatisfied(chain, EN) == []
+
+    def test_resolution_after_dissatisfaction_cancels(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("it still doesn't work"),
+            f.msg_out("my apologies, let me fix that — here's the corrected config"),
+        ])
+        assert detect_dissatisfied(chain, EN) == []
+
+    def test_old_dissatisfaction_not_flagged(self):
+        # dissatisfaction early in the chain followed by lots of activity
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("this doesn't work"),
+            f.msg_out("investigating"),
+            f.tool_call("read", {"path": "/tmp/x"}), f.tool_result("read"),
+            f.msg_out("found it"),
+            f.tool_call("edit", {"path": "/tmp/x"}), f.tool_result("edit"),
+        ])
+        assert detect_dissatisfied(chain, EN) == []
+
+
+# ── SIG-HALLUCINATION ────────────────────────────────────────────────
+
+
+class TestHallucination:
+    def test_completion_claim_after_failed_tool(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("deploy it"),
+            *f.failing_call("exec", {"command": "kubectl apply -f app.yaml"},
+                            "error: forbidden"),
+            f.msg_out("I've successfully deployed the application."),
+        ])
+        sigs = detect_hallucinations(chain, EN)
+        assert len(sigs) == 1 and sigs[0].severity == "critical"
+        assert sigs[0].extra["tool_name"] == "exec"
+
+    def test_claim_after_successful_tool_ok(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("deploy it"),
+            f.tool_call("exec", {"command": "kubectl apply"}), f.tool_result("exec"),
+            f.msg_out("I've successfully deployed the application."),
+        ])
+        assert detect_hallucinations(chain, EN) == []
+
+    def test_error_in_previous_turn_not_attributed(self):
+        # failed tool belongs to an earlier user turn; the claim's own turn
+        # has a clean result
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("try plan A"),
+            *f.failing_call("exec", {"command": "a"}, "boom"),
+            f.msg_out("plan A failed, trying B"),
+            f.msg_in("ok"),
+            f.tool_call("exec", {"command": "b"}), f.tool_result("exec"),
+            f.msg_out("I've successfully completed plan B."),
+        ])
+        assert detect_hallucinations(chain, EN) == []
+
+    def test_non_claim_after_failure_ok(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("deploy"),
+            *f.failing_call("exec", {"command": "x"}, "err"),
+            f.msg_out("That failed — investigating the error."),
+        ])
+        assert detect_hallucinations(chain, EN) == []
+
+
+# ── SIG-UNVERIFIED-CLAIM ─────────────────────────────────────────────
+
+
+class TestUnverifiedClaim:
+    def test_claim_without_any_tool_activity(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("update the config"),
+            f.msg_out("I've updated the configuration file as requested."),
+        ])
+        sigs = detect_unverified_claims(chain, EN)
+        assert len(sigs) == 1 and sigs[0].severity == "medium"
+
+    def test_claim_with_tool_evidence_ok(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("update the config"),
+            f.tool_call("edit", {"path": "cfg"}), f.tool_result("edit"),
+            f.msg_out("I've updated the configuration file."),
+        ])
+        assert detect_unverified_claims(chain, EN) == []
+
+    def test_evidence_scoped_to_turn(self):
+        # tool ran in turn 1; turn 2's claim has no evidence of its own
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_in("read the file"),
+            f.tool_call("read", {"path": "x"}), f.tool_result("read"),
+            f.msg_out("here it is"),
+            f.msg_in("now fix the bug"),
+            f.msg_out("I've fixed the bug."),
+        ])
+        sigs = detect_unverified_claims(chain, EN)
+        assert len(sigs) == 1 and "fixed the bug" in sigs[0].summary
+
+
+# ── SIG-TOOL-FAIL ────────────────────────────────────────────────────
+
+
+class TestToolFail:
+    def test_identical_retry_both_failing(self):
+        f = EventFactory()
+        chain = one_chain([
+            *f.failing_call("exec", {"command": "make build"}, "compile error"),
+            *f.failing_call("exec", {"command": "make build"}, "compile error"),
+        ])
+        sigs = detect_tool_failures(chain, EN)
+        assert len(sigs) == 1 and sigs[0].extra["tool_name"] == "exec"
+
+    def test_changed_params_below_threshold_ok(self):
+        f = EventFactory()
+        chain = one_chain([
+            *f.failing_call("web", {"url": "https://a.example", "depth": 1}, "timeout"),
+            *f.failing_call("web", {"url": "https://other.example/completely/different",
+                                    "depth": 9}, "timeout"),
+        ])
+        assert detect_tool_failures(chain, EN) == []
+
+    def test_different_tools_not_paired(self):
+        f = EventFactory()
+        chain = one_chain([
+            *f.failing_call("exec", {"command": "x"}, "err"),
+            *f.failing_call("read", {"command": "x"}, "err"),
+        ])
+        assert detect_tool_failures(chain, EN) == []
+
+    def test_success_then_failure_not_flagged(self):
+        f = EventFactory()
+        chain = one_chain([
+            f.tool_call("exec", {"command": "x"}), f.tool_result("exec"),
+            *f.failing_call("exec", {"command": "x"}, "err"),
+        ])
+        assert detect_tool_failures(chain, EN) == []
+
+
+# ── SIG-DOOM-LOOP ────────────────────────────────────────────────────
+
+
+def loop_chain(n, command="npm run build", error="exit 1", mutate=None):
+    f = EventFactory()
+    raws = []
+    for i in range(n):
+        cmd = mutate(command, i) if mutate else command
+        raws += f.failing_call("exec", {"command": cmd}, error)
+    return one_chain(raws)
+
+
+class TestDoomLoop:
+    def test_two_failures_not_a_loop(self):
+        assert detect_doom_loops(loop_chain(2), EN) == []
+
+    def test_three_failures_high(self):
+        sigs = detect_doom_loops(loop_chain(3), EN)
+        assert len(sigs) == 1
+        assert sigs[0].severity == "high" and sigs[0].extra["loop_length"] == 3
+
+    def test_five_failures_critical(self):
+        sigs = detect_doom_loops(loop_chain(5), EN)
+        assert len(sigs) == 1
+        assert sigs[0].severity == "critical" and sigs[0].extra["loop_length"] == 5
+
+    def test_near_identical_exec_commands_levenshtein(self):
+        # small edits to a long command keep similarity ≥ 0.8
+        sigs = detect_doom_loops(
+            loop_chain(4, command="kubectl rollout status deployment/app --namespace prod",
+                       mutate=lambda c, i: c + f" # retry {i}"), EN)
+        assert len(sigs) == 1 and sigs[0].extra["loop_length"] == 4
+
+    def test_dissimilar_commands_break_the_run(self):
+        f = EventFactory()
+        raws = []
+        raws += f.failing_call("exec", {"command": "make test"}, "fail")
+        raws += f.failing_call("exec", {"command": "make test"}, "fail")
+        raws += f.failing_call("exec", {"command": "completely different frobnicate --xyz"},
+                               "fail")
+        assert detect_doom_loops(one_chain(raws), EN) == []
+
+    def test_success_breaks_the_run(self):
+        f = EventFactory()
+        raws = []
+        raws += f.failing_call("exec", {"command": "x"}, "fail")
+        raws += f.failing_call("exec", {"command": "x"}, "fail")
+        raws += [f.tool_call("exec", {"command": "x"}), f.tool_result("exec")]
+        raws += f.failing_call("exec", {"command": "x"}, "fail")
+        assert detect_doom_loops(one_chain(raws), EN) == []
+
+    def test_jaccard_path_for_non_exec_tools(self):
+        f = EventFactory()
+        raws = []
+        for _ in range(3):
+            raws += f.failing_call("write", {"path": "/etc/app.conf", "mode": "w"},
+                                   "permission denied")
+        sigs = detect_doom_loops(one_chain(raws), EN)
+        assert len(sigs) == 1 and sigs[0].extra["tool_name"] == "write"
+
+
+# ── SIG-REPEAT-FAIL ──────────────────────────────────────────────────
+
+
+class TestRepeatFail:
+    def make_chains(self, errors_by_session):
+        raws = []
+        for session, error in errors_by_session:
+            f = EventFactory(session=session)
+            raws += f.failing_call("exec", {"command": "deploy"}, error)
+        return reconstruct_chains(MemoryTraceSource(raws).fetch())
+
+    def test_cross_chain_recurrence_reported_once(self):
+        chains = self.make_chains([("s1", "connection refused"),
+                                   ("s2", "connection refused"),
+                                   ("s3", "connection refused")])
+        state = {}
+        sigs = []
+        for c in chains:
+            sigs += detect_repeat_failures(c, EN, state)
+        assert len(sigs) == 1  # reported exactly once, not per chain
+        assert sigs[0].severity == "high"
+
+    def test_single_chain_not_flagged(self):
+        chains = self.make_chains([("s1", "connection refused")])
+        assert detect_repeat_failures(chains[0], EN, {}) == []
+
+    def test_numbers_normalized_in_signature(self):
+        assert failure_signature("exec", "timeout after 30s on port 8080") == \
+            failure_signature("exec", "timeout after 60s on port 9090")
+
+    def test_different_tools_different_signatures(self):
+        assert failure_signature("exec", "boom") != failure_signature("read", "boom")
+
+    def test_no_state_means_disabled(self):
+        chains = self.make_chains([("s1", "x"), ("s2", "x")])
+        assert detect_repeat_failures(chains[0], EN, None) == []
+
+
+# ── registry behavior ────────────────────────────────────────────────
+
+
+class TestRegistry:
+    def _raws(self):
+        f = EventFactory()
+        return [
+            f.msg_out("The cache is warmed."),
+            f.msg_in("no, that's wrong"),
+            *f.failing_call("exec", {"command": "x"}, "err"),
+            *f.failing_call("exec", {"command": "x"}, "err"),
+            *f.failing_call("exec", {"command": "x"}, "err"),
+        ]
+
+    def test_registry_has_all_seven(self):
+        assert set(DETECTOR_REGISTRY) == {
+            "SIG-CORRECTION", "SIG-DISSATISFIED", "SIG-HALLUCINATION",
+            "SIG-UNVERIFIED-CLAIM", "SIG-TOOL-FAIL", "SIG-DOOM-LOOP",
+            "SIG-REPEAT-FAIL"}
+
+    def test_disable_one_signal(self):
+        chains = reconstruct_chains(MemoryTraceSource(self._raws()).fetch())
+        sigs = detect_all_signals(chains, EN, {"SIG-DOOM-LOOP": {"enabled": False}})
+        assert not [s for s in sigs if s.signal == "SIG-DOOM-LOOP"]
+        assert [s for s in sigs if s.signal == "SIG-CORRECTION"]
+
+    def test_severity_override(self):
+        chains = reconstruct_chains(MemoryTraceSource(self._raws()).fetch())
+        sigs = detect_all_signals(chains, EN,
+                                  {"SIG-CORRECTION": {"severity": "critical"}})
+        corr = [s for s in sigs if s.signal == "SIG-CORRECTION"]
+        assert corr and all(s.severity == "critical" for s in corr)
+
+    def test_detector_exception_does_not_kill_run(self, monkeypatch):
+        def boom(chain, patterns, state=None):
+            raise RuntimeError("detector bug")
+
+        monkeypatch.setitem(DETECTOR_REGISTRY, "SIG-HALLUCINATION", boom)
+        chains = reconstruct_chains(MemoryTraceSource(self._raws()).fetch())
+        logger = list_logger()
+        sigs = detect_all_signals(chains, EN, logger=logger)
+        assert [s for s in sigs if s.signal == "SIG-CORRECTION"]
+        assert any("detector SIG-HALLUCINATION failed" in m
+                   for lvl, m in logger.records if lvl == "error")
+
+    def test_signals_sorted_by_ts(self):
+        chains = reconstruct_chains(MemoryTraceSource(self._raws()).fetch())
+        sigs = detect_all_signals(chains, EN)
+        assert [s.ts for s in sigs] == sorted(s.ts for s in sigs)
+
+
+# ── signal language packs ×10 ────────────────────────────────────────
+
+# lang → (correction, dissatisfaction, satisfaction, resolution, completion)
+SIGNAL_MATRIX = {
+    "en": ("no, that's wrong", "it still isn't working and this is useless",
+           "works now, thanks", "my apologies, let me fix it",
+           "I've finished the deployment"),
+    "de": ("nein, das stimmt nicht", "das funktioniert nicht",
+           "danke, läuft jetzt", "entschuldigung, ist behoben",
+           "erfolgreich abgeschlossen"),
+    "fr": ("non, c'est faux", "ça ne marche pas",
+           "merci, ça marche", "désolé, c'est corrigé",
+           "j'ai terminé la migration"),
+    "es": ("no, eso está mal", "no funciona",
+           "gracias, ya funciona", "disculpa, está arreglado",
+           "he terminado el despliegue"),
+    "pt": ("não, isso está errado", "não funciona",
+           "obrigado, funciona agora", "desculpa, está consertado",
+           "eu terminei a implantação"),
+    "it": ("no, questo è sbagliato", "non funziona",
+           "grazie, ora funziona", "scusa, è sistemato",
+           "ho completato il deploy"),
+    "zh": ("不对,不是这样", "还是报错",
+           "谢谢,解决了", "已修复",
+           "已经部署好了"),
+    "ja": ("違います", "動きません",
+           "ありがとう、直りました", "修正しました",
+           "完了しました"),
+    "ko": ("틀렸어요", "안 돼요",
+           "감사합니다 해결됐어요", "고쳤습니다",
+           "배포했습니다"),
+    "ru": ("нет, это неверно", "не работает",
+           "спасибо, теперь работает", "исправлено",
+           "успешно завершено"),
+}
+
+
+@pytest.mark.parametrize("lang", sorted(SIGNAL_MATRIX))
+class TestSignalPacks:
+    def test_pack_exists(self, lang):
+        assert lang in SIGNAL_PACKS
+
+    def test_all_five_pattern_classes(self, lang):
+        correction, dissat, satisf, resol, completion = SIGNAL_MATRIX[lang]
+        p = compile_signal_patterns([lang])
+        assert any(rx.search(correction) for rx in p.correction), \
+            f"{lang}: correction miss on {correction!r}"
+        assert any(rx.search(dissat) for rx in p.dissatisfaction), \
+            f"{lang}: dissatisfaction miss on {dissat!r}"
+        assert any(rx.search(satisf) for rx in p.satisfaction_overrides), \
+            f"{lang}: satisfaction miss on {satisf!r}"
+        assert any(rx.search(resol) for rx in p.resolution), \
+            f"{lang}: resolution miss on {resol!r}"
+        assert any(rx.search(completion) for rx in p.completion_claims), \
+            f"{lang}: completion miss on {completion!r}"
+
+    def test_end_to_end_correction_detection(self, lang):
+        correction = SIGNAL_MATRIX[lang][0]
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("status report: all systems nominal"),
+            f.msg_in(correction),
+        ])
+        sigs = detect_corrections(chain, compile_signal_patterns([lang]))
+        assert len(sigs) == 1, f"{lang}: correction {correction!r} not detected"
+
+
+def test_merged_packs_detect_cross_language():
+    p = compile_signal_patterns(["en", "de", "zh"])
+    f = EventFactory()
+    chain = one_chain([
+        f.msg_out("Alles ist deployed."), f.msg_in("nein, das stimmt nicht"),
+        f.msg_out("系统正常。"), f.msg_in("不对,还是报错"),
+    ])
+    assert len(detect_corrections(chain, p)) == 2
