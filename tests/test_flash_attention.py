@@ -82,3 +82,33 @@ class TestEncoderFlashPath:
         for key in ("severity", "keep", "mood", "embedding"):
             np.testing.assert_allclose(np.asarray(flash[key]), np.asarray(dense[key]),
                                        atol=2e-4, err_msg=key)
+
+
+    def test_flash_path_handles_non_multiple_of_128_seq_len(self):
+        # regression: seq_len=192 must pick a dividing block size, not crash
+        base = dict(vocab_size=512, seq_len=192, d_model=64, n_heads=4,
+                    n_layers=1, d_ff=128, dtype=jnp.float32)
+        cfg_d = EncoderConfig(**base)
+        cfg_f = EncoderConfig(**base, attn_impl="flash")
+        params = init_params(jax.random.PRNGKey(1), cfg_d)
+        tokens = jnp.asarray(encode_texts(["odd length sequence test"],
+                                          seq_len=192, vocab_size=512))
+        dense = forward(params, tokens, cfg_d)
+        flash = forward(params, tokens, cfg_f)
+        np.testing.assert_allclose(np.asarray(flash["embedding"]),
+                                   np.asarray(dense["embedding"]), atol=2e-4)
+
+    def test_flash_path_pads_awkward_seq_len(self):
+        # L=131 (prime, >128): no aligned divisor exists — the encoder must
+        # pad to 256 with block 128 and still match dense
+        base = dict(vocab_size=512, seq_len=131, d_model=64, n_heads=4,
+                    n_layers=1, d_ff=128, dtype=jnp.float32)
+        cfg_d = EncoderConfig(**base)
+        cfg_f = EncoderConfig(**base, attn_impl="flash")
+        params = init_params(jax.random.PRNGKey(2), cfg_d)
+        tokens = jnp.asarray(encode_texts(["prime length sequence"],
+                                          seq_len=131, vocab_size=512))
+        dense = forward(params, tokens, cfg_d)
+        flash = forward(params, tokens, cfg_f)
+        np.testing.assert_allclose(np.asarray(flash["embedding"]),
+                                   np.asarray(dense["embedding"]), atol=2e-4)

@@ -283,11 +283,13 @@ class TestChromaRemove:
         assert url.endswith("/collections/facts/delete")
         assert payload == {"ids": ["f1", "f2"]}
 
-    def test_remove_with_custom_endpoint_warns(self):
+    def test_remove_with_custom_endpoint_warns_and_settles(self):
+        # permanently undeletable: warns, but counts as settled so the
+        # maintenance loop does not retry (and re-warn) every tick forever
         logger = list_logger()
         emb = ChromaEmbeddings({"enabled": True, "endpoint": "http://x/custom"},
                                logger, http_post=lambda u, p: None)
-        assert emb.remove({"f1"}) == 0
+        assert emb.remove({"f1"}) == 1
         assert any("pruned facts remain" in m for lvl, m in logger.records)
 
     def test_remove_failure_is_soft(self):
@@ -298,3 +300,41 @@ class TestChromaRemove:
             {"enabled": True, "endpoint": "http://x/api/v2/collections/{name}/upsert"},
             list_logger(), http_post=boom)
         assert emb.remove({"f1"}) == 0
+
+
+class TestChromaRemoveRetry:
+    """Regression: a failed Chroma delete must be retried next tick, not
+    silently forgotten."""
+
+    def make(self, tmp_path, http_post):
+        store = FactStore(tmp_path, {"decayFactor": 0.1, "pruneBelowRelevance": 0.3},
+                          list_logger(), clock=FakeClock(), wall_timers=False)
+        store.load()
+        emb = ChromaEmbeddings(
+            {"enabled": True, "collectionName": "facts",
+             "endpoint": "http://x/api/v2/collections/{name}/upsert"},
+            list_logger(), http_post=http_post)
+        return store, Maintenance(store, emb, list_logger(), wall_timers=False)
+
+    def test_failed_delete_retried_on_next_tick(self, tmp_path):
+        calls = {"delete": 0, "fail": True}
+
+        def http_post(url, payload):
+            if url.endswith("/delete"):
+                calls["delete"] += 1
+                if calls["fail"]:
+                    raise OSError("chroma briefly down")
+
+        store, m = self.make(tmp_path, http_post)
+        store.add_fact("redis", "is", "down")
+        assert m.run_embeddings_sync() == 1
+        store.decay_facts()
+        assert store.count() == 0
+
+        m.run_embeddings_sync()             # delete attempt fails
+        assert calls["delete"] == 1
+        calls["fail"] = False
+        m.run_embeddings_sync()             # must retry, not forget
+        assert calls["delete"] == 2
+        m.run_embeddings_sync()             # done — no further deletes
+        assert calls["delete"] == 2

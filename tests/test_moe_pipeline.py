@@ -123,3 +123,40 @@ class TestPipeline:
         for s in range(4):
             np.testing.assert_allclose(np.asarray(g_pipe[s, 0]),
                                        np.asarray(g_seq[s]["w"]), atol=1e-5)
+
+
+class TestMoEMask:
+    """Regression: aux load-balance loss must ignore padding tokens."""
+
+    def setup_method(self):
+        self.cfg = MoEConfig(d_model=32, d_ff=64, n_experts=4)
+        self.params = init_moe_params(jax.random.PRNGKey(0), self.cfg)
+
+    def test_masked_aux_equals_unpadded_aux(self):
+        # real tokens followed by pad positions: aux with mask over the padded
+        # input must equal aux of the unpadded input alone
+        real = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+        pad = jnp.zeros((2, 24, 32))
+        padded = jnp.concatenate([real, pad], axis=1)
+        mask = jnp.concatenate([jnp.ones((2, 8), bool), jnp.zeros((2, 24), bool)],
+                               axis=1)
+        _, aux_masked = moe_ffn(padded, self.params, self.cfg, mask)
+        _, aux_real = moe_ffn(real, self.params, self.cfg)
+        np.testing.assert_allclose(float(aux_masked), float(aux_real), rtol=1e-5)
+
+    def test_pad_heavy_batch_does_not_dilute_aux(self):
+        # all-pads-route-to-one-expert scenario: without a mask the pads
+        # dominate the sums; with the mask they are invisible
+        real = jax.random.normal(jax.random.PRNGKey(2), (1, 4, 32))
+        padded = jnp.concatenate([real, jnp.zeros((1, 124, 32))], axis=1)
+        mask = jnp.concatenate([jnp.ones((1, 4), bool), jnp.zeros((1, 124), bool)],
+                               axis=1)
+        _, aux_no_mask = moe_ffn(padded, self.params, self.cfg)
+        _, aux_masked = moe_ffn(padded, self.params, self.cfg, mask)
+        assert not np.isclose(float(aux_no_mask), float(aux_masked))
+
+    def test_all_pad_shard_is_finite(self):
+        x = jnp.zeros((1, 8, 32))
+        mask = jnp.zeros((1, 8), bool)
+        _, aux = moe_ffn(x, self.params, self.cfg, mask)
+        assert np.isfinite(float(aux))

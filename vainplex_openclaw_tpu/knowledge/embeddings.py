@@ -74,16 +74,22 @@ class ChromaEmbeddings:
 
     def remove(self, ids) -> int:
         """Best-effort delete of pruned facts from the collection (Chroma v2
-        sibling ``…/delete`` endpoint of the configured upsert URL)."""
+        sibling ``…/delete`` endpoint of the configured upsert URL).
+
+        Returns the number of ids *settled* — deleted, or permanently
+        undeletable (custom endpoint we cannot derive a delete URL from).
+        Transient failures return fewer than ``len(ids)`` so the caller
+        retries only those next tick."""
         ids = sorted(ids)
         if not self.enabled() or not ids:
             return 0
         endpoint = self._endpoint()
         if not endpoint.endswith("/upsert"):
+            # Permanent: no retry will ever succeed — warn once, settle.
             self.logger.warn(
                 "cannot derive delete endpoint from custom upsert URL; "
                 f"{len(ids)} pruned facts remain in ChromaDB")
-            return 0
+            return len(ids)
         try:
             self.http_post(endpoint[: -len("/upsert")] + "/delete", {"ids": ids})
             self.logger.info(f"Removed {len(ids)} pruned facts from ChromaDB")
@@ -164,18 +170,20 @@ class LocalEmbeddings:
                  "score": float(scores[i])} for i in order]
 
     def remove(self, ids) -> int:
-        """Drop pruned facts from the index so search never returns them."""
+        """Drop pruned facts from the index so search never returns them.
+        Ids already absent count as settled (the desired state holds)."""
         dead = set(ids)
-        if self._vectors is None or not dead:
+        if not dead:
             return 0
+        if self._vectors is None:
+            return len(dead)
         keep = [i for i, fid in enumerate(self._ids) if fid not in dead]
-        removed = len(self._ids) - len(keep)
-        if removed:
+        if len(keep) < len(self._ids):
             self._ids = [self._ids[i] for i in keep]
             self._vectors = self._vectors[keep] if keep else None
-            for fid in dead:
-                self._docs.pop(fid, None)
-        return removed
+        for fid in dead:
+            self._docs.pop(fid, None)
+        return len(dead)
 
     def count(self) -> int:
         return len(self._ids)

@@ -35,13 +35,18 @@ class Maintenance:
         # keeps serving facts the store has deleted.
         current = set(self.fact_store.facts.keys())
         dead = self._synced_ids - current
+        failed_dead: set = set()
         if dead:
             if hasattr(self.embeddings, "remove"):
-                self.embeddings.remove(dead)
+                # remove() returns how many ids are settled (deleted or
+                # permanently undeletable). A transient failure settles fewer:
+                # keep those ids marked as synced so the next tick retries.
+                if self.embeddings.remove(dead) < len(dead):
+                    failed_dead = dead
             else:
                 self.logger.warn(f"{len(dead)} pruned facts remain in the "
                                  "embeddings backend (no remove support)")
-        self._synced_ids &= current
+        self._synced_ids = (self._synced_ids & current) | failed_dead
         pending = [f for f in self.fact_store.facts.values()
                    if f.id not in self._synced_ids]
         if not pending:

@@ -104,7 +104,21 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, mask)
+        # Pallas blocks must divide L and keep the sublane dimension a
+        # multiple of 8 for MXU/VPU alignment. Prefer an aligned divisor of
+        # L ≤128; otherwise pad L up to a multiple of 128 — padded keys are
+        # excluded via the kv mask, padded query rows are sliced away.
+        block = next((b for b in range(min(128, L), 7, -1)
+                      if L % b == 0 and b % 8 == 0), None)
+        if block is not None:
+            out = flash_attention(q, k, v, mask, block_q=block, block_k=block)
+        else:
+            pad = (-L) % 128
+            qp, kp, vp = (jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                          for t in (q, k, v))
+            maskp = jnp.pad(mask, ((0, 0), (0, pad)))
+            out = flash_attention(qp, kp, vp, maskp,
+                                  block_q=128, block_k=128)[:, :, :L]
     else:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
         scores = jnp.where(mask[:, None, None, :], scores, -1e30)
@@ -122,7 +136,8 @@ def _block(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
     if "moe" in p:
         from .moe import MoEConfig, moe_ffn
 
-        y, aux = moe_ffn(h, p["moe"], MoEConfig(cfg.d_model, cfg.d_ff, cfg.n_experts))
+        y, aux = moe_ffn(h, p["moe"], MoEConfig(cfg.d_model, cfg.d_ff, cfg.n_experts),
+                         mask)
         return x + y, aux
     h = jax.nn.gelu(h @ p["mlp"]["w1"].astype(dt)) @ p["mlp"]["w2"].astype(dt)
     return x + h, jnp.zeros((), jnp.float32)

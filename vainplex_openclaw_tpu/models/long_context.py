@@ -69,7 +69,7 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
                 from .moe import MoEConfig, load_balance_loss, moe_ffn_parts
 
                 mcfg = MoEConfig(cfg.d_model, cfg.d_ff, cfg.n_experts)
-                y, route_sum, prob_sum, count = moe_ffn_parts(h, p["moe"], mcfg)
+                y, route_sum, prob_sum, count = moe_ffn_parts(h, p["moe"], mcfg, mask)
                 # psum the per-expert sums over BOTH axes so the aux equals
                 # the dense whole-batch value.
                 axes = (dp_axis, sp_axis)

@@ -41,13 +41,17 @@ def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
     }
 
 
-def moe_ffn_parts(x: jax.Array, p: dict, cfg: MoEConfig
+def moe_ffn_parts(x: jax.Array, p: dict, cfg: MoEConfig,
+                  mask: jax.Array | None = None
                   ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """x: [B, T, D] → (out, route_sum [E], prob_sum [E], token_count).
+    """x: [B, T, D], mask: [B, T] valid-token mask →
+    (out, route_sum [E], prob_sum [E], token_count).
 
     The per-expert sums let callers assemble the load-balance loss over any
     token population — sequence-parallel callers psum them over sp first so
-    the aux matches the single-device value exactly.
+    the aux matches the single-device value exactly. ``mask`` excludes
+    padding positions from the sums: without it the aux loss would mostly
+    balance routing of pad tokens whose outputs the pooling discards.
     """
     dt = x.dtype
     logits = (x.astype(jnp.float32) @ p["gate"]).astype(jnp.float32)  # [B,T,E]
@@ -65,20 +69,27 @@ def moe_ffn_parts(x: jax.Array, p: dict, cfg: MoEConfig
     out = jnp.einsum("ebtd,bte->btd", y.astype(jnp.float32), route)
     out = (out * gate_val).astype(dt)
 
-    count = jnp.asarray(x.shape[0] * x.shape[1], jnp.float32)
-    return out, route.sum(axis=(0, 1)), probs.sum(axis=(0, 1)), count
+    if mask is None:
+        m = jnp.ones(x.shape[:2], jnp.float32)
+    else:
+        m = mask.astype(jnp.float32)
+    route_sum = (route * m[:, :, None]).sum(axis=(0, 1))
+    prob_sum = (probs * m[:, :, None]).sum(axis=(0, 1))
+    return out, route_sum, prob_sum, m.sum()
 
 
-def moe_ffn(x: jax.Array, p: dict, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+def moe_ffn(x: jax.Array, p: dict, cfg: MoEConfig,
+            mask: jax.Array | None = None) -> tuple[jax.Array, jax.Array]:
     """x: [B, T, D] → (out [B, T, D], aux load-balance loss scalar)."""
-    out, route_sum, prob_sum, count = moe_ffn_parts(x, p, cfg)
+    out, route_sum, prob_sum, count = moe_ffn_parts(x, p, cfg, mask)
     return out, load_balance_loss(route_sum, prob_sum, count, cfg.n_experts)
 
 
 def load_balance_loss(route_sum: jax.Array, prob_sum: jax.Array,
                       count: jax.Array, n_experts: int) -> jax.Array:
     """Switch-style aux from per-expert sums over `count` tokens."""
-    return n_experts * jnp.sum((route_sum / count) * (prob_sum / count))
+    denom = jnp.maximum(count, 1.0)
+    return n_experts * jnp.sum((route_sum / denom) * (prob_sum / denom))
 
 
 def moe_sharding_rules(ep_axis: str = "ep") -> list:

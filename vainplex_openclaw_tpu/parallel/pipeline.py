@@ -80,8 +80,11 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn: Callable,
             if 0 <= m_out < M:
                 emit = jnp.where(stage == S - 1, state, jnp.zeros_like(state))
                 out = out.at[m_out].set(emit)
-            state = jax.lax.ppermute(state, pp_axis,
-                                     [(j, (j + 1) % S) for j in range(S)])
+            # no hop after the final step — that output is never read, and the
+            # extra ppermute would cost one ICI round-trip (fwd + transposed bwd)
+            if t < M + S - 2:
+                state = jax.lax.ppermute(state, pp_axis,
+                                         [(j, (j + 1) % S) for j in range(S)])
         # out is non-zero only on the last stage; psum replicates it.
         return jax.lax.psum(out, pp_axis)
 
