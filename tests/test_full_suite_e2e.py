@@ -1,0 +1,260 @@
+"""Full-suite end-to-end integration: ALL plugins loaded in ONE gateway,
+driving the reference's "minimum end-to-end slice" (SURVEY §7.3) plus the
+cross-plugin flows — event store capturing every hook, trace analyzer
+consuming the shared transport, trace-to-facts feeding governance, sitrep
+aggregating cortex + audit artifacts.
+
+Reference analogs: governance/test/integration.test.ts (712 — full engine
+pipeline against a real tmp workspace), cortex demo/demo.ts (the repo's only
+runnable e2e artifact), nats-eventstore/test/integration.test.ts.
+"""
+
+import json
+
+import pytest
+
+from vainplex_openclaw_tpu.core import Gateway, list_logger
+from vainplex_openclaw_tpu.cortex import CortexPlugin
+from vainplex_openclaw_tpu.cortex.trace_analyzer import TransportTraceSource
+from vainplex_openclaw_tpu.events import EventStorePlugin
+from vainplex_openclaw_tpu.events.transport import MemoryTransport
+from vainplex_openclaw_tpu.governance import GovernancePlugin
+from vainplex_openclaw_tpu.governance.validation.facts import (
+    extract_facts_from_trace_report,
+)
+from vainplex_openclaw_tpu.knowledge import KnowledgeEnginePlugin
+from vainplex_openclaw_tpu.sitrep import SitrepPlugin
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock
+
+AGENT = "main"
+SESSION = "agent:main:sess-1"
+
+
+@pytest.fixture
+def suite(tmp_path, monkeypatch):
+    """One gateway, five plugins, shared clock + transport + workspace."""
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))
+    clock = FakeClock(1_753_772_400.0)  # 2025-07-29 07:00 UTC
+    logger = list_logger()
+    ws = tmp_path / "ws"
+    gw = Gateway(config={"workspace": str(ws),
+                         "agents": [{"id": AGENT}, {"id": "helper"}]},
+                 logger=logger, clock=clock)
+    transport = MemoryTransport(clock=clock)
+
+    gov = GovernancePlugin(workspace=str(ws), clock=clock)
+    gw.load(gov, plugin_config={
+        "redaction": {"enabled": True},
+        "validation": {"enabled": True,
+                       "facts": [{"subject": "backup-service", "predicate": "state",
+                                  "value": "down"}]},
+        "builtinPolicies": {"credentialGuard": True, "productionSafeguard": True,
+                            "nightMode": False, "rateLimiter": {"maxPerMinute": 100}},
+    })
+    events = EventStorePlugin(transport=transport, clock=clock)
+    gw.load(events, plugin_config={})
+    cortex = CortexPlugin(workspace=str(ws), clock=clock, wall_timers=False,
+                          trace_source=TransportTraceSource(transport))
+    gw.load(cortex, plugin_config={
+        "languages": ["en", "de"],
+        "traceAnalyzer": {"enabled": True, "scheduleMinutes": 0},
+    })
+    knowledge = KnowledgeEnginePlugin(workspace=str(ws), clock=clock,
+                                      wall_timers=False)
+    gw.load(knowledge, plugin_config={})
+    sitrep = SitrepPlugin(workspace=str(ws), clock=clock, wall_timers=False)
+    gw.load(sitrep, plugin_config={"collectors": {"threads": {"enabled": True},
+                                                  "errors": {"enabled": True}},
+                                   "intervalMinutes": 0})
+    gw.start()
+    yield type("Suite", (), {
+        "gw": gw, "clock": clock, "logger": logger, "ws": ws,
+        "transport": transport, "gov": gov, "cortex": cortex,
+        "knowledge": knowledge, "events": events, "sitrep": sitrep,
+    })()
+    gw.stop()
+
+
+def ctx(**extra):
+    return {"agent_id": AGENT, "session_key": SESSION, **extra}
+
+
+class TestScriptedConversation:
+    """The demo-equivalent: a scripted conversation through every plugin."""
+
+    def drive(self, s):
+        s.gw.session_start(ctx())
+        s.gw.message_received(
+            "We decided to migrate the database to Postgres because MySQL "
+            "licensing is too costly. Email dba@example.com for access.", ctx())
+        s.clock.advance(60)
+        s.gw.message_sent("I'll prepare the migration plan by Friday.", ctx())
+        s.clock.advance(60)
+        # allowed tool call
+        d1, _ = s.gw.run_tool("read", {"path": "README.md"}, lambda p: "contents",
+                              ctx())
+        # blocked by credential guard
+        d2 = s.gw.before_tool_call("read", {"path": "/home/user/.env"}, ctx())
+        # tool result containing a secret goes through redaction layer 1
+        scrubbed = s.gw.tool_result_persist(
+            "exec", "export OPENAI_KEY=sk-" + "a" * 24, ctx())
+        return d1, d2, scrubbed
+
+    def test_cross_plugin_effects(self, suite):
+        d1, d2, scrubbed = self.drive(suite)
+        assert d1.allowed and d2.blocked
+        assert "credential" in (d2.block_reason or "").lower()
+        assert "[REDACTED:credential:" in scrubbed
+
+        # cortex tracked the decision and the commitment
+        trackers = suite.cortex.trackers(ctx())
+        trackers.flush()
+        decisions = read_json(suite.ws / "memory" / "reboot" / "decisions.json")
+        assert any("postgres" in d["what"].lower() for d in decisions["decisions"])
+        commitments = read_json(suite.ws / "memory" / "reboot" / "commitments.json")
+        assert any("migration plan" in c["what"] for c in commitments["commitments"])
+
+        # knowledge engine extracted the email entity into the fact store
+        suite.knowledge.fact_store.flush()
+        facts = read_json(suite.ws / "knowledge" / "facts.json")
+        assert any("dba@example.com" in json.dumps(f) for f in facts["facts"])
+
+        # the denial hit the audit trail on disk
+        suite.gov.engine.audit_trail.flush()
+        audit_dir = suite.ws / "governance" / "audit"
+        records = [json.loads(line)
+                   for f in audit_dir.glob("*.jsonl")
+                   for line in f.read_text().splitlines()]
+        denials = [r for r in records if r["verdict"] == "deny"]
+        assert denials and denials[0]["controls"]
+
+        # every hook landed in the event store with idempotent ids
+        types = [e.canonical_type for e in suite.transport.fetch()]
+        assert "message.in.received" in types
+        assert "tool.call.requested" in types
+        ids = [e.id for e in suite.transport.fetch()]
+        assert len(ids) == len(set(ids))
+
+    def test_trust_learned_across_the_script(self, suite):
+        before = suite.gov.engine.get_trust(AGENT)["agent"]["score"]
+        self.drive(suite)
+        after = suite.gov.engine.get_trust(AGENT)["agent"]
+        # one success and one violation were recorded
+        assert after["signals"]["successCount"] >= 1
+        assert after["signals"]["violationCount"] >= 1
+        assert after["score"] != before or after["signals"]["successCount"] > 0
+
+    def test_compaction_snapshot_and_boot_context(self, suite):
+        self.drive(suite)
+        suite.gw.before_compaction(ctx(), messages=[
+            {"role": "user", "content": "status of the postgres migration?"},
+            {"role": "assistant", "content": "schema converted, data next"}])
+        reboot = suite.ws / "memory" / "reboot"
+        assert (reboot / "hot-snapshot.md").exists()
+        boot = (reboot / "BOOTSTRAP.md").read_text()
+        assert "postgres" in boot.lower() or "migrate" in boot.lower()
+
+        # a fresh session boots with that context
+        results = suite.gw.session_start(ctx(session_key="agent:main:sess-2"))
+        joined = json.dumps([r for r in results if r])
+        assert "BOOTSTRAP" in joined or "postgres" in joined.lower()
+
+
+class TestTraceAnalysisLoop:
+    """Events published by the suite feed the trace analyzer, and its report
+    feeds facts back into governance (the reference's only cross-plugin data
+    flow, trace-to-facts-bridge.ts)."""
+
+    def test_doom_loop_detected_from_live_events(self, suite):
+        s = suite
+
+        def failing_tool(params):
+            raise RuntimeError("exit 1: tests failed")
+
+        s.gw.session_start(ctx())
+        for i in range(4):
+            s.gw.run_tool("exec", {"command": "npm test"}, failing_tool, ctx())
+            s.clock.advance(30)
+        report = s.cortex.trace_analyzer.run()
+        sigs = {f["signal"] for f in report["findings"]}
+        assert "SIG-TOOL-FAIL" in sigs
+        assert "SIG-DOOM-LOOP" in sigs
+
+        # incremental state advanced; a second run reprocesses nothing
+        report2 = s.cortex.trace_analyzer.run()
+        assert report2["runStats"]["events"] == 0
+
+    def test_report_facts_flow_back_to_governance(self, suite, tmp_path):
+        report_path = tmp_path / "trace-report.json"
+        report_path.write_text(json.dumps({"findings": [
+            {"signal": "SIG-HALLUCINATION", "severity": "high",
+             "factCorrection": {"subject": "deploy-service", "predicate": "status",
+                                "value": "down"}}]}))
+        facts = extract_facts_from_trace_report(report_path)
+        assert facts and facts[0]["subject"] == "deploy-service"
+        facts_file = tmp_path / "facts-from-trace.json"
+        facts_file.write_text(json.dumps({"facts": facts}))
+        n = suite.gov.fact_registry.load_facts_from_file(facts_file)
+        assert n == 1
+        # the corrected fact now drives output validation
+        fact = suite.gov.fact_registry.lookup("deploy-service", "status")
+        assert fact is not None and fact.value == "down"
+
+    def test_output_validation_blocks_contradiction_live(self, suite):
+        s = suite
+        s.gw.session_start(ctx())
+        # seeded fact: backup-service status=down. Low session trust → block.
+        s.gov.engine.session_trust.get_session_trust(SESSION, AGENT)
+        s.gov.engine.session_trust.set_score(SESSION, AGENT, 20.0)
+        d = s.gw.before_message_write("backup-service is running", ctx())
+        assert d.blocked
+
+
+class TestSitrepAggregation:
+    def test_sitrep_sees_cortex_and_audit_state(self, suite):
+        s = suite
+        s.gw.session_start(ctx())
+        s.gw.message_received("We need to fix the flaky deploy pipeline", ctx())
+        s.gw.before_tool_call("read", {"path": "secrets.pem"}, ctx())  # denial
+        trackers = s.cortex.trackers(ctx())
+        trackers.flush()
+        s.gov.engine.audit_trail.flush()
+        report = s.sitrep.generate()
+        assert report["collectors"]["threads"]["status"] in ("ok", "warn")
+        errs = report["collectors"]["errors"]
+        assert errs["items"], "audit denial should surface in sitrep errors"
+        assert (s.ws / "sitrep.json").exists()
+
+
+class TestGatewaySurface:
+    def test_all_commands_respond(self, suite):
+        for cmd in ("governance", "trust", "cortexstatus", "eventstatus"):
+            out = suite.gw.command(cmd)
+            assert isinstance(out.get("text"), str) and out["text"]
+
+    def test_all_gateway_methods_respond(self, suite):
+        assert suite.gw.call_method("governance.status")["enabled"] is True
+        assert "agents" in suite.gw.call_method("governance.trust")
+        assert suite.gw.call_method("eventstore.status")["healthy"] is True
+
+    def test_cortex_tools_registered_and_queryable(self, suite):
+        s = suite
+        s.gw.message_received("We decided to adopt terraform because of drift",
+                              ctx())
+        s.cortex.trackers(ctx()).flush()
+        tool = s.gw.tools.get("cortex_decisions")
+        assert tool is not None
+        out = tool["handler"]({"query": "terraform"})
+        assert out["decisions"]
+
+    def test_plugin_crash_never_blocks_the_pipeline(self, suite):
+        """Fail-open: a crashing tracker must not break message flow
+        (reference: every hook handler try/caught, SURVEY §5)."""
+        s = suite
+        s.cortex.trackers(ctx()).threads.process_message = lambda *a, **k: 1 / 0
+        results = s.gw.message_received("still flows", ctx())
+        assert isinstance(results, list)  # no exception escaped
+        d = s.gw.before_tool_call("read", {"path": "ok.txt"}, ctx())
+        assert d.allowed

@@ -62,6 +62,10 @@ class _WorkspaceTrackers:
             self.enhancer = LlmEnhancer(call_llm, logger,
                                         config["llmEnhance"].get("batchSize", 3))
 
+    def flush(self) -> None:
+        for tracker in (self.threads, self.decisions, self.commitments):
+            tracker.flush()
+
 
 class CortexPlugin:
     id = "cortex"
@@ -212,9 +216,7 @@ class CortexPlugin:
     def _on_gateway_stop(self, event: dict, ctx: dict):
         for trackers in self._trackers.values():
             try:
-                trackers.threads.flush()
-                trackers.decisions.flush()
-                trackers.commitments.flush()
+                trackers.flush()
             except Exception as exc:  # noqa: BLE001
                 self.logger.error(f"flush failed: {exc}")
         return None
