@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from typing import Iterable
+from typing import Iterable, Optional
 
 from .events import NormalizedEvent
 
@@ -29,6 +29,9 @@ class ConversationChain:
     events: list[NormalizedEvent]
     type_counts: dict = field(default_factory=dict)
     boundary_type: str = "time_range"
+    # per-run cache of tool.call→tool.result pairing, shared by the three
+    # tool-failure detectors (signals._tool_attempts)
+    _tool_attempts: Optional[list] = field(default=None, repr=False, compare=False)
 
 
 def compute_chain_id(session: str, agent: str, first_ts: float) -> str:

@@ -81,6 +81,8 @@ def assemble_report(run_stats: dict, signals: list, classified: list,
 
 
 def save_report(report: dict, directory: str | Path) -> Path:
+    # Reports can run to megabytes (thousands of findings); compact JSON is
+    # ~3x faster to serialize and the file is machine-consumed (bridge, CI).
     path = Path(directory) / REPORT_FILE
-    write_json_atomic(path, report)
+    write_json_atomic(path, report, indent=None)
     return path

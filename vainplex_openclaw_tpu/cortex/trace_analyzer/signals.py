@@ -185,7 +185,11 @@ def detect_unverified_claims(chain: ConversationChain,
 
 
 def _tool_attempts(chain: ConversationChain) -> list[dict]:
-    """Pair tool.call with its following tool.result."""
+    """Pair tool.call with its following tool.result. Cached on the chain —
+    three detectors (tool-fail, doom-loop, repeat-fail) share the pairing."""
+    cached = getattr(chain, "_tool_attempts", None)
+    if cached is not None:
+        return cached
     attempts = []
     events = chain.events
     for i, event in enumerate(events):
@@ -201,6 +205,7 @@ def _tool_attempts(chain: ConversationChain) -> list[dict]:
             "error": (result.payload.get("tool_error") if result else None),
             "is_error": bool(result and result.payload.get("tool_is_error")),
         })
+    chain._tool_attempts = attempts
     return attempts
 
 

@@ -18,11 +18,13 @@ from pathlib import Path
 from typing import Any, Callable, Iterator, Optional
 
 
-def write_json_atomic(path: str | Path, obj: Any, indent: int = 2) -> None:
+def write_json_atomic(path: str | Path, obj: Any, indent: Optional[int] = 2) -> None:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(path.name + f".tmp{os.getpid()}")
-    tmp.write_text(json.dumps(obj, indent=indent, ensure_ascii=False, default=str), encoding="utf-8")
+    separators = (",", ":") if indent is None else None
+    tmp.write_text(json.dumps(obj, indent=indent, separators=separators,
+                              ensure_ascii=False, default=str), encoding="utf-8")
     os.replace(tmp, path)
 
 
