@@ -93,7 +93,47 @@ def bench_event_publish(n: int = 20_000) -> dict:
             "unit": "msg/s", "vs_baseline": round(rate / 3800.0, 2)}
 
 
+def bench_policy_eval(n: int = 5_000) -> dict:
+    """Full governance pipeline latency per before_tool_call (reference
+    budget: <5 ms for 10+ regex policies, governance/README.md:624)."""
+    import os
+    import tempfile
+
+    from vainplex_openclaw_tpu.core import Gateway
+    from vainplex_openclaw_tpu.governance import GovernancePlugin
+
+    user_policies = [
+        {"id": f"p{i}", "priority": 50 + i, "scope": {"hooks": ["before_tool_call"]},
+         "rules": [{"action": "audit",
+                    "conditions": [{"type": "tool", "tools": ["exec"],
+                                    "params": {"command":
+                                               {"matches": f"pattern-{i}-[a-z]+"}}}]}]}
+        for i in range(10)
+    ]
+    saved_home = os.environ.get("OPENCLAW_HOME")
+    with tempfile.TemporaryDirectory() as ws:
+        os.environ["OPENCLAW_HOME"] = os.path.join(ws, "home")
+        gw = Gateway(config={"workspace": ws, "agents": [{"id": "main"}]})
+        plugin = GovernancePlugin(workspace=ws)
+        gw.load(plugin, plugin_config={"policies": user_policies})
+        gw.start()
+        ctx = {"agent_id": "main", "session_key": "agent:main:s"}
+        gw.before_tool_call("exec", {"command": "ls -la /tmp"}, ctx)  # warmup
+        t0 = time.perf_counter()
+        for i in range(n):
+            gw.before_tool_call("exec", {"command": f"ls -la /tmp/dir{i}"}, ctx)
+        dt_ms = (time.perf_counter() - t0) * 1000.0 / n
+        gw.stop()
+    if saved_home is None:
+        os.environ.pop("OPENCLAW_HOME", None)
+    else:
+        os.environ["OPENCLAW_HOME"] = saved_home
+    baseline_ms = 5.0
+    return {"metric": "policy_eval_latency", "value": round(dt_ms, 4), "unit": "ms",
+            "vs_baseline": round(baseline_ms / dt_ms, 1)}  # >1 = faster than budget
+
+
 if __name__ == "__main__":
-    secondary = bench_event_publish()
-    print(f"secondary: {json.dumps(secondary)}", file=sys.stderr)
+    for fn in (bench_event_publish, bench_policy_eval):
+        print(f"secondary: {json.dumps(fn())}", file=sys.stderr)
     print(json.dumps(bench_trace_analyzer()))

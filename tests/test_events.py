@@ -81,6 +81,20 @@ def test_build_envelope_fields_and_trace_propagation():
     assert ev.scope["run_id"] == "r1"
 
 
+def test_session_precedence_ctx_session_id_beats_original_event():
+    """ctx.session_key → ctx.session_id → original_event.session_key — a
+    reordering changes the deterministic event id and breaks dedup."""
+    ev = build_envelope(
+        "message.in.received", {},
+        {"session_id": "s-ctx", "message_id": "m1",
+         "original_event": {"session_key": "s-original"}})
+    assert ev.session == "s-ctx"
+    ev2 = build_envelope(
+        "message.in.received", {},
+        {"message_id": "m1", "original_event": {"session_key": "s-original"}})
+    assert ev2.session == "s-original"
+
+
 def test_system_event_uses_system_identity():
     ev = build_envelope("gateway.started", {}, {"agent_id": "main"}, system_event=True)
     assert ev.agent == "system" and ev.session == "system"

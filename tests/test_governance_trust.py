@@ -345,3 +345,21 @@ class TestFrequencyTracker:
         for i in range(5):
             t.record("a", f"s{i}")
         assert t.count(60, "global") == 3
+
+    def test_none_session_key_counts_in_session_scope(self):
+        t = FrequencyTracker(clock=FakeClock())
+        t.record("a")  # no session key
+        t.record("a", "s1")
+        assert t.count(60, "session", session_key=None) == 1
+        assert t.count(60, "session", session_key="s1") == 1
+
+    def test_clock_step_backwards_does_not_corrupt_counts(self):
+        clk = FakeClock()
+        t = FrequencyTracker(max_entries=4, clock=clk)
+        t.record("a", "s")
+        clk.advance(-120)  # NTP step back
+        for _ in range(6):  # force ring evictions with out-of-order wall time
+            t.record("a", "s")
+            clk.advance(1)
+        assert t.count(3600, "global") == 4  # ring capacity respected
+        assert t.count(3600, "agent", "a") == 4

@@ -10,6 +10,7 @@ deterministic event IDs for idempotent re-publish
 from __future__ import annotations
 
 import hashlib
+import time
 import uuid
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
@@ -90,6 +91,10 @@ def derive_event_id(canonical_type: str, session: str, payload: dict, ctx: dict)
         ctx.get("run_id"), payload.get("run_id"), oe.get("run_id"),
         oe.get("id"),
     )
+    return _event_id(canonical_type, session, stable)
+
+
+def _event_id(canonical_type: str, session: str, stable: Optional[str]) -> str:
     if stable:
         h = hashlib.sha256(f"{session}:{canonical_type}:{stable}".encode()).hexdigest()[:16]
         return f"evt-{h}"
@@ -109,13 +114,27 @@ def build_envelope(
     now_ms: Optional[float] = None,
 ) -> ClawEvent:
     oe = ctx.get("original_event") or {}
+    # Hot path (every hook publishes through here): look each identifier up
+    # ONCE and reuse across id/scope/trace instead of re-deriving per field.
+    cg, pg, og = ctx.get, payload.get, oe.get
+    session_key = _first_str(cg("session_key"), og("session_key"))
+    session_id = _first_str(cg("session_id"), og("session_id"))
+    run_id = _first_str(cg("run_id"), pg("run_id"), og("run_id"))
+    tool_call_id = _first_str(pg("tool_call_id"), cg("tool_call_id"), og("tool_call_id"))
+    message_id = _first_str(cg("message_id"), pg("message_id"), og("message_id"))
+    job_id = _first_str(cg("job_id"), pg("job_id"), og("job_id"))
+
     agent = "system" if system_event else (
-        _first_str(ctx.get("agent_id"), payload.get("agent_id"), oe.get("agent_id")) or "unknown")
+        _first_str(cg("agent_id"), pg("agent_id"), og("agent_id")) or "unknown")
+    # precedence: ctx.session_key → ctx.session_id → original_event.session_key
     session = "system" if system_event else (
-        _first_str(ctx.get("session_key"), ctx.get("session_id"), oe.get("session_key")) or agent)
-    ts = now_ms if now_ms is not None else __import__("time").time() * 1000.0
+        _first_str(cg("session_key"), cg("session_id"), og("session_key")) or agent)
+    ts = now_ms if now_ms is not None else time.time() * 1000.0
+    # Specificity order tool-call id → message id → job id → run id
+    # (see derive_event_id docstring).
+    stable = tool_call_id or message_id or job_id or run_id or _first_str(og("id"))
     return ClawEvent(
-        id=derive_event_id(canonical_type, session, payload, ctx),
+        id=_event_id(canonical_type, session, stable),
         ts=ts,
         agent=agent,
         session=session,
@@ -126,26 +145,24 @@ def build_envelope(
         source={"plugin": plugin},
         actor={
             "agent_id": None if system_event else agent,
-            "user_id": _first_str(ctx.get("sender_id")),
-            "channel": _first_str(ctx.get("channel_id")),
+            "user_id": _first_str(cg("sender_id")),
+            "channel": _first_str(cg("channel_id")),
         },
         scope={
-            "session_key": _first_str(ctx.get("session_key"), oe.get("session_key")),
-            "session_id": _first_str(ctx.get("session_id"), oe.get("session_id")),
-            "run_id": _first_str(ctx.get("run_id"), payload.get("run_id"), oe.get("run_id")),
-            "tool_call_id": _first_str(payload.get("tool_call_id"), ctx.get("tool_call_id"),
-                                       oe.get("tool_call_id")),
-            "message_id": _first_str(ctx.get("message_id"), payload.get("message_id"), oe.get("message_id")),
-            "job_id": _first_str(ctx.get("job_id"), payload.get("job_id"), oe.get("job_id")),
+            "session_key": session_key,
+            "session_id": session_id,
+            "run_id": run_id,
+            "tool_call_id": tool_call_id,
+            "message_id": message_id,
+            "job_id": job_id,
         },
         trace={
-            "trace_id": _first_str(ctx.get("trace_id"), oe.get("trace_id")),
-            "span_id": _first_str(ctx.get("span_id"), oe.get("span_id")),
-            "parent_span_id": _first_str(ctx.get("parent_span_id"), oe.get("parent_span_id")),
-            "causation_id": _first_str(payload.get("causation_id"), oe.get("causation_id")),
-            "correlation_id": _first_str(ctx.get("run_id"), ctx.get("session_id"),
-                                         ctx.get("session_key"), oe.get("run_id"),
-                                         oe.get("session_id"), oe.get("session_key")),
+            "trace_id": _first_str(cg("trace_id"), og("trace_id")),
+            "span_id": _first_str(cg("span_id"), og("span_id")),
+            "parent_span_id": _first_str(cg("parent_span_id"), og("parent_span_id")),
+            "causation_id": _first_str(pg("causation_id"), og("causation_id")),
+            "correlation_id": _first_str(cg("run_id"), cg("session_id"), cg("session_key"),
+                                         og("run_id"), og("session_id"), og("session_key")),
         },
         visibility=visibility,
         redaction=redaction,

@@ -106,14 +106,16 @@ class EventStorePlugin:
         self.transport.publish(subject, envelope)  # fire-and-forget; failures counted
 
     def _make_handler(self, mapping: HookMapping):
+        attrs = {
+            "mapper": mapping.mapper, "legacy_type": mapping.legacy_type,
+            "visibility": mapping.visibility, "redaction": mapping.redaction,
+            "system_event": mapping.system_event,
+        }
+
         def handler(event: dict, ctx: dict) -> None:
             et = mapping.event_type
             canonical = et(event, ctx) if callable(et) else et
-            self._emit(canonical, {
-                "mapper": mapping.mapper, "legacy_type": mapping.legacy_type,
-                "visibility": mapping.visibility, "redaction": mapping.redaction,
-                "system_event": mapping.system_event,
-            }, event, ctx)
+            self._emit(canonical, attrs, event, ctx)
             return None
 
         return handler
