@@ -1,0 +1,126 @@
+"""Plugin manifest + schema validation tests (reference: the JSON-schema'd
+``openclaw.plugin.json`` manifest each package ships, SURVEY §5 config
+system)."""
+
+import pytest
+
+from vainplex_openclaw_tpu.config.manifest import (
+    PluginManifest,
+    enabled_section,
+    validate_schema,
+)
+from vainplex_openclaw_tpu.core import Gateway, list_logger
+
+import vainplex_openclaw_tpu.cortex.plugin as cortex_mod
+import vainplex_openclaw_tpu.events.plugin as events_mod
+import vainplex_openclaw_tpu.governance.plugin as gov_mod
+import vainplex_openclaw_tpu.knowledge.plugin as ke_mod
+import vainplex_openclaw_tpu.sitrep.plugin as sitrep_mod
+
+ALL_PLUGINS = [gov_mod, cortex_mod, events_mod, ke_mod, sitrep_mod]
+
+
+class TestValidateSchema:
+    def test_type_checks(self):
+        assert validate_schema({"type": "string"}, "x") == []
+        assert validate_schema({"type": "integer"}, 3) == []
+        assert validate_schema({"type": "integer"}, True)  # bool is not int
+        assert validate_schema({"type": "number"}, 3.5) == []
+        assert validate_schema({"type": "boolean"}, True) == []
+        assert validate_schema({"type": "null"}, None) == []
+        errs = validate_schema({"type": "string"}, 7)
+        assert errs and "expected" in errs[0]
+
+    def test_union_types(self):
+        schema = {"type": ["string", "null"]}
+        assert validate_schema(schema, None) == []
+        assert validate_schema(schema, "x") == []
+        assert validate_schema(schema, 3)
+
+    def test_enum(self):
+        schema = {"type": "string", "enum": ["open", "closed"]}
+        assert validate_schema(schema, "open") == []
+        assert "not in" in validate_schema(schema, "ajar")[0]
+
+    def test_min_max(self):
+        schema = {"type": "integer", "minimum": 1, "maximum": 10}
+        assert validate_schema(schema, 5) == []
+        assert "< minimum" in validate_schema(schema, 0)[0]
+        assert "> maximum" in validate_schema(schema, 11)[0]
+
+    def test_required_and_nested_paths(self):
+        schema = {"type": "object", "required": ["id"],
+                  "properties": {"id": {"type": "string"},
+                                 "sub": {"type": "object", "properties": {
+                                     "n": {"type": "integer"}}}}}
+        assert validate_schema(schema, {"id": "a", "sub": {"n": 1}}) == []
+        errs = validate_schema(schema, {"sub": {"n": "bad"}})
+        assert any("missing required" in e for e in errs)
+        assert any("$.sub.n" in e for e in errs)
+
+    def test_additional_properties_false_and_schema(self):
+        strict = {"type": "object", "properties": {"a": {}},
+                  "additionalProperties": False}
+        assert "unknown property" in validate_schema(strict, {"b": 1})[0]
+        mapped = {"type": "object",
+                  "additionalProperties": {"type": "number"}}
+        assert validate_schema(mapped, {"x": 1.5}) == []
+        assert validate_schema(mapped, {"x": "no"})
+
+    def test_array_items(self):
+        schema = {"type": "array", "items": {"type": "string"}}
+        assert validate_schema(schema, ["a", "b"]) == []
+        errs = validate_schema(schema, ["a", 3])
+        assert errs and "[1]" in errs[0]
+
+    def test_unknown_keywords_ignored(self):
+        assert validate_schema({"type": "string", "format": "uri"}, "x") == []
+
+
+class TestPluginManifests:
+    @pytest.mark.parametrize("mod", ALL_PLUGINS,
+                             ids=lambda m: m.MANIFEST.id)
+    def test_defaults_validate_against_own_schema(self, mod):
+        assert mod.MANIFEST.validate_config(mod.DEFAULTS) == []
+
+    @pytest.mark.parametrize("mod", ALL_PLUGINS,
+                             ids=lambda m: m.MANIFEST.id)
+    def test_manifest_shape(self, mod):
+        m = mod.MANIFEST
+        assert m.id and m.description
+        d = m.to_dict()
+        assert d["configSchema"]["type"] == "object"
+        assert isinstance(d["hooks"], list) and d["hooks"]
+
+    def test_manifest_catches_bad_config(self):
+        errs = gov_mod.MANIFEST.validate_config({"failMode": "sideways"})
+        assert errs and "sideways" in errs[0]
+        errs = events_mod.MANIFEST.validate_config({"transport": "carrier-pigeon"})
+        assert errs
+        errs = ke_mod.MANIFEST.validate_config(
+            {"extraction": {"minImportance": 2.0}})
+        assert errs and "maximum" in errs[0]
+
+    def test_eventstore_hooks_derived_from_mapping_table(self):
+        assert "before_tool_call" in events_mod.MANIFEST.hooks
+        assert "llm_input" in events_mod.MANIFEST.hooks
+
+
+class TestGatewayManifestValidation:
+    def test_bad_config_warns_but_loads(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OPENCLAW_HOME", str(tmp_path / "home"))
+        logger = list_logger()
+        gw = Gateway(config={"workspace": str(tmp_path)}, logger=logger)
+        plugin = gov_mod.GovernancePlugin(workspace=str(tmp_path))
+        gw.load(plugin, plugin_config={"failMode": "sideways"}, logger=logger)
+        warns = logger.messages("warn")
+        assert any("config schema" in w for w in warns)
+        assert plugin.engine is not None  # still loaded (warn-only)
+
+    def test_valid_config_no_warnings(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OPENCLAW_HOME", str(tmp_path / "home"))
+        logger = list_logger()
+        gw = Gateway(config={"workspace": str(tmp_path)}, logger=logger)
+        gw.load(gov_mod.GovernancePlugin(workspace=str(tmp_path)),
+                plugin_config={"failMode": "closed"}, logger=logger)
+        assert not [w for w in logger.messages("warn") if "config schema" in w]

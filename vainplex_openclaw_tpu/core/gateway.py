@@ -101,6 +101,12 @@ class Gateway:
         plugin_id = getattr(plugin, "id", None) or getattr(plugin, "ID", None)
         if not plugin_id:
             raise ValueError("plugin must expose an 'id'")
+        # Manifest validation (the openclaw.plugin.json equivalent): config
+        # problems are warnings, never load failures — the gateway must boot.
+        manifest = getattr(plugin, "manifest", None)
+        if manifest is not None and plugin_config:
+            for err in manifest.validate_config(plugin_config):
+                (logger or self.logger).warn(f"[{plugin_id}] config schema: {err}")
         api = PluginApi(plugin_id, self, plugin_config=plugin_config, logger=logger)
         plugin.register(api)
         self.plugins[plugin_id] = _LoadedPlugin(plugin_id, api, plugin)

@@ -16,6 +16,7 @@ from pathlib import Path
 from typing import Callable, Optional
 
 from ..config.loader import load_plugin_config
+from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand
 from .boot_context import BootContextGenerator
 from .commitment_tracker import CommitmentTracker
@@ -43,6 +44,54 @@ DEFAULTS = {
     "traceAnalyzer": {"enabled": False},
 }
 
+MANIFEST = PluginManifest(
+    id="cortex",
+    description="Conversation intelligence: threads, decisions, commitments, "
+                "boot context, pre-compaction snapshots, trace analyzer",
+    config_schema={
+        "type": "object",
+        "properties": {
+            "enabled": {"type": "boolean"},
+            "workspace": {"type": ["string", "null"]},
+            "languages": {"type": ["string", "array"],
+                          "items": {"type": "string"}},
+            "customPatterns": {"type": "object"},
+            "threads": enabled_section(
+                pruneDays={"type": "number", "minimum": 0},
+                maxThreads={"type": "integer", "minimum": 1}),
+            "decisions": enabled_section(
+                dedupeWindowHours={"type": "number", "minimum": 0}),
+            "commitments": enabled_section(
+                overdueDays={"type": "number", "minimum": 0}),
+            "bootContext": enabled_section(
+                maxChars={"type": "integer", "minimum": 100},
+                maxThreads={"type": "integer", "minimum": 1},
+                decisionDays={"type": "number", "minimum": 0},
+                maxDecisions={"type": "integer", "minimum": 0}),
+            "preCompaction": {"type": "object", "properties": {
+                "maxSnapshotMessages": {"type": "integer", "minimum": 1}}},
+            "narrative": enabled_section(),
+            "llmEnhance": enabled_section(
+                batchSize={"type": "integer", "minimum": 1}),
+            "registerTools": {"type": "boolean"},
+            "traceAnalyzer": enabled_section(
+                languages={"type": "array", "items": {"type": "string"}},
+                fetchBatchSize={"type": "integer", "minimum": 1},
+                maxEventsPerRun={"type": "integer", "minimum": 1},
+                gapMinutes={"type": "number", "minimum": 0},
+                maxEventsPerChain={"type": "integer", "minimum": 1},
+                signals={"type": "object"},
+                classify={"type": "object"},
+                scheduleMinutes={"type": "number", "minimum": 0},
+                natsUrl={"type": ["string", "null"]},
+                stream={"type": "string"}),
+        },
+    },
+    commands=("cortexstatus", "trace-analyze"),
+    hooks=("message_received", "message_sent", "agent_end", "session_start",
+           "before_compaction", "gateway_stop"),
+)
+
 
 class _WorkspaceTrackers:
     def __init__(self, workspace: str, config: dict, patterns: MergedPatterns,
@@ -69,6 +118,7 @@ class _WorkspaceTrackers:
 
 class CortexPlugin:
     id = "cortex"
+    manifest = MANIFEST
 
     def __init__(self, workspace: Optional[str] = None,
                  clock: Callable[[], float] = time.time,

@@ -12,6 +12,7 @@ from typing import Callable, Optional
 
 from ..core.api import PluginCommand, PluginService
 from ..config.loader import load_plugin_config
+from ..config.manifest import PluginManifest
 from .envelope import ClawEvent, build_envelope
 from .mappings import EXTRA_EMITTERS, HOOK_MAPPINGS, ExtraEmitter, HookMapping
 from .subjects import build_subject
@@ -28,9 +29,36 @@ DEFAULTS = {
     "publishPriority": 10_000,  # after every other plugin has seen the hook
 }
 
+MANIFEST = PluginManifest(
+    id="eventstore",
+    description="Durable event log: canonical envelope, hook→event mapping, "
+                "memory/file/NATS transports",
+    config_schema={
+        "type": "object",
+        "properties": {
+            "enabled": {"type": "boolean"},
+            "transport": {"type": "string", "enum": ["memory", "file", "nats"]},
+            "prefix": {"type": "string"},
+            "stream": {"type": "string"},
+            "natsUrl": {"type": "string"},
+            "fileRoot": {"type": ["string", "null"]},
+            "retention": {"type": "object", "properties": {
+                "maxMsgs": {"type": "integer", "minimum": 1},
+                "maxBytes": {"type": "integer", "minimum": 1},
+                "maxAgeS": {"type": ["number", "null"]}}},
+            "publishPriority": {"type": "integer"},
+        },
+    },
+    commands=("eventstatus",),
+    gateway_methods=("eventstore.status",),
+    hooks=tuple(sorted({m.hook_name for m in HOOK_MAPPINGS}
+                       | {e.hook_name for e in EXTRA_EMITTERS})),
+)
+
 
 class EventStorePlugin:
     id = "eventstore"
+    manifest = MANIFEST
 
     def __init__(self, transport=None, clock: Callable[[], float] = time.time):
         self._injected_transport = transport

@@ -23,11 +23,57 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..config.loader import load_plugin_config
+from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand, PluginService
 from .engine import GovernanceEngine
 from .util import extract_agent_ids, resolve_agent_id
 
 TOOL_LOG_MAX = 50  # per-session ring for the response gate
+
+MANIFEST = PluginManifest(
+    id="governance",
+    description="Agent firewall: policies, risk, trust, audit, redaction, "
+                "output validation, 2FA approval",
+    config_schema={
+        "type": "object",
+        "properties": {
+            "enabled": {"type": "boolean"},
+            "failMode": {"type": "string", "enum": ["open", "closed"]},
+            "timezone": {"type": "string"},
+            "workspace": {"type": ["string", "null"]},
+            "builtinPolicies": {"type": "object", "properties": {
+                "nightMode": {"type": "boolean"},
+                "credentialGuard": {"type": "boolean"},
+                "productionSafeguard": {"type": "boolean"},
+                "rateLimiter": {"type": ["object", "boolean"], "properties": {
+                    "maxPerMinute": {"type": "integer", "minimum": 1}}},
+            }},
+            "policies": {"type": "array", "items": {"type": "object",
+                                                    "required": ["id", "rules"]}},
+            "timeWindows": {"type": "object"},
+            "toolRiskOverrides": {"type": "object",
+                                  "additionalProperties": {"type": "number",
+                                                           "minimum": 0, "maximum": 100}},
+            "trust": enabled_section(),
+            "sessionTrust": enabled_section(),
+            "audit": enabled_section(
+                retentionDays={"type": "integer", "minimum": 0},
+                redactPatterns={"type": "array", "items": {"type": "string"}}),
+            "twoFa": enabled_section(),
+            "validation": enabled_section(),
+            "redaction": enabled_section(
+                failMode={"type": "string", "enum": ["open", "closed"]}),
+            "erc8004": enabled_section(),
+            "internalChannels": {"type": "array", "items": {"type": "string"}},
+        },
+    },
+    commands=("governance", "trust"),
+    gateway_methods=("governance.status", "governance.trust"),
+    hooks=("before_tool_call", "after_tool_call", "message_sending",
+           "before_message_write", "before_agent_start", "session_start",
+           "session_end", "gateway_stop", "message_received",
+           "tool_result_persist"),
+)
 
 DEFAULTS = {
     "enabled": True,
@@ -57,6 +103,7 @@ DEFAULTS = {
 
 class GovernancePlugin:
     id = "governance"
+    manifest = MANIFEST
 
     def __init__(self, workspace: Optional[str] = None,
                  clock: Callable[[], float] = time.time,

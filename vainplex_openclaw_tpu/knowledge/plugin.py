@@ -12,6 +12,7 @@ import time
 from typing import Callable, Optional
 
 from ..config.loader import load_plugin_config
+from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand, PluginService
 from .embeddings import create_embeddings
 from .entity_extractor import EntityExtractor
@@ -31,9 +32,37 @@ DEFAULTS = {
     "maintenance": {"decayHours": 24, "syncMinutes": 30},
 }
 
+MANIFEST = PluginManifest(
+    id="knowledge-engine",
+    description="Entity extraction into a decaying fact store with embeddings",
+    config_schema={
+        "type": "object",
+        "properties": {
+            "enabled": {"type": "boolean"},
+            "workspace": {"type": ["string", "null"]},
+            "storage": {"type": "object", "properties": {
+                "maxFacts": {"type": "integer", "minimum": 1},
+                "writeDebounceMs": {"type": "integer", "minimum": 0}}},
+            "extraction": {"type": "object", "properties": {
+                "minImportance": {"type": "number", "minimum": 0, "maximum": 1},
+                "mentionPredicate": {"type": "string"}}},
+            "llm": enabled_section(batchSize={"type": "integer", "minimum": 1}),
+            "embeddings": enabled_section(
+                backend={"type": "string", "enum": ["local", "chroma", "none"]},
+                endpoint={"type": "string"},
+                collectionName={"type": "string"}),
+            "maintenance": {"type": "object", "properties": {
+                "decayHours": {"type": "number", "minimum": 0},
+                "syncMinutes": {"type": "number", "minimum": 0}}},
+        },
+    },
+    hooks=("session_start", "message_received", "message_sent", "gateway_stop"),
+)
+
 
 class KnowledgeEnginePlugin:
     id = "knowledge-engine"
+    manifest = MANIFEST
 
     def __init__(self, workspace: Optional[str] = None,
                  clock: Callable[[], float] = time.time,

@@ -8,6 +8,7 @@ import time
 from typing import Callable, Optional
 
 from ..config.loader import load_plugin_config
+from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand, PluginService
 from .aggregator import generate_sitrep, write_sitrep
 
@@ -26,9 +27,31 @@ DEFAULTS = {
     "customCollectors": [],
 }
 
+MANIFEST = PluginManifest(
+    id="sitrep",
+    description="Interval situation reports aggregated from pluggable collectors",
+    config_schema={
+        "type": "object",
+        "properties": {
+            "enabled": {"type": "boolean"},
+            "workspace": {"type": ["string", "null"]},
+            "intervalMinutes": {"type": "number", "minimum": 0},
+            "collectors": {"type": "object",
+                           "additionalProperties": enabled_section()},
+            "customCollectors": {"type": "array", "items": {
+                "type": "object", "required": ["id", "command"],
+                "properties": {"id": {"type": "string"},
+                               "command": {"type": "string"}}}},
+        },
+    },
+    commands=("sitrep",),
+    hooks=("gateway_stop",),
+)
+
 
 class SitrepPlugin:
     id = "sitrep"
+    manifest = MANIFEST
 
     def __init__(self, workspace: Optional[str] = None,
                  clock: Callable[[], float] = time.time, wall_timers: bool = True):
