@@ -133,7 +133,45 @@ def bench_policy_eval(n: int = 5_000) -> dict:
             "vs_baseline": round(baseline_ms / dt_ms, 1)}  # >1 = faster than budget
 
 
+def bench_encoder_throughput(batch: int = 256, steps: int = 20) -> dict:
+    """Flagship CortexEncoder forward throughput on the available accelerator
+    (tokens/s). No reference baseline exists (the reference runs no models);
+    vs_baseline reports tokens/s per microsecond of the reference's 5 ms
+    policy budget purely for scale — i.e. it is informational."""
+    import jax
+    import numpy as np
+
+    from vainplex_openclaw_tpu.models import EncoderConfig, forward, init_params
+
+    cfg = EncoderConfig()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = np.random.randint(0, cfg.vocab_size, size=(batch, cfg.seq_len),
+                               dtype=np.int32)
+    fn = jax.jit(lambda p, t: forward(p, t, cfg))
+    out = fn(params, tokens)  # compile + warmup
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(params, tokens)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    tokens_per_s = batch * cfg.seq_len * steps / dt
+    return {"metric": "encoder_throughput", "value": round(tokens_per_s, 0),
+            "unit": "tokens/s", "vs_baseline": None,
+            "device": jax.devices()[0].platform}
+
+
 if __name__ == "__main__":
     for fn in (bench_event_publish, bench_policy_eval):
-        print(f"secondary: {json.dumps(fn())}", file=sys.stderr)
-    print(json.dumps(bench_trace_analyzer()))
+        try:
+            print(f"secondary: {json.dumps(fn())}", file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — secondaries must not kill the headline
+            print(f"secondary failed: {exc}", file=sys.stderr)
+    # Headline measured BEFORE the encoder bench: initializing JAX/TPU in
+    # this process measurably slows the pure-Python pipeline afterwards.
+    headline = bench_trace_analyzer()
+    try:
+        print(f"secondary: {json.dumps(bench_encoder_throughput())}", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001
+        print(f"secondary failed: {exc}", file=sys.stderr)
+    print(json.dumps(headline))

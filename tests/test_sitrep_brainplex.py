@@ -257,6 +257,23 @@ class TestDemo:
         assert "BOOTSTRAP" in out      # boot context regenerated
 
 
+class TestBrainplexManifestValidation:
+    def test_generated_configs_validate_against_manifests(self):
+        from vainplex_openclaw_tpu.brainplex.configurator import validate_generated
+
+        configs = generate_configs(
+            ["governance", "cortex", "eventstore", "sitrep", "knowledge-engine"],
+            ["main", "helper"])
+        assert validate_generated(configs) == {}
+
+    def test_invalid_config_reported_per_plugin(self):
+        from vainplex_openclaw_tpu.brainplex.configurator import validate_generated
+
+        problems = validate_generated({"governance": {"failMode": "sideways"},
+                                       "unknown-plugin": {"whatever": 1}})
+        assert "governance" in problems and "unknown-plugin" not in problems
+
+
 class TestBrainplexRegressions:
     """Fixes from review: JSON5 merge safety, --config honored, no wipe."""
 

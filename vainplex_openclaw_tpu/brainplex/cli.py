@@ -10,7 +10,12 @@ import sys
 from pathlib import Path
 from typing import Optional
 
-from .configurator import CORE_PLUGINS, OPTIONAL_PLUGINS, generate_configs
+from .configurator import (
+    CORE_PLUGINS,
+    OPTIONAL_PLUGINS,
+    generate_configs,
+    validate_generated,
+)
 from .scanner import scan
 from .writer import update_openclaw_config, write_config
 
@@ -136,6 +141,9 @@ def run_init(args: dict, start_dir: Optional[str] = None,
 
     # 6-8: generate + write per-plugin configs
     configs = generate_configs(plan["install"], result["agents"])
+    for plugin_id, errors in validate_generated(configs).items():
+        for err in errors:
+            out.warn(f"{plugin_id} config schema: {err}")
     config_root = Path(result["config_path"]).parent / "plugins"
     entries = {}
     for plugin_id, config in configs.items():

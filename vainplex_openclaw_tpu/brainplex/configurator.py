@@ -48,3 +48,37 @@ def default_config_for(plugin_id: str, agents: Optional[list[str]] = None) -> di
 
 def generate_configs(plugin_ids: list[str], agents: list[str]) -> dict[str, dict]:
     return {pid: default_config_for(pid, agents) for pid in plugin_ids}
+
+
+def manifest_for(plugin_id: str):
+    """Resolve the installed plugin's manifest, or None if unknown."""
+    from importlib import import_module
+
+    modules = {
+        "governance": "vainplex_openclaw_tpu.governance.plugin",
+        "cortex": "vainplex_openclaw_tpu.cortex.plugin",
+        "eventstore": "vainplex_openclaw_tpu.events.plugin",
+        "knowledge-engine": "vainplex_openclaw_tpu.knowledge.plugin",
+        "sitrep": "vainplex_openclaw_tpu.sitrep.plugin",
+    }
+    name = modules.get(plugin_id)
+    if name is None:
+        return None
+    try:
+        return getattr(import_module(name), "MANIFEST", None)
+    except ImportError:
+        return None
+
+
+def validate_generated(configs: dict[str, dict]) -> dict[str, list[str]]:
+    """Validate generated configs against each plugin's manifest schema.
+    Returns {plugin_id: [errors]} with only failing plugins present."""
+    problems: dict[str, list[str]] = {}
+    for pid, config in configs.items():
+        manifest = manifest_for(pid)
+        if manifest is None:
+            continue
+        errors = manifest.validate_config(config)
+        if errors:
+            problems[pid] = errors
+    return problems
