@@ -231,6 +231,23 @@ def test_llm_hooks_omit_bodies(openclaw_home):
     assert "secret prompt" not in json.dumps(ev.to_dict())
 
 
+def test_typed_llm_and_compaction_flows(openclaw_home):
+    """Gateway typed entry points for the remaining Layer-B hooks
+    (llm_input/llm_output/after_compaction, SURVEY §1)."""
+    gw, plugin = _loaded_gateway()
+    ctx = {"agent_id": "m", "session_key": "s"}
+    gw.llm_input("prompt body", ctx)
+    gw.llm_output("completion body", ctx)
+    gw.after_compaction(ctx, kept_messages=7)
+    types = [e.canonical_type for e in plugin.transport.fetch()]
+    assert "model.input.observed" in types
+    assert "model.output.observed" in types
+    assert "session.compaction.ended" in types
+    ended = next(e for e in plugin.transport.fetch()
+                 if e.canonical_type == "session.compaction.ended")
+    assert "completion body" not in json.dumps(ended.to_dict())
+
+
 def test_gateway_lifecycle_system_events_and_status(openclaw_home):
     gw, plugin = _loaded_gateway()
     gw.start()

@@ -299,6 +299,18 @@ class Gateway:
                           messages: Optional[list] = None) -> list[Any]:
         return self.fire("before_compaction", {"messages": messages or []}, dict(ctx or {}))
 
+    def after_compaction(self, ctx: Optional[dict] = None,
+                         kept_messages: int = 0) -> list[Any]:
+        return self.fire("after_compaction", {"kept_messages": kept_messages},
+                         dict(ctx or {}))
+
+    def llm_input(self, prompt: str, ctx: Optional[dict] = None) -> list[Any]:
+        """Observation hook; the event store records lengths only, never bodies."""
+        return self.fire("llm_input", {"prompt": prompt}, dict(ctx or {}))
+
+    def llm_output(self, completion: str, ctx: Optional[dict] = None) -> list[Any]:
+        return self.fire("llm_output", {"completion": completion}, dict(ctx or {}))
+
     # ── commands & RPC ───────────────────────────────────────────────
 
     def command(self, name: str, ctx: Optional[dict] = None, args: str = "") -> dict:
