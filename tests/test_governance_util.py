@@ -1,0 +1,147 @@
+"""Unit tests for governance shared helpers (reference: governance/src/util.ts
+has its own test file; SURVEY §2.1 lists util at 264 LoC)."""
+
+import pytest
+
+from vainplex_openclaw_tpu.governance.util import (
+    clamp,
+    current_time_context,
+    extract_agent_id,
+    extract_agent_ids,
+    extract_parent_session_key,
+    glob_to_regex,
+    is_in_time_range,
+    is_sub_agent,
+    is_tier_at_least,
+    is_tier_at_most,
+    parse_agent_from_session_key,
+    parse_time_to_minutes,
+    resolve_agent_id,
+    risk_ordinal,
+    score_to_tier,
+    tier_ordinal,
+)
+
+
+class TestTiers:
+    @pytest.mark.parametrize("score,tier", [
+        (0, "untrusted"), (19.9, "untrusted"), (20, "restricted"),
+        (39.9, "restricted"), (40, "standard"), (59.9, "standard"),
+        (60, "trusted"), (79.9, "trusted"), (80, "elevated"), (100, "elevated"),
+    ])
+    def test_score_to_tier_boundaries(self, score, tier):
+        assert score_to_tier(score) == tier
+
+    def test_tier_ordering(self):
+        assert tier_ordinal("elevated") > tier_ordinal("trusted") > \
+            tier_ordinal("standard") > tier_ordinal("restricted") > \
+            tier_ordinal("untrusted")
+        assert tier_ordinal("nonsense") == 0  # unknown → untrusted
+
+    def test_tier_comparisons(self):
+        assert is_tier_at_least("trusted", "standard")
+        assert is_tier_at_least("standard", "standard")
+        assert not is_tier_at_least("restricted", "standard")
+        assert is_tier_at_most("restricted", "standard")
+        assert not is_tier_at_most("elevated", "trusted")
+
+    def test_risk_ordinal(self):
+        assert risk_ordinal("critical") > risk_ordinal("high") > \
+            risk_ordinal("medium") > risk_ordinal("low")
+        assert risk_ordinal("??") == 0
+
+    def test_clamp(self):
+        assert clamp(150, 0, 100) == 100
+        assert clamp(-5, 0, 100) == 0
+        assert clamp(42, 0, 100) == 42
+
+
+class TestGlobAndTime:
+    def test_glob_to_regex(self):
+        assert glob_to_regex("tool_*").match("tool_exec")
+        assert not glob_to_regex("tool_*").match("mytool_exec")
+        assert glob_to_regex("a?c").match("abc")
+        assert not glob_to_regex("a?c").match("abbc")
+        # regex metacharacters in the glob are literal
+        assert glob_to_regex("a.b").match("a.b")
+        assert not glob_to_regex("a.b").match("axb")
+
+    @pytest.mark.parametrize("text,minutes", [
+        ("00:00", 0), ("23:59", 23 * 60 + 59), ("08:30", 510),
+        ("24:00", -1), ("12:60", -1), ("nope", -1), ("12", -1), ("a:b", -1),
+    ])
+    def test_parse_time_to_minutes(self, text, minutes):
+        assert parse_time_to_minutes(text) == minutes
+
+    def test_time_range_plain_and_midnight_wrap(self):
+        # [09:00, 17:00)
+        assert is_in_time_range(9 * 60, 9 * 60, 17 * 60)
+        assert not is_in_time_range(17 * 60, 9 * 60, 17 * 60)
+        # [23:00, 06:00) wraps midnight
+        assert is_in_time_range(23 * 60 + 30, 23 * 60, 6 * 60)
+        assert is_in_time_range(2 * 60, 23 * 60, 6 * 60)
+        assert not is_in_time_range(12 * 60, 23 * 60, 6 * 60)
+
+    def test_current_time_context_sunday_zero(self):
+        # 2026-07-26 was a Sunday; noon local epoch for a fixed check
+        import time as _t
+
+        ts = _t.mktime((2026, 7, 26, 12, 30, 0, 0, 0, -1))
+        ctx = current_time_context(ts)
+        assert ctx.day_of_week == 0  # Sunday → 0 (reference Intl convention)
+        assert ctx.hour == 12 and ctx.minute == 30
+        assert ctx.date == "2026-07-26"
+
+
+class TestSessionKeys:
+    def test_parse_agent_simple_and_subagent(self):
+        assert parse_agent_from_session_key("agent:viola:telegram:1") == "viola"
+        assert parse_agent_from_session_key(
+            "agent:main:subagent:helper:123") == "helper"
+        assert parse_agent_from_session_key("random") is None
+        assert parse_agent_from_session_key("agent:") is None
+
+    def test_extract_agent_id_fallbacks(self):
+        assert extract_agent_id(agent_id="x") == "x"
+        assert extract_agent_id(session_key="agent:main:1") == "main"
+        assert extract_agent_id(session_key="plain") == "plain"
+        assert extract_agent_id() == "unknown"
+
+    def test_resolve_agent_id_chain_and_unresolved(self):
+        assert resolve_agent_id({"agent_id": "a"}) == "a"
+        assert resolve_agent_id({"session_key": "agent:m:1"}) == "m"
+        assert resolve_agent_id({"session_id": "agent:n:2"}) == "n"
+        assert resolve_agent_id({}, {"metadata": {"agent_id": "meta"}}) == "meta"
+        # 'unresolved', NOT 'unknown' (the trust migration depends on this)
+        assert resolve_agent_id({}) == "unresolved"
+
+    def test_sub_agent_helpers(self):
+        key = "agent:main:tg:1:subagent:child:9"
+        assert is_sub_agent(key) and not is_sub_agent("agent:main:1")
+        assert extract_parent_session_key(key) == "agent:main:tg:1"
+        assert extract_parent_session_key("agent:main:1") is None
+
+
+class TestExtractAgentIds:
+    """All 4 openclaw.json agent shapes (reference scanner.ts:58-90)."""
+
+    def test_flat_list(self):
+        assert extract_agent_ids({"agents": [{"id": "a"}, "b"]}) == ["a", "b"]
+
+    def test_agents_list(self):
+        assert extract_agent_ids(
+            {"agents": {"list": [{"id": "a"}, {"name": "c"}]}}) == ["a", "c"]
+
+    def test_agents_definitions(self):
+        assert extract_agent_ids(
+            {"agents": {"definitions": ["x", {"id": "y"}]}}) == ["x", "y"]
+
+    def test_named_keys(self):
+        assert sorted(extract_agent_ids(
+            {"agents": {"main": {}, "helper": {}, "defaults": {}}})) == \
+            ["helper", "main"]
+
+    def test_absent_or_malformed(self):
+        assert extract_agent_ids({}) == []
+        assert extract_agent_ids({"agents": 42}) == []
+        assert extract_agent_ids({"agents": {"list": "nope"}}) == []

@@ -144,20 +144,32 @@ def extract_parent_session_key(session_key: str) -> Optional[str]:
 
 
 def extract_agent_ids(openclaw_config: dict) -> list[str]:
-    """Agent ids from openclaw.json across both list shapes."""
+    """Agent ids from openclaw.json across the 4 config shapes the reference
+    supports (scanner.ts:58-90): flat list, agents.list, agents.definitions,
+    and named keys."""
+
+    def names(entries: list) -> list[str]:
+        out = []
+        for entry in entries:
+            if isinstance(entry, str):
+                out.append(entry)
+            elif isinstance(entry, dict):
+                for key in ("id", "name"):
+                    if isinstance(entry.get(key), str):
+                        out.append(entry[key])
+                        break
+        return out
+
     agents = openclaw_config.get("agents")
-    if not isinstance(agents, dict):
-        return []
-    entries = agents.get("list")
-    if not isinstance(entries, list):
-        return []
-    out = []
-    for entry in entries:
-        if isinstance(entry, str):
-            out.append(entry)
-        elif isinstance(entry, dict) and isinstance(entry.get("id"), str):
-            out.append(entry["id"])
-    return out
+    if isinstance(agents, list):
+        return names(agents)
+    if isinstance(agents, dict):
+        for key in ("list", "definitions"):
+            if isinstance(agents.get(key), list):
+                return names(agents[key])
+        meta = {"definitions", "defaults", "list"}
+        return [k for k in agents if k not in meta]
+    return []
 
 
 def now_us() -> int:
