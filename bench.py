@@ -169,9 +169,23 @@ if __name__ == "__main__":
             print(f"secondary failed: {exc}", file=sys.stderr)
     # Headline measured BEFORE the encoder bench: initializing JAX/TPU in
     # this process measurably slows the pure-Python pipeline afterwards.
+    # The encoder bench runs in a CHILD process with a hard timeout — a
+    # wedged accelerator tunnel blocks inside device init where no Python
+    # exception can fire, and it must not take the headline down with it.
     headline = bench_trace_analyzer()
     try:
-        print(f"secondary: {json.dumps(bench_encoder_throughput())}", file=sys.stderr)
+        import subprocess
+
+        child = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; print(json.dumps(bench.bench_encoder_throughput()))"],
+            capture_output=True, text=True, timeout=300,
+            cwd=__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+        if child.returncode == 0 and child.stdout.strip():
+            print(f"secondary: {child.stdout.strip().splitlines()[-1]}", file=sys.stderr)
+        else:
+            print(f"secondary failed: rc={child.returncode} "
+                  f"{child.stderr.strip()[-200:]}", file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"secondary failed: {exc}", file=sys.stderr)
     print(json.dumps(headline))

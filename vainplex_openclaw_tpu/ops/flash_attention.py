@@ -1,12 +1,13 @@
 """Flash attention as a Pallas TPU kernel.
 
-Tiled exact attention for the flagship encoder's single-chip hot path: the
-grid runs over (batch·heads, query blocks); each program streams K/V blocks
-from VMEM through the MXU, carrying the online-softmax running max / sum /
-accumulator so the L×L score matrix never materialises. Softmax statistics
-accumulate in fp32 (`preferred_element_type`) regardless of input dtype;
-block shapes are MXU/VPU-aligned (sublane multiples of 8, lane dim padded to
-128 by Mosaic).
+Tiled exact attention for the flagship encoder's single-chip hot path. The
+grid is (batch·heads, query blocks, kv blocks): Pallas streams one K/V block
+per step through the MXU (double-buffered HBM→VMEM fetches — only
+O(block) VMEM regardless of sequence length), carrying the online-softmax
+running max / sum / accumulator in VMEM scratch across the kv dimension of
+the grid. Softmax statistics accumulate in fp32 (`preferred_element_type`)
+regardless of input dtype; block shapes are MXU/VPU-aligned (the stats
+scratch keeps a 128-lane last dimension).
 
 On non-TPU backends the same kernel runs under the Pallas interpreter
 (`interpret=True`) so tests validate the exact kernel logic on the CPU mesh;
@@ -23,51 +24,61 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_STATS_LANES = 128  # keep scratch lane dimension hardware-aligned
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int,
-                  causal: bool, block_q: int, scale: float):
-    # q_ref: [1, block_q, Dh]; k_ref/v_ref: [1, L, Dh]; bias_ref: [1, L]
-    q = q_ref[0].astype(jnp.float32) * scale
-    L = k_ref.shape[1]
-    Dh = q_ref.shape[2]
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, causal: bool, block_q: int, block_k: int, scale: float,
+                  n_kb: int):
+    # q_ref: [1, block_q, Dh]; k_ref/v_ref: [1, block_k, Dh];
+    # bias_ref: [1, 1, block_k]; scratch persists across the kv grid dim.
     qi = pl.program_id(1)
+    j = pl.program_id(2)
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, Dh), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k.astype(jnp.float32),
-                                (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s + bias_ref[0, pl.ds(j * block_k, block_k)][None, :]
+    # Under causality, kv blocks strictly after the query block are fully
+    # masked — skip their compute entirely (the grid still visits them).
+    live = (not causal) or (j * block_k <= (qi + 1) * block_q - 1)
+
+    @pl.when(live)
+    def _block():
+        # Inputs stay in their native dtype (bf16 feeds the MXU at full
+        # rate); accumulation is f32 via preferred_element_type. Scale is
+        # applied to the f32 scores, not the inputs.
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = s + bias_ref[0, 0, :][None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
-    n_kb = L // block_k
-    if causal:
-        # K/V blocks strictly after the query block are fully masked — skip.
-        n_kb = jnp.minimum(n_kb, ((qi + 1) * block_q + block_k - 1) // block_k)
-    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == n_kb - 1)
+    def _final():
+        l = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -90,27 +101,36 @@ def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
         interpret = jax.default_backend() != "tpu"
 
     if kv_mask is None:
-        bias = jnp.zeros((B, L), jnp.float32)
+        bias = jnp.zeros((B, 1, L), jnp.float32)
     else:
-        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
+        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)[:, None, :]
 
     qf = q.reshape(B * H, L, Dh)
     kf = k.reshape(B * H, L, Dh)
     vf = v.reshape(B * H, L, Dh)
+    n_kb = L // block_k
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
-                               block_q=block_q, scale=1.0 / np.sqrt(Dh))
+    kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
+                               block_k=block_k, scale=1.0 / np.sqrt(Dh),
+                               n_kb=n_kb)
     out = pl.pallas_call(
         kernel,
-        grid=(B * H, L // block_q),
+        grid=(B * H, L // block_q, n_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, Dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, L, Dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, L, Dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, L), lambda b, i: (b // H, 0)),
+            pl.BlockSpec((1, block_q, Dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // H, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, Dh), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, Dh), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, L, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, Dh), jnp.float32),            # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, bias)
     return out.reshape(B, H, L, Dh)
