@@ -93,6 +93,27 @@ def bench_event_publish(n: int = 20_000) -> dict:
             "unit": "msg/s", "vs_baseline": round(rate / 3800.0, 2)}
 
 
+def bench_consumer_read(n: int = 50_000) -> dict:
+    """Event-store consumer read throughput (envelope fetch + dict roundtrip),
+    vs the reference's NATS consumer-read baseline (~20,000 msg/s)."""
+    from vainplex_openclaw_tpu.events.envelope import build_envelope
+    from vainplex_openclaw_tpu.events.transport import MemoryTransport
+
+    transport = MemoryTransport(max_msgs=n + 1)
+    for i in range(n):
+        ev = build_envelope("message.in.received", {"chars": 42},
+                            {"agent_id": "main", "session_key": "s",
+                             "message_id": f"m{i}"})
+        transport.publish(f"claw.main.msg{i % 56}", ev)
+    t0 = time.perf_counter()
+    count = sum(1 for e in transport.fetch() if e.payload["chars"] == 42)
+    dt = time.perf_counter() - t0
+    assert count == n
+    rate = n / dt
+    return {"metric": "event_store_consumer_read", "value": round(rate, 1),
+            "unit": "msg/s", "vs_baseline": round(rate / 20_000.0, 2)}
+
+
 def bench_policy_eval(n: int = 5_000) -> dict:
     """Full governance pipeline latency per before_tool_call (reference
     budget: <5 ms for 10+ regex policies, governance/README.md:624)."""
@@ -162,7 +183,7 @@ def bench_encoder_throughput(batch: int = 256, steps: int = 20) -> dict:
 
 
 if __name__ == "__main__":
-    for fn in (bench_event_publish, bench_policy_eval):
+    for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval):
         try:
             print(f"secondary: {json.dumps(fn())}", file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — secondaries must not kill the headline
@@ -179,7 +200,7 @@ if __name__ == "__main__":
         child = subprocess.run(
             [sys.executable, "-c",
              "import json, bench; print(json.dumps(bench.bench_encoder_throughput()))"],
-            capture_output=True, text=True, timeout=300,
+            capture_output=True, text=True, timeout=180,
             cwd=__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
         if child.returncode == 0 and child.stdout.strip():
             print(f"secondary: {child.stdout.strip().splitlines()[-1]}", file=sys.stderr)
