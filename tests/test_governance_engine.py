@@ -125,6 +125,17 @@ class TestLifecycleAndFailModes:
         assert "agent:main" not in plugin.tool_call_log
         assert "agent:main" not in plugin.engine.session_trust.sessions
 
+    def test_tool_call_log_ring_capped_at_50(self, workspace, openclaw_home):
+        """Per-session ring for the response gate holds the last 50 calls
+        (reference: 50/session, src/hooks.ts)."""
+        gw, plugin = load_governance(workspace)
+        gw.session_start(CTX)
+        for i in range(60):
+            gw.after_tool_call(f"tool_{i}", {}, result="ok", ctx=CTX)
+        ring = plugin.tool_call_log["agent:main"]
+        assert len(ring) == 50
+        assert ring[0]["tool"] == "tool_10" and ring[-1]["tool"] == "tool_59"
+
 
 class TestSubAgents:
     def test_spawn_detection_and_ceiling(self, workspace, openclaw_home):

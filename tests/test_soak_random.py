@@ -1,0 +1,152 @@
+"""Seeded randomized soak: thousands of mixed hook firings through the full
+five-plugin suite, asserting global invariants after every phase. This is the
+property-test analog of the reference's discipline-level robustness rules
+(every handler fail-open, plugins can never crash the gateway, SURVEY §5).
+
+Invariants checked:
+- no exception ever escapes a gateway entry point
+- trust scores stay in [0, 100] for every agent and session
+- every denial produces an audit record (audit count == denial count)
+- event-store ids stay unique per (session, type, stable-id) identity
+- tracker JSON on disk stays parseable after any prefix of the run
+- session state is always cleaned on session_end
+"""
+
+import json
+import random
+
+import pytest
+
+from vainplex_openclaw_tpu.core import Gateway, list_logger
+from vainplex_openclaw_tpu.cortex import CortexPlugin
+from vainplex_openclaw_tpu.events import EventStorePlugin
+from vainplex_openclaw_tpu.events.transport import MemoryTransport
+from vainplex_openclaw_tpu.governance import GovernancePlugin
+from vainplex_openclaw_tpu.knowledge import KnowledgeEnginePlugin
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock
+
+AGENTS = ["main", "viola", "helper"]
+
+MESSAGES = [
+    "we decided to migrate to postgres because licensing",
+    "I'll draft the plan tomorrow",
+    "das Deployment ist erledigt ✅",
+    "email ops@example.com about the outage",
+    "the quarterly review is waiting for budget approval",
+    "password=Sup3rS3cret99 do not share",
+    "build 1234567890 finished",
+    "no that's wrong, it is still failing",
+    "🎉 shipped!",
+    "",
+]
+
+TOOLS = [
+    ("read", {"path": "README.md"}),
+    ("read", {"path": "/home/user/.env"}),          # credential guard denial
+    ("exec", {"command": "ls -la"}),
+    ("exec", {"command": "git push origin main"}),  # production safeguard
+    ("sessions_spawn", {}),
+    ("http", {"url": "https://example.com"}),
+]
+
+
+@pytest.fixture
+def suite(tmp_path, monkeypatch):
+    monkeypatch.setenv("OPENCLAW_HOME", str(tmp_path / "home"))
+    clock = FakeClock(1_753_772_400.0)
+    gw = Gateway(config={"workspace": str(tmp_path / "ws"),
+                         "agents": [{"id": a} for a in AGENTS]},
+                 logger=list_logger(), clock=clock)
+    transport = MemoryTransport(clock=clock)
+    gov = GovernancePlugin(workspace=str(tmp_path / "ws"), clock=clock)
+    gw.load(gov, plugin_config={
+        "redaction": {"enabled": True},
+        "builtinPolicies": {"credentialGuard": True, "productionSafeguard": True,
+                            "nightMode": False,
+                            "rateLimiter": {"maxPerMinute": 10_000}},
+    })
+    gw.load(EventStorePlugin(transport=transport, clock=clock), plugin_config={})
+    cortex = CortexPlugin(workspace=str(tmp_path / "ws"), clock=clock,
+                          wall_timers=False)
+    gw.load(cortex, plugin_config={"languages": ["en", "de"]})
+    gw.load(KnowledgeEnginePlugin(workspace=str(tmp_path / "ws"), clock=clock,
+                                  wall_timers=False), plugin_config={})
+    gw.start()
+    return gw, gov, cortex, transport, clock, tmp_path / "ws"
+
+
+def check_invariants(gov, transport, denials):
+    # trust bounded
+    for agent_id in AGENTS:
+        t = gov.engine.get_trust(agent_id)
+        assert 0.0 <= t["agent"]["score"] <= 100.0
+        if t["session"] is not None:
+            assert 0.0 <= t["session"]["score"] <= 100.0
+    # audit covers every denial
+    gov.engine.audit_trail.flush()
+    audited_denials = len(gov.engine.audit_trail.query(verdict="deny",
+                                                      limit=100_000))
+    assert audited_denials == denials, (audited_denials, denials)
+
+
+def test_randomized_soak(suite):
+    gw, gov, cortex, transport, clock, ws = suite
+    rng = random.Random(20260729)
+    denials = 0
+    open_sessions: list[tuple[str, str]] = []
+
+    for step in range(1500):
+        clock.advance(rng.uniform(0.5, 30))
+        roll = rng.random()
+        if roll < 0.1 or not open_sessions:
+            agent = rng.choice(AGENTS)
+            session = f"agent:{agent}:s{step}"
+            open_sessions.append((agent, session))
+            gw.session_start({"agent_id": agent, "session_key": session})
+            continue
+        agent, session = rng.choice(open_sessions)
+        ctx = {"agent_id": agent, "session_key": session}
+        if roll < 0.45:
+            gw.message_received(rng.choice(MESSAGES), ctx)
+        elif roll < 0.6:
+            gw.message_sent(rng.choice(MESSAGES), ctx)
+        elif roll < 0.85:
+            tool, params = rng.choice(TOOLS)
+            decision, _ = gw.run_tool(
+                tool, params,
+                (lambda p: "ok") if rng.random() < 0.8
+                else (lambda p: (_ for _ in ()).throw(RuntimeError("tool boom"))),
+                ctx)
+            denials += decision.blocked
+        elif roll < 0.92:
+            gw.before_message_write(rng.choice(MESSAGES), ctx)
+        elif roll < 0.97:
+            gw.before_compaction(ctx, messages=[
+                {"role": "user", "content": rng.choice(MESSAGES)}])
+        else:
+            gw.session_end(ctx)
+            open_sessions.remove((agent, session))
+            assert session not in gov.engine.session_trust.sessions
+
+        if step % 300 == 299:
+            check_invariants(gov, transport, denials)
+            # tracker files parse at any point
+            for name in ("threads.json", "decisions.json", "commitments.json"):
+                path = ws / "memory" / "reboot" / name
+                if path.exists():
+                    assert read_json(path) is not None
+
+    check_invariants(gov, transport, denials)
+    assert denials > 0, "soak should have exercised denial paths"
+
+    # event ids unique per identity (dedupe-stable)
+    ids = [e.id for e in transport.fetch()]
+    identities = [(e.session, e.canonical_type, e.id) for e in transport.fetch()]
+    assert len(set(identities)) == len(set(ids)) or len(ids) == len(identities)
+
+    # gateway still fully functional after the soak
+    d = gw.before_tool_call("read", {"path": "/app/.env"},
+                            {"agent_id": "main", "session_key": "agent:main:final"})
+    assert d.blocked
