@@ -1,0 +1,99 @@
+"""Per-language signal detection across all 10 packs (reference:
+cortex/src/trace-analyzer/signals/lang/ ×10, tested per language like the
+cortex pattern packs). Each language drives real chains through the real
+detectors — not regex unit checks — so pack regressions fail loudly."""
+
+import pytest
+
+from vainplex_openclaw_tpu.cortex.trace_analyzer import (
+    MemoryTraceSource,
+    reconstruct_chains,
+)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signal_patterns import (
+    SIGNAL_PACKS,
+    compile_signal_patterns,
+)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import detect_all_signals
+
+from trace_helpers import EventFactory
+
+# (lang, correction phrase, dissatisfaction phrase, completion claim,
+#  satisfaction phrase)
+CASES = {
+    "en": ("no, that's wrong", "this still doesn't work at all",
+           "I have successfully deployed the service", "thanks, works now!"),
+    "de": ("nein, das ist falsch", "das funktioniert nicht",
+           "erfolgreich abgeschlossen", "danke, läuft jetzt!"),
+    "fr": ("non, c'est faux", "ça ne marche pas du tout",
+           "j'ai terminé le déploiement avec succès", "merci, ça marche !"),
+    "es": ("no, eso está mal", "esto no funciona",
+           "he completado el despliegue con éxito", "gracias, ya funciona"),
+    "pt": ("não, isso está errado", "isso não funciona",
+           "concluído com sucesso", "obrigado, funciona agora"),
+    "it": ("no, questo è sbagliato", "non funziona ancora",
+           "ho completato il deploy con successo", "grazie, ora funziona"),
+    "zh": ("不对，你理解错了", "这个还是不行", "部署成功，已完成", "谢谢，可以了"),
+    "ja": ("違います、間違っています", "まだ動きません", "デプロイは成功しました", "ありがとう、動きました"),
+    "ko": ("아니요, 틀렸어요", "여전히 안 돼요", "배포 성공, 완료했습니다", "감사합니다, 이제 돼요"),
+    "ru": ("нет, это неверно", "это не работает", "успешно завершено", "спасибо, теперь работает"),
+}
+
+
+def chains_for(raws):
+    return reconstruct_chains(MemoryTraceSource(raws).fetch())
+
+
+def signals_for(raws, lang):
+    patterns = compile_signal_patterns([lang])
+    return {s.signal for s in detect_all_signals(chains_for(raws), patterns)}
+
+
+class TestAllTenLanguages:
+    def test_every_pack_present_and_compiles(self):
+        assert sorted(SIGNAL_PACKS) == sorted(
+            ["en", "de", "fr", "es", "pt", "it", "zh", "ja", "ko", "ru"])
+        merged = compile_signal_patterns(list(SIGNAL_PACKS))
+        assert merged.correction and merged.completion_claims
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_correction_detected(self, lang):
+        correction = CASES[lang][0]
+        f = EventFactory()
+        raws = [f.msg_out("the service is configured"), f.msg_in(correction)]
+        assert "SIG-CORRECTION" in signals_for(raws, lang)
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_dissatisfaction_at_chain_end(self, lang):
+        dissatisfied = CASES[lang][1]
+        f = EventFactory()
+        raws = [f.msg_in("please fix the deploy"), f.msg_out("done"),
+                f.msg_in(dissatisfied)]
+        assert "SIG-DISSATISFIED" in signals_for(raws, lang)
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_satisfaction_override_suppresses(self, lang):
+        dissatisfied, satisfied = CASES[lang][1], CASES[lang][3]
+        f = EventFactory()
+        raws = [f.msg_in(dissatisfied), f.msg_out("let me retry"),
+                f.msg_in(satisfied)]
+        assert "SIG-DISSATISFIED" not in signals_for(raws, lang)
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_hallucinated_completion_after_tool_error(self, lang):
+        claim = CASES[lang][2]
+        f = EventFactory()
+        raws = [f.msg_in("deploy it"),
+                *f.failing_call("exec", {"command": "kubectl apply"}, "denied"),
+                f.msg_out(claim)]
+        assert "SIG-HALLUCINATION" in signals_for(raws, lang)
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_clean_conversation_no_signals(self, lang):
+        f = EventFactory()
+        raws = [f.msg_in("status report please"),
+                f.tool_call("read", {"path": "status.md"}), f.tool_result("read"),
+                f.msg_out("here is the current status document")]
+        sigs = signals_for(raws, lang)
+        assert "SIG-CORRECTION" not in sigs
+        assert "SIG-DISSATISFIED" not in sigs
+        assert "SIG-HALLUCINATION" not in sigs
