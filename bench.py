@@ -132,33 +132,59 @@ def bench_policy_eval(n: int = 5_000) -> dict:
         for i in range(10)
     ]
     saved_home = os.environ.get("OPENCLAW_HOME")
-    with tempfile.TemporaryDirectory() as ws:
-        os.environ["OPENCLAW_HOME"] = os.path.join(ws, "home")
-        gw = Gateway(config={"workspace": ws, "agents": [{"id": "main"}]})
-        plugin = GovernancePlugin(workspace=ws)
-        gw.load(plugin, plugin_config={"policies": user_policies})
-        gw.start()
-        ctx = {"agent_id": "main", "session_key": "agent:main:s"}
-        gw.before_tool_call("exec", {"command": "ls -la /tmp"}, ctx)  # warmup
-        t0 = time.perf_counter()
-        for i in range(n):
-            gw.before_tool_call("exec", {"command": f"ls -la /tmp/dir{i}"}, ctx)
-        dt_ms = (time.perf_counter() - t0) * 1000.0 / n
-        gw.stop()
-    if saved_home is None:
-        os.environ.pop("OPENCLAW_HOME", None)
-    else:
-        os.environ["OPENCLAW_HOME"] = saved_home
+    try:
+        with tempfile.TemporaryDirectory() as ws:
+            os.environ["OPENCLAW_HOME"] = os.path.join(ws, "home")
+            gw = Gateway(config={"workspace": ws, "agents": [{"id": "main"}]})
+            plugin = GovernancePlugin(workspace=ws)
+            gw.load(plugin, plugin_config={"policies": user_policies})
+            gw.start()
+            ctx = {"agent_id": "main", "session_key": "agent:main:s"}
+            gw.before_tool_call("exec", {"command": "ls -la /tmp"}, ctx)  # warmup
+            t0 = time.perf_counter()
+            for i in range(n):
+                gw.before_tool_call("exec", {"command": f"ls -la /tmp/dir{i}"}, ctx)
+            dt_ms = (time.perf_counter() - t0) * 1000.0 / n
+            gw.stop()
+    finally:
+        # An exception mid-bench must not leak a deleted-tempdir OPENCLAW_HOME
+        # into the rest of the process (__main__ keeps going after failures).
+        if saved_home is None:
+            os.environ.pop("OPENCLAW_HOME", None)
+        else:
+            os.environ["OPENCLAW_HOME"] = saved_home
     baseline_ms = 5.0
     return {"metric": "policy_eval_latency", "value": round(dt_ms, 4), "unit": "ms",
             "vs_baseline": round(baseline_ms / dt_ms, 1)}  # >1 = faster than budget
 
 
+# Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
+# Public figures; unknown kinds report mfu: null rather than a wrong number.
+_TPU_PEAK_BF16 = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+# Round-1 hardware-measured self-baseline (tokens/s on the real chip, commit
+# 0088192); BASELINE.md records the reference publishes NO model metrics, so
+# the bar is our own prior round — vs_baseline > 1 means we got faster.
+_ENCODER_SELF_BASELINE = 1.42e8
+
+
+def encoder_flops_per_token(cfg) -> float:
+    """Analytic forward FLOPs/token (2·m·n·k matmul convention): per layer
+    8D² QKVO projections + 4LD attention (QKᵀ and PV) + 4DF MLP, plus the
+    classification/embedding heads once."""
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.seq_len
+    per_layer = 8 * D * D + 4 * L * D + 4 * D * F
+    heads = 2 * D * (cfg.n_severity + 2 + cfg.n_mood + D)
+    return float(cfg.n_layers * per_layer + heads)
+
+
 def bench_encoder_throughput(batch: int = 256, steps: int = 20) -> dict:
-    """Flagship CortexEncoder forward throughput on the available accelerator
-    (tokens/s). No reference baseline exists (the reference runs no models);
-    vs_baseline reports tokens/s per microsecond of the reference's 5 ms
-    policy budget purely for scale — i.e. it is informational."""
+    """Flagship CortexEncoder forward throughput (tokens/s) + MFU on the
+    available accelerator. attn_impl is left at "auto": on TPU this measures
+    the Pallas flash kernel, the flagship path."""
     import jax
     import numpy as np
 
@@ -177,9 +203,135 @@ def bench_encoder_throughput(batch: int = 256, steps: int = 20) -> dict:
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     tokens_per_s = batch * cfg.seq_len * steps / dt
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "") or ""
+    # "axon" is the image's TPU-tunnel platform; its device_kind can be
+    # opaque, so fall back to the tunnel's advertised TPU generation.
+    on_tpu = dev.platform in ("tpu", "axon")
+    if on_tpu and not any(key in kind.lower() for key, _ in _TPU_PEAK_BF16):
+        import os
+
+        kind = kind or os.environ.get("PALLAS_AXON_TPU_GEN", "")
+        if os.environ.get("PALLAS_AXON_TPU_GEN"):
+            kind = f"{kind} (PALLAS_AXON_TPU_GEN={os.environ['PALLAS_AXON_TPU_GEN']})"
+    peak = next((p for key, p in _TPU_PEAK_BF16
+                 if on_tpu and key in kind.lower()), None)
+    achieved_flops = tokens_per_s * encoder_flops_per_token(cfg)
     return {"metric": "encoder_throughput", "value": round(tokens_per_s, 0),
-            "unit": "tokens/s", "vs_baseline": None,
-            "device": jax.devices()[0].platform}
+            "unit": "tokens/s",
+            "vs_baseline": round(tokens_per_s / _ENCODER_SELF_BASELINE, 2),
+            "device": dev.platform, "device_kind": kind,
+            "achieved_tflops": round(achieved_flops / 1e12, 2),
+            "mfu": round(achieved_flops / peak, 4) if peak else None}
+
+
+def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
+                         steps: int = 10) -> list[dict]:
+    """Pallas flash kernel vs XLA dense attention across sequence lengths
+    (VERDICT r1 #3: the kernel must earn its flagship slot). TPU-only — the
+    interpreter path is not a meaningful timing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vainplex_openclaw_tpu.ops.flash_attention import flash_attention
+    from vainplex_openclaw_tpu.parallel.ring_attention import dense_attention_reference
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        return [{"metric": "flash_vs_dense", "skipped": True,
+                 "reason": f"backend={jax.default_backend()} (interpret-mode "
+                           "Pallas timing is meaningless)"}]
+    out = []
+    B, H, Dh = 4, 8, 64
+    for L in seq_lens:
+        key = jax.random.PRNGKey(L)
+        q, k, v = (jax.random.normal(kk, (B, H, L, Dh), jnp.bfloat16)
+                   for kk in jax.random.split(key, 3))
+        mask = jnp.ones((B, L), bool)
+        f = jax.jit(lambda q, k, v, m: flash_attention(q, k, v, m))
+        d = jax.jit(lambda q, k, v, m: dense_attention_reference(q, k, v, m))
+        times = {}
+        for name, fn in (("flash", f), ("dense", d)):
+            try:
+                jax.block_until_ready(fn(q, k, v, mask))  # compile
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    r = fn(q, k, v, mask)
+                jax.block_until_ready(r)
+                times[name] = (time.perf_counter() - t0) / steps * 1e3
+            except Exception as exc:  # e.g. dense OOM at 16k
+                times[name] = None
+                times[f"{name}_error"] = str(exc)[:120]
+        rec = {"metric": "flash_vs_dense", "seq_len": L,
+               "flash_ms": round(times["flash"], 3) if times.get("flash") else None,
+               "dense_ms": round(times["dense"], 3) if times.get("dense") else None}
+        if rec["flash_ms"] and rec["dense_ms"]:
+            rec["speedup"] = round(rec["dense_ms"] / rec["flash_ms"], 2)
+        out.append({**rec, **{k: v for k, v in times.items() if k.endswith("_error")}})
+    return out
+
+
+def _run_child(code: str, timeout: float):
+    """Run a python -c snippet in a child with a hard timeout; returns
+    (last_stdout_line, error, timed_out). Accelerator work happens ONLY in
+    children: a wedged tunnel blocks inside device init where no Python
+    exception can fire, and it must not take the headline down with it."""
+    import os
+    import subprocess
+
+    try:
+        child = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                               text=True, timeout=timeout,
+                               cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout:.0f}s", True
+    if child.returncode == 0 and child.stdout.strip():
+        return child.stdout.strip().splitlines()[-1], None, False
+    return None, f"rc={child.returncode} {child.stderr.strip()[-200:]}", False
+
+
+def _accelerator_benches() -> list[str]:
+    """Device-health probe → encoder throughput (retry once) → flash-vs-dense
+    sweep. Always returns records — a wedged device yields explicit
+    {skipped, reason} lines, never a silent absence (VERDICT r1 #2)."""
+    lines = []
+    probe_code = ("import jax; d = jax.devices()[0]; "
+                  "print(d.platform + '|' + (d.device_kind or ''))")
+    probe, err, _ = _run_child(probe_code, timeout=90)
+    if err is not None:  # one retry: first contact can pay one-off tunnel setup
+        probe, err, _ = _run_child(probe_code, timeout=90)
+    if err is not None:
+        reason = f"device init probe failed: {err}"
+        lines.append(json.dumps({"metric": "encoder_throughput", "skipped": True,
+                                 "reason": reason}))
+        lines.append(json.dumps({"metric": "flash_vs_dense", "skipped": True,
+                                 "reason": reason}))
+        # Fallback: still capture a number on forced-CPU (explicitly marked
+        # device: "cpu") so the artifact is never numberless.
+        cpu_code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                    "import json, bench; "
+                    "print(json.dumps(bench.bench_encoder_throughput()))")
+        out, cerr, _ = _run_child(cpu_code, timeout=240)
+        if cerr is None:
+            lines.append(out)
+        return lines
+    lines.append(json.dumps({"metric": "device_probe", "device": probe}))
+
+    enc_code = ("import json, bench; "
+                "print(json.dumps(bench.bench_encoder_throughput()))")
+    out, err, timed_out = _run_child(enc_code, timeout=240)
+    if timed_out:  # retry real timeouts only, not deterministic failures
+        out, err, timed_out = _run_child(enc_code, timeout=240)
+    lines.append(out if err is None else json.dumps(
+        {"metric": "encoder_throughput", "skipped": True, "reason": err}))
+
+    fvd_code = ("import json, bench; "
+                "print(json.dumps(bench.bench_flash_vs_dense()))")
+    out, err, _ = _run_child(fvd_code, timeout=300)
+    lines.append(out if err is None else json.dumps(
+        {"metric": "flash_vs_dense", "skipped": True, "reason": err}))
+    return lines
 
 
 if __name__ == "__main__":
@@ -188,25 +340,12 @@ if __name__ == "__main__":
             print(f"secondary: {json.dumps(fn())}", file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — secondaries must not kill the headline
             print(f"secondary failed: {exc}", file=sys.stderr)
-    # Headline measured BEFORE the encoder bench: initializing JAX/TPU in
-    # this process measurably slows the pure-Python pipeline afterwards.
-    # The encoder bench runs in a CHILD process with a hard timeout — a
-    # wedged accelerator tunnel blocks inside device init where no Python
-    # exception can fire, and it must not take the headline down with it.
+    # Headline measured BEFORE any JAX init in-process: initializing the
+    # TPU backend measurably slows the pure-Python pipeline afterwards.
     headline = bench_trace_analyzer()
     try:
-        import subprocess
-
-        child = subprocess.run(
-            [sys.executable, "-c",
-             "import json, bench; print(json.dumps(bench.bench_encoder_throughput()))"],
-            capture_output=True, text=True, timeout=180,
-            cwd=__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
-        if child.returncode == 0 and child.stdout.strip():
-            print(f"secondary: {child.stdout.strip().splitlines()[-1]}", file=sys.stderr)
-        else:
-            print(f"secondary failed: rc={child.returncode} "
-                  f"{child.stderr.strip()[-200:]}", file=sys.stderr)
+        for line in _accelerator_benches():
+            print(f"secondary: {line}", file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"secondary failed: {exc}", file=sys.stderr)
     print(json.dumps(headline))

@@ -1,0 +1,162 @@
+"""Model checkpoint/restore + data pipeline tests (SURVEY §5 checkpoint/
+resume axis, model layer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.models import EncoderConfig, init_params
+from vainplex_openclaw_tpu.models.checkpoint import (
+    all_steps, latest_step, restore_checkpoint, save_checkpoint)
+from vainplex_openclaw_tpu.models.data import TextClassificationData, synthetic_examples
+from vainplex_openclaw_tpu.models.train import init_state, make_optimizer, train_step
+
+CFG = EncoderConfig(vocab_size=512, seq_len=32, d_model=64, n_heads=4,
+                    n_layers=2, d_ff=128, dtype=jnp.float32, attn_impl="dense")
+
+
+def _data(n=64, batch=8):
+    return TextClassificationData(synthetic_examples(n, seed=7), batch_size=batch,
+                                  seq_len=CFG.seq_len, vocab_size=CFG.vocab_size)
+
+
+def _leaves_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+class TestCheckpointRoundTrip:
+    def test_save_restore_identity(self, tmp_path):
+        optimizer = make_optimizer()
+        state = init_state(init_params(jax.random.PRNGKey(0), CFG), optimizer)
+        save_checkpoint(str(tmp_path), state)
+        restored = restore_checkpoint(str(tmp_path), like=state)
+        assert _leaves_equal(state, restored)
+
+    def test_bfloat16_leaves_roundtrip_bit_exact(self, tmp_path):
+        # np.savez degrades ml_dtypes to raw void; the uint-view + manifest
+        # dtype path must restore bf16 bit-exactly (code-review r2 finding).
+        tree = {"w": jax.random.normal(jax.random.PRNGKey(1), (8, 8)
+                                       ).astype(jnp.bfloat16),
+                "step": jnp.zeros((), jnp.int32)}
+        save_checkpoint(str(tmp_path), tree, step=0)
+        back = restore_checkpoint(str(tmp_path), like=tree)
+        assert back["w"].dtype == jnp.bfloat16
+        assert np.array_equal(np.asarray(tree["w"]).view(np.uint16),
+                              np.asarray(back["w"]).view(np.uint16))
+
+    def test_missing_and_extra_leaves_rejected(self, tmp_path):
+        tree = {"a": jnp.ones((2,)), "step": jnp.zeros((), jnp.int32)}
+        save_checkpoint(str(tmp_path), tree, step=0)
+        with pytest.raises(KeyError, match="missing leaf"):
+            restore_checkpoint(str(tmp_path), like={**tree, "b": jnp.ones((1,))})
+        with pytest.raises(KeyError, match="extra leaves"):
+            restore_checkpoint(str(tmp_path), like={"a": jnp.ones((2,)),
+                                                    })
+
+    def test_latest_step_and_pruning(self, tmp_path):
+        tree = {"a": jnp.ones((2,)), "step": jnp.zeros((), jnp.int32)}
+        for s in (1, 5, 9, 13):
+            save_checkpoint(str(tmp_path), tree, step=s, keep=3)
+        assert all_steps(str(tmp_path)) == [5, 9, 13]
+        assert latest_step(str(tmp_path)) == 13
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            restore_checkpoint(str(tmp_path / "none"), like={})
+
+    def test_failed_save_leaves_no_tmp_or_torn_step(self, tmp_path):
+        # Non-serializable metadata must fail the save cleanly: no tmp
+        # litter, and no step-N.npz visible without its manifest.
+        tree = {"a": jnp.ones((2,)), "step": jnp.zeros((), jnp.int32)}
+        with pytest.raises(TypeError):
+            save_checkpoint(str(tmp_path), tree, step=3,
+                            metadata={"bad": object()})
+        assert all_steps(str(tmp_path)) == []
+        import os
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+class TestBitExactResume:
+    def test_train_resume_equivalence(self, tmp_path):
+        """train 4 steps straight  ≡  train 2, checkpoint, restore, train 2 —
+        to the bit (same batches via the epoch-keyed pipeline)."""
+        optimizer = make_optimizer()
+        data = _data()
+        batches = list(data.epoch(0))[:4]
+
+        straight = init_state(init_params(jax.random.PRNGKey(0), CFG), optimizer)
+        for b in batches:
+            straight, _ = train_step(straight, b, CFG, optimizer)
+
+        resumed = init_state(init_params(jax.random.PRNGKey(0), CFG), optimizer)
+        for b in batches[:2]:
+            resumed, _ = train_step(resumed, b, CFG, optimizer)
+        save_checkpoint(str(tmp_path), resumed)
+        like = init_state(init_params(jax.random.PRNGKey(0), CFG), optimizer)
+        resumed = restore_checkpoint(str(tmp_path), like=like)
+        for b in batches[2:]:
+            resumed, _ = train_step(resumed, b, CFG, optimizer)
+
+        assert int(straight.step) == int(resumed.step) == 4
+        assert _leaves_equal(straight.params, resumed.params)
+        assert _leaves_equal(straight.opt_state, resumed.opt_state)
+
+    def test_sharded_save_restore(self, tmp_path):
+        """Save from a dp×tp-sharded state, restore onto a fresh sharded
+        template — leaves come back with the template's sharding."""
+        from jax.sharding import PartitionSpec as P
+
+        from vainplex_openclaw_tpu.parallel import make_mesh
+        from vainplex_openclaw_tpu.parallel.mesh import shard_params
+
+        mesh = make_mesh(8, axes=("dp", "tp"))
+        rules = [("w1", P(None, "tp")), ("w2", P("tp", None))]
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        sharded = jax.device_put(params, shard_params(params, mesh, rules))
+        save_checkpoint(str(tmp_path), sharded, step=0)
+
+        template = jax.device_put(init_params(jax.random.PRNGKey(42), CFG),
+                                  shard_params(params, mesh, rules))
+        back = restore_checkpoint(str(tmp_path), like=template)
+        assert _leaves_equal(params, back)
+        w1 = back["blocks"][0]["mlp"]["w1"]
+        assert w1.sharding.spec == P(None, "tp")
+
+
+class TestDataPipeline:
+    def test_epoch_order_deterministic_by_seed_and_epoch(self):
+        data = _data()
+        a = [b["tokens"] for b in data.epoch(3)]
+        b = [b["tokens"] for b in data.epoch(3)]
+        c = [b["tokens"] for b in data.epoch(4)]
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+        assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+
+    def test_drop_remainder_static_shapes(self):
+        data = TextClassificationData(synthetic_examples(30, seed=1),
+                                      batch_size=8, seq_len=32, vocab_size=512)
+        batches = list(data.epoch(0))
+        assert len(batches) == 3
+        assert all(b["tokens"].shape == (8, 32) for b in batches)
+
+    def test_eval_batches_cover_every_example_once(self):
+        data = TextClassificationData(synthetic_examples(30, seed=1),
+                                      batch_size=8, seq_len=32, vocab_size=512)
+        total = sum(n_valid for _, n_valid in data.eval_batches())
+        assert total == 30
+        assert all(b["tokens"].shape == (8, 32) for b, _ in data.eval_batches())
+
+    def test_synthetic_examples_deterministic_and_labelled(self):
+        a, b = synthetic_examples(20, seed=5), synthetic_examples(20, seed=5)
+        assert a == b
+        for _, lab in a:
+            assert set(lab) == {"severity", "keep", "mood"}
+            assert 0 <= lab["severity"] <= 3 and lab["keep"] in (0, 1)
+
+    def test_empty_dataset_rejected(self):
+        with pytest.raises(ValueError):
+            TextClassificationData([], batch_size=4)

@@ -243,6 +243,11 @@ def test_typed_llm_and_compaction_flows(openclaw_home):
     assert "model.input.observed" in types
     assert "model.output.observed" in types
     assert "session.compaction.ended" in types
+    # Regression (advisor r1): "lengths only" means lengths ARE recorded —
+    # the output event must carry the completion length, not chars: 0.
+    out_ev = next(e for e in plugin.transport.fetch()
+                  if e.canonical_type == "model.output.observed")
+    assert out_ev.payload["chars"] == len("completion body")
     ended = next(e for e in plugin.transport.fetch()
                  if e.canonical_type == "session.compaction.ended")
     assert "completion body" not in json.dumps(ended.to_dict())

@@ -71,7 +71,7 @@ class TestEncoderFlashPath:
     def test_forward_parity_dense_vs_flash(self):
         base = dict(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
                     n_layers=2, d_ff=128, dtype=jnp.float32)
-        cfg_d = EncoderConfig(**base)
+        cfg_d = EncoderConfig(**base, attn_impl="dense")  # pin: "auto" would be flash on TPU
         cfg_f = EncoderConfig(**base, attn_impl="flash")
         params = init_params(jax.random.PRNGKey(0), cfg_d)
         tokens = jnp.asarray(encode_texts(
@@ -88,7 +88,7 @@ class TestEncoderFlashPath:
         # regression: seq_len=192 must pick a dividing block size, not crash
         base = dict(vocab_size=512, seq_len=192, d_model=64, n_heads=4,
                     n_layers=1, d_ff=128, dtype=jnp.float32)
-        cfg_d = EncoderConfig(**base)
+        cfg_d = EncoderConfig(**base, attn_impl="dense")  # pin: "auto" would be flash on TPU
         cfg_f = EncoderConfig(**base, attn_impl="flash")
         params = init_params(jax.random.PRNGKey(1), cfg_d)
         tokens = jnp.asarray(encode_texts(["odd length sequence test"],
@@ -103,7 +103,7 @@ class TestEncoderFlashPath:
         # pad to 256 with block 128 and still match dense
         base = dict(vocab_size=512, seq_len=131, d_model=64, n_heads=4,
                     n_layers=1, d_ff=128, dtype=jnp.float32)
-        cfg_d = EncoderConfig(**base)
+        cfg_d = EncoderConfig(**base, attn_impl="dense")  # pin: "auto" would be flash on TPU
         cfg_f = EncoderConfig(**base, attn_impl="flash")
         params = init_params(jax.random.PRNGKey(2), cfg_d)
         tokens = jnp.asarray(encode_texts(["prime length sequence"],

@@ -1,0 +1,191 @@
+"""Matrix 2FA loop, end-to-end against a real (fake) homeserver.
+
+Covers the outbound half the reference implements in
+governance/src/hooks.ts:812-874 (posting the batched approval prompt into
+the approvers' room) plus the inbound poller (matrix-poller.ts:1-40), with
+no mocking of Approval2FA internals: a 2fa-gated tool call must produce an
+HTTP PUT at the homeserver, and a code message served by the homeserver must
+resolve the batch and unblock the call.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from vainplex_openclaw_tpu.governance import GovernancePlugin
+from vainplex_openclaw_tpu.governance.approval import generate_base32_secret
+from vainplex_openclaw_tpu.governance.approval.matrix import MatrixNotifier
+
+from helpers import list_logger
+
+
+class FakeHomeserver:
+    """Minimal Matrix client-server API: room send (PUT) + messages (GET)."""
+
+    def __init__(self):
+        self.sent: list[dict] = []          # recorded PUT bodies
+        self.txn_ids: list[str] = []
+        self.room_messages: list[dict] = []  # served to GET /messages
+        self.auth_headers: list[str] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence test output
+                pass
+
+            def _json(self, status: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_PUT(self):
+                outer.auth_headers.append(self.headers.get("Authorization", ""))
+                if "/send/m.room.message/" not in self.path:
+                    return self._json(404, {"errcode": "M_UNRECOGNIZED"})
+                txn = self.path.rsplit("/", 1)[-1]
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                outer.txn_ids.append(txn)
+                outer.sent.append(body)
+                self._json(200, {"event_id": f"$evt{len(outer.sent)}"})
+
+            def do_GET(self):
+                if "/messages" not in self.path:
+                    return self._json(404, {"errcode": "M_UNRECOGNIZED"})
+                self._json(200, {"chunk": list(outer.room_messages),
+                                 "start": "t1", "end": "t2"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def homeserver():
+    hs = FakeHomeserver()
+    yield hs
+    hs.close()
+
+
+def _creds(homeserver, tmp_path) -> str:
+    path = tmp_path / "matrix-creds.json"
+    path.write_text(json.dumps({
+        "homeserver": homeserver.url, "accessToken": "syt_test_token",
+        "roomId": "!approvals:m.org", "userId": "@bot:m.org"}))
+    return str(path)
+
+
+class TestMatrixNotifier:
+    def test_send_puts_message_with_bearer_and_encoded_room(self, homeserver):
+        notifier = MatrixNotifier(
+            {"homeserver": homeserver.url, "accessToken": "syt_test_token",
+             "roomId": "!approvals:m.org"}, list_logger())
+        event_id = notifier.send("🔒 APPROVAL REQUIRED")
+        assert event_id == "$evt1"
+        assert homeserver.sent == [{"msgtype": "m.text", "body": "🔒 APPROVAL REQUIRED"}]
+        assert homeserver.auth_headers[-1] == "Bearer syt_test_token"
+
+    def test_txn_ids_unique_across_sends(self, homeserver):
+        notifier = MatrixNotifier(
+            {"homeserver": homeserver.url, "accessToken": "t",
+             "roomId": "!r:m.org"}, list_logger())
+        for _ in range(5):
+            notifier.send("msg")
+        assert len(set(homeserver.txn_ids)) == 5
+
+    def test_failure_is_fail_open(self):
+        logger = list_logger()
+        notifier = MatrixNotifier(
+            {"homeserver": "http://127.0.0.1:1", "accessToken": "t",
+             "roomId": "!r:m.org"}, logger)
+        assert notifier.send("msg") is None  # no raise
+        assert any("notification failed" in m for m in logger.messages("warn"))
+
+
+class TestMatrix2FAEndToEnd:
+    def test_request_notify_code_allow(self, homeserver, tmp_path, workspace,
+                                       openclaw_home):
+        """2fa verdict → prompt PUT at the homeserver → code served via
+        /messages → poller resolves → the blocked tool call allows."""
+        from vainplex_openclaw_tpu.core import Gateway
+
+        secret = generate_base32_secret()
+        policy = {"id": "gate-exec", "rules": [{
+            "id": "r", "conditions": [{"type": "tool", "name": "exec"}],
+            "effect": {"action": "2fa", "reason": "exec needs approval"}}]}
+        gw = Gateway(config={"agents": {"list": ["main"]}})  # real wall clock
+        plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock)
+        gw.load(plugin, plugin_config={
+            "enabled": True, "builtinPolicies": {}, "policies": [policy],
+            "twoFa": {"enabled": True, "totpSecret": secret,
+                      "approvers": ["@boss:m.org"],
+                      "matrixCredsPath": _creds(homeserver, tmp_path),
+                      "matrixPollIntervalSeconds": 0.05,
+                      "batchWindowMs": 30, "timeoutSeconds": 20}})
+        gw.start()  # starts the matrix-2fa-poller service
+        try:
+            decisions = []
+            worker = threading.Thread(target=lambda: decisions.append(
+                gw.before_tool_call("exec", {"command": "deploy"},
+                                    {"agent_id": "main", "session_key": "agent:main"})))
+            worker.start()
+
+            deadline = time.time() + 10
+            while not homeserver.sent and time.time() < deadline:
+                time.sleep(0.01)
+            assert homeserver.sent, "no notification reached the homeserver"
+            prompt = homeserver.sent[0]["body"]
+            assert "APPROVAL REQUIRED" in prompt and "exec" in prompt
+
+            homeserver.room_messages.append({
+                "type": "m.room.message", "sender": "@boss:m.org",
+                "content": {"body": plugin.approval_2fa.totp.generate()}})
+            worker.join(timeout=10)
+            assert not worker.is_alive(), "tool call never unblocked"
+            assert decisions and decisions[0].allowed
+        finally:
+            gw.stop()
+
+    def test_unauthorized_room_sender_cannot_approve(self, homeserver, tmp_path,
+                                                     workspace, openclaw_home):
+        from vainplex_openclaw_tpu.core import Gateway
+
+        secret = generate_base32_secret()
+        policy = {"id": "gate-exec", "rules": [{
+            "id": "r", "conditions": [{"type": "tool", "name": "exec"}],
+            "effect": {"action": "2fa", "reason": "gated"}}]}
+        gw = Gateway(config={"agents": {"list": ["main"]}})
+        plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock)
+        gw.load(plugin, plugin_config={
+            "enabled": True, "builtinPolicies": {}, "policies": [policy],
+            "twoFa": {"enabled": True, "totpSecret": secret,
+                      "approvers": ["@boss:m.org"],
+                      "matrixCredsPath": _creds(homeserver, tmp_path),
+                      "matrixPollIntervalSeconds": 0.05,
+                      "batchWindowMs": 30, "timeoutSeconds": 2}})
+        gw.start()
+        try:
+            homeserver.room_messages.append({
+                "type": "m.room.message", "sender": "@rando:m.org",
+                "content": {"body": plugin.approval_2fa.totp.generate()}})
+            d = gw.before_tool_call("exec", {"command": "rm -rf /"},
+                                    {"agent_id": "main", "session_key": "agent:main"})
+            assert d.blocked  # times out → deny; rando's code never approves
+        finally:
+            gw.stop()

@@ -74,6 +74,15 @@ class TestPerPatternPositive:
     def test_phone_number(self):
         assert "phone-number" in pattern_ids("call +4915123456789", ["pii"])
 
+    def test_phone_number_space_separated_no_other_punctuation(self):
+        # Regression (advisor r1): space is in the separator class, so the
+        # anchor prefilter must not require punctuation to be present.
+        assert "phone-number" in pattern_ids("call me at 555 123 4567 ok", ["pii"])
+
+    def test_phone_number_dot_and_paren_forms(self):
+        assert "phone-number" in pattern_ids("dial 555.123.4567 now", ["pii"])
+        assert "phone-number" in pattern_ids("dial (555) 123-4567 now", ["pii"])
+
 
 class TestPerPatternNegative:
     """Near-miss strings that must NOT fire the named pattern (false-positive

@@ -81,9 +81,10 @@ def _tool_result_payload(e: HookPayload, c: HookCtx) -> dict:
 
 def _llm_meta_payload(e: HookPayload, c: HookCtx) -> dict:
     # Lengths and redaction metadata only — bodies are deliberately omitted.
-    prompt = e.get("prompt") or e.get("content") or ""
+    # llm_input carries "prompt"/"content"; llm_output carries "completion".
+    body = e.get("prompt") or e.get("content") or e.get("completion") or ""
     return {
-        "chars": len(str(prompt)),
+        "chars": len(str(body)),
         "model": e.get("model"),
         "redaction_applied": bool(e.get("redaction_applied")),
     }

@@ -214,14 +214,21 @@ class GovernancePlugin:
         api.on("message_received", self.handle_2fa_code, priority=100)
         creds_path = tcfg.get("matrixCredsPath")
         if creds_path:
+            from .approval.matrix import MatrixNotifier
             from .approval.poller import MatrixPoller, load_matrix_credentials
 
             creds = load_matrix_credentials(creds_path)
             if creds:
+                # Outbound: batched approval prompts go INTO the room
+                # (ref hooks.ts:812-874); inbound: the poller reads codes
+                # back out. Together they close the 2FA loop end-to-end.
+                notifier = MatrixNotifier(creds, api.logger, clock=self.clock)
+                self.approval_2fa.set_notify_fn(notifier.notify_fn())
                 poller = MatrixPoller(
                     creds,
                     lambda code, sender: self.approval_2fa.try_resolve_any(code, sender),
-                    api.logger)
+                    api.logger,
+                    interval_s=tcfg.get("matrixPollIntervalSeconds", 2.0))
                 api.register_service(PluginService(
                     id="matrix-2fa-poller",
                     start=lambda ctx: poller.start(),

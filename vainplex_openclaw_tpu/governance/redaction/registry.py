@@ -79,9 +79,12 @@ BUILTIN_PATTERNS: tuple[RedactionPattern, ...] = (
        "email", anchors=("@",)),
     # E.164 with + prefix, or separator-formatted numbers — bare digit runs
     # (ids, timestamps, error codes) must NOT be treated as phone numbers.
+    # Anchors must be a SUPERSET of matchable strings: the separator class
+    # includes space, so punctuation-only anchors would skip "555 123 4567".
+    # Every match contains a digit, so anchor on digits — still prunes prose.
     _p("phone-number", "pii",
        r"(?<!\d)(?:\+[1-9]\d{6,14}|\(?\d{3}\)?[-. ]\d{3}[-. ]\d{4})(?!\d)", "phone",
-       anchors=("+", "(", "-", ".")),
+       anchors=tuple("0123456789")),
     _p("ssn-us", "pii", r"\b\d{3}-\d{2}-\d{4}\b", "ssn", anchors=("-",)),
 )
 

@@ -1,0 +1,170 @@
+"""Sharded model checkpoint/restore (params + opt-state + step).
+
+Fills the SURVEY §5 checkpoint/resume axis for the model layer (the suite's
+JSON checkpointing — pre-compaction snapshots, trace-analyzer processing
+state, trust persistence — covers everything *except* device arrays). Design:
+
+- A checkpoint is ``step-<n>.npz`` (every pytree leaf as a host numpy array,
+  keyed by its tree path) + a ``manifest.json`` with step/leaf metadata,
+  written tmp+rename like storage/atomic.py so a crash can never leave a
+  torn checkpoint behind.
+- Restore is **sharding-aware**: the caller passes a ``like`` pytree (the
+  freshly initialized, possibly ``jax.device_put``-sharded TrainState);
+  every restored leaf is placed back with the sharding of the corresponding
+  ``like`` leaf, so resume works identically under a multi-chip Mesh —
+  save on mesh A, restore on mesh B of a different layout, and XLA reshards.
+- ``latest_step``/pruning give a resumable directory layout; resume is
+  bit-exact (tests/test_checkpoint.py proves train-N ≡ train-k→restore→
+  train-(N−k) to the bit).
+
+The reference has no device-array counterpart (pure-TS middleware); parity
+target is its resume discipline, e.g. trace-analyzer ProcessingState
+(cortex/src/trace-analyzer/report.ts) carried over to the numeric layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step-(\d+)\.npz$")
+_UINT_BY_ITEMSIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including ml_dtypes extensions (bfloat16…)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _path_key(path) -> str:
+    """Stable string key for a tree path (dict keys / sequence indices /
+    namedtuple fields)."""
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(p.key))
+        else:  # pragma: no cover — future key types
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, step: Optional[int] = None,
+                    keep: int = 3, metadata: Optional[dict] = None) -> str:
+    """Write one atomic checkpoint; returns the .npz path.
+
+    ``step`` defaults to ``int(state.step)`` when the pytree has a scalar
+    ``step`` field (TrainState does). Old checkpoints beyond ``keep`` are
+    pruned oldest-first.
+    """
+    if step is None:
+        step = int(np.asarray(getattr(state, "step")))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    arrays: dict[str, np.ndarray] = {}
+    dtypes: dict[str, str] = {}
+    for path, leaf in leaves:
+        key = _path_key(path)
+        arr = np.asarray(jax.device_get(leaf))
+        dtypes[key] = str(arr.dtype)
+        # np.savez silently degrades ml_dtypes (bfloat16 et al.) to raw void
+        # ('|V2') which cannot be cast back — store those as same-itemsize
+        # uint views and record the true dtype in the manifest.
+        if arr.dtype.kind == "V":  # ml_dtypes all present as numpy kind 'V'
+            arr = arr.view(_UINT_BY_ITEMSIZE[arr.dtype.itemsize])
+        arrays[key] = arr
+
+    # Atomicity: all_steps()/latest_step() key on the .npz, so the manifest
+    # must land FIRST — whenever a step's .npz is visible, its manifest
+    # (which holds the only record of ml_dtypes like bf16) already exists.
+    final = os.path.join(ckpt_dir, f"step-{step}.npz")
+    manifest = {"step": step, "n_leaves": len(arrays),
+                "leaves": sorted(arrays), "dtypes": dtypes,
+                "metadata": metadata or {}}
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".npz.tmp")
+    mfd, mtmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".json.tmp")
+    try:
+        with os.fdopen(mfd, "w") as f:
+            json.dump(manifest, f)
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(mtmp, os.path.join(ckpt_dir, f"step-{step}.manifest.json"))
+        os.replace(tmp, final)
+    except BaseException:
+        for t in (tmp, mtmp):
+            if os.path.exists(t):
+                os.unlink(t)
+        raise
+
+    for old in all_steps(ckpt_dir)[:-keep] if keep else []:
+        os.unlink(os.path.join(ckpt_dir, f"step-{old}.npz"))
+        mpath = os.path.join(ckpt_dir, f"step-{old}.manifest.json")
+        if os.path.exists(mpath):
+            os.unlink(mpath)
+    return final
+
+
+def all_steps(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(m.group(1)) for f in os.listdir(ckpt_dir)
+                  if (m := _STEP_RE.match(f)))
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = all_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, like: Any,
+                       step: Optional[int] = None) -> Any:
+    """Restore the checkpoint at ``step`` (default: latest) into the tree
+    structure of ``like``, placing each leaf with the sharding of the
+    corresponding ``like`` leaf (host numpy leaves stay numpy)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step-{step}.npz")
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    mpath = os.path.join(ckpt_dir, f"step-{step}.manifest.json")
+    with open(mpath) as f:
+        dtypes = json.load(f).get("dtypes", {})
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
+    restored = []
+    for leaf_path, leaf in leaves:
+        key = _path_key(leaf_path)
+        if key not in arrays:
+            raise KeyError(f"checkpoint {path} missing leaf {key!r}")
+        arr = arrays.pop(key)
+        saved_dtype = _resolve_dtype(dtypes[key]) if key in dtypes else arr.dtype
+        if arr.dtype != saved_dtype:  # stored as a same-itemsize uint view
+            arr = arr.view(saved_dtype)
+        if isinstance(leaf, jax.Array):
+            sharding = getattr(leaf, "sharding", None)
+            arr = arr.astype(leaf.dtype) if arr.dtype != leaf.dtype else arr
+            restored.append(jax.device_put(arr, sharding)
+                            if sharding is not None else jax.numpy.asarray(arr))
+        else:
+            restored.append(arr)
+    if arrays:
+        raise KeyError(f"checkpoint {path} has extra leaves: {sorted(arrays)[:5]}")
+    return jax.tree_util.tree_unflatten(treedef, restored)

@@ -1,0 +1,97 @@
+"""Deterministic data pipeline: labelled agent-trace text → static-shape
+device batches.
+
+XLA wants static shapes: every batch is exactly ``[batch_size, seq_len]``
+(drop-remainder for training; eval wraps around so every example is scored
+exactly once via the ``n_valid`` count). Shuffling is seeded and epoch-keyed
+so a resumed run (models/checkpoint.py) sees the identical batch order —
+bit-exact resume needs a bit-exact pipeline.
+
+``synthetic_examples`` generates the severity/keep/mood-labelled corpus the
+tests and the shipped tiny checkpoint train on: templated agent-trace lines
+(tool failures, doom loops, decisions, pleasantries) whose labels follow
+from the template, mirroring the label semantics of the trace-analyzer's
+LLM triage (cortex/src/trace-analyzer/classifier.ts keep/severity fields).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tokenizer import encode_texts
+
+# (template, severity 0..3, keep, mood) — mood: 0 frustrated | 1 neutral |
+# 2 satisfied | 3 urgent | 4 confused. Formatted with a varying noun/index.
+_TEMPLATES = [
+    ("tool {n} failed: connection refused after {i} retries", 3, 1, 3),
+    ("error: deployment {n} exceeded progress deadline", 3, 1, 3),
+    ("no, that's wrong — {n} is still failing and this is useless", 2, 1, 0),
+    ("you already tried {n} three times, stop repeating yourself", 2, 1, 0),
+    ("permission denied writing to {n}", 2, 1, 1),
+    ("rate limit hit calling {n}, backing off {i}s", 1, 1, 1),
+    ("we decided to ship {n} tomorrow because the fix is ready", 1, 1, 1),
+    ("let's go with {n} — it handles the edge cases better", 1, 1, 2),
+    ("I'll deliver the {n} report by friday", 1, 1, 1),
+    ("thanks, {n} works perfectly now", 0, 0, 2),
+    ("looks good, merging {n}", 0, 0, 2),
+    ("reading file {n} ({i} bytes)", 0, 0, 1),
+    ("listing directory {n}", 0, 0, 1),
+    ("hmm, which {n} did you mean? I see {i} candidates", 0, 1, 4),
+    ("what does the {n} flag do again?", 0, 0, 4),
+    ("ok", 0, 0, 1),
+]
+_NOUNS = ["deploy", "api-server", "kubectl", "auth-service", "build", "cache",
+          "v2-rollout", "db-migration", "billing-job", "ingress", "webhook",
+          "scheduler"]
+
+
+def synthetic_examples(n: int, seed: int = 0) -> list[tuple[str, dict]]:
+    """n labelled (text, {severity, keep, mood}) examples, deterministic."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        tmpl, sev, keep, mood = _TEMPLATES[rng.integers(len(_TEMPLATES))]
+        text = tmpl.format(n=_NOUNS[rng.integers(len(_NOUNS))],
+                           i=int(rng.integers(2, 500)))
+        out.append((text, {"severity": sev, "keep": keep, "mood": mood}))
+    return out
+
+
+class TextClassificationData:
+    """Seeded, epoch-keyed batches over labelled examples."""
+
+    def __init__(self, examples: list[tuple[str, dict]], batch_size: int,
+                 seq_len: int = 128, vocab_size: int = 8192, seed: int = 0):
+        if not examples:
+            raise ValueError("empty dataset")
+        self.examples = examples
+        self.batch_size = batch_size
+        self.seed = seed
+        texts = [t for t, _ in examples]
+        self.tokens = encode_texts(texts, seq_len=seq_len, vocab_size=vocab_size)
+        self.labels = {head: np.asarray([lab[head] for _, lab in examples],
+                                        dtype=np.int32)
+                       for head in ("severity", "keep", "mood")}
+
+    def __len__(self) -> int:
+        return len(self.examples)
+
+    def _batch(self, idx: np.ndarray) -> dict:
+        return {"tokens": self.tokens[idx],
+                **{h: self.labels[h][idx] for h in self.labels}}
+
+    def epoch(self, epoch_idx: int, shuffle: bool = True):
+        """Drop-remainder batches; order depends only on (seed, epoch_idx)."""
+        order = np.arange(len(self.examples))
+        if shuffle:
+            np.random.default_rng((self.seed, epoch_idx)).shuffle(order)
+        for start in range(0, len(order) - self.batch_size + 1, self.batch_size):
+            yield self._batch(order[start:start + self.batch_size])
+
+    def eval_batches(self):
+        """Static-shape eval batches; the final batch wraps around and
+        reports ``n_valid`` so wrapped duplicates are excluded from metrics."""
+        n = len(self.examples)
+        for start in range(0, n, self.batch_size):
+            idx = np.arange(start, start + self.batch_size) % n
+            yield self._batch(idx), min(self.batch_size, n - start)

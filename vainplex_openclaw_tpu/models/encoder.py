@@ -36,7 +36,10 @@ class EncoderConfig:
     n_severity: int = 4   # info | low | medium | high-critical
     n_mood: int = 5       # frustrated | neutral | satisfied | urgent | confused
     dtype: object = jnp.bfloat16
-    attn_impl: str = "dense"  # "dense" (XLA-fused) | "flash" (Pallas kernel)
+    # "auto" → Pallas flash kernel on TPU, XLA-fused dense elsewhere;
+    # "dense" | "flash" force an implementation — parity tests must pin BOTH
+    # sides explicitly or the comparison is flash-vs-flash on TPU.
+    attn_impl: str = "auto"
     n_experts: int = 0        # 0 = dense MLP; >0 = MoE FFN (models/moe.py)
     moe_aux_weight: float = 0.01
 
@@ -101,6 +104,12 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
         return (x @ w.astype(dt)).reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
 
     q, k, v = heads(p["q"]), heads(p["k"]), heads(p["v"])
+    if impl == "auto":
+        # Resolved at trace time; jit caches are per-backend so this is safe
+        # under jit. The Pallas kernel is the TPU hot path (VERDICT r1 #3);
+        # dense lets XLA fuse on CPU/GPU where interpret-mode Pallas is slow.
+        # "axon" is the image's experimental TPU-tunnel platform — real TPU.
+        impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 

@@ -98,7 +98,9 @@ def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
     if L % block_q or L % block_k:
         raise ValueError(f"L={L} not divisible by blocks ({block_q},{block_k})")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # "axon" = the image's TPU-tunnel platform (real TPU, real Mosaic
+        # compile via PALLAS_AXON_REMOTE_COMPILE); only interpret elsewhere.
+        interpret = jax.default_backend() not in ("tpu", "axon")
 
     if kv_mask is None:
         bias = jnp.zeros((B, 1, L), jnp.float32)
