@@ -1,0 +1,199 @@
+"""Scripted in-memory fake of the ``nats`` client API (the contract seam the
+real adapters import). The image has no ``nats`` distribution and zero
+egress, so the adapters' real paths can only be exercised by installing this
+module as ``sys.modules['nats']`` — it implements exactly the surface
+NatsTransport and NatsTraceSource consume: connect / jetstream / add_stream /
+publish / pull_subscribe / fetch / ack / stream_info / drain, plus
+scriptable failures (connect refused, publish timeout, fetch timeout).
+
+Reference parity: the reference's tests mock its NATS client the same way
+(ne/test/nats-client.test.ts); this goes further by modelling a stateful
+stream with sequences so pagination contracts are real.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import types
+from dataclasses import dataclass, field
+
+
+class FakeJetStreamState:
+    """Shared broker state: one stream of (subject, payload) with 1-based
+    JetStream sequences and retention applied on publish."""
+
+    def __init__(self):
+        self.streams: dict[str, dict] = {}     # name -> StreamConfig-ish
+        self.messages: dict[str, list] = {}    # name -> [(seq, subject, bytes)]
+        self.next_seq: dict[str, int] = {}
+        self.connect_error: Exception | None = None
+        self.publish_error: Exception | None = None
+        self.fetch_error: Exception | None = None
+        self.published_subjects: list[str] = []
+        self.connections: int = 0
+        self.connect_opts: list[dict] = []
+
+    def stream_for_subject(self, subject: str) -> str | None:
+        for name, cfg in self.streams.items():
+            for pat in cfg["subjects"]:
+                prefix = pat[:-2] if pat.endswith(".>") else pat
+                if subject == pat or subject.startswith(prefix + ".") \
+                        or (pat.endswith(".>") and subject.startswith(prefix)):
+                    return name
+        return None
+
+    def add(self, subject: str, payload: bytes) -> int:
+        name = self.stream_for_subject(subject)
+        if name is None:
+            raise RuntimeError(f"no stream for subject {subject}")
+        seq = self.next_seq[name]
+        self.next_seq[name] += 1
+        msgs = self.messages[name]
+        msgs.append((seq, subject, payload))
+        max_msgs = self.streams[name].get("max_msgs") or 0
+        if max_msgs and len(msgs) > max_msgs:  # limits retention: drop oldest
+            del msgs[: len(msgs) - max_msgs]
+        return seq
+
+
+@dataclass
+class _Metadata:
+    sequence: object
+
+
+@dataclass
+class _SeqPair:
+    stream: int
+    consumer: int
+
+
+class _FakeMsg:
+    def __init__(self, seq: int, subject: str, data: bytes):
+        self.subject = subject
+        self.data = data
+        self.metadata = _Metadata(sequence=_SeqPair(stream=seq, consumer=seq))
+        self.acked = False
+
+    async def ack(self):
+        self.acked = True
+
+
+class _FakePullSub:
+    def __init__(self, state: FakeJetStreamState, stream: str, start_seq: int):
+        self.state = state
+        self.stream = stream
+        self.cursor = start_seq  # next stream sequence to deliver
+
+    async def fetch(self, n: int, timeout: float = 5.0):
+        if self.state.fetch_error is not None:
+            raise self.state.fetch_error
+        out = []
+        for seq, subject, payload in self.state.messages.get(self.stream, []):
+            if seq >= self.cursor and len(out) < n:
+                out.append(_FakeMsg(seq, subject, payload))
+        if not out:
+            raise asyncio.TimeoutError("no messages")  # real client times out
+        self.cursor = out[-1].metadata.sequence.stream + 1
+        return out
+
+
+class _FakeJetStream:
+    def __init__(self, state: FakeJetStreamState):
+        self.state = state
+
+    async def add_stream(self, cfg):
+        name = cfg["name"] if isinstance(cfg, dict) else cfg.name
+        if name in self.state.streams:
+            raise RuntimeError("stream already exists")  # adapter swallows
+        as_dict = cfg if isinstance(cfg, dict) else dict(
+            name=cfg.name, subjects=list(cfg.subjects),
+            max_msgs=cfg.max_msgs, max_bytes=cfg.max_bytes, max_age=cfg.max_age)
+        self.state.streams[name] = as_dict
+        self.state.messages.setdefault(name, [])
+        self.state.next_seq.setdefault(name, 1)
+
+    async def publish(self, subject: str, payload: bytes):
+        if self.state.publish_error is not None:
+            raise self.state.publish_error
+        self.state.add(subject, payload)
+        self.state.published_subjects.append(subject)
+
+    async def pull_subscribe(self, subject, durable=None, stream=None, config=None):
+        if stream not in self.state.streams:
+            raise RuntimeError(f"stream not found: {stream}")
+        start = getattr(config, "opt_start_seq", None) or 1
+        return _FakePullSub(self.state, stream, start)
+
+    async def stream_info(self, name):
+        msgs = self.state.messages.get(name, [])
+        state = types.SimpleNamespace(
+            last_seq=self.state.next_seq.get(name, 1) - 1, messages=len(msgs))
+        return types.SimpleNamespace(state=state)
+
+
+class _FakeNC:
+    def __init__(self, state: FakeJetStreamState):
+        self.state = state
+        self.is_closed = False
+        self.drained = False
+
+    def jetstream(self):
+        return _FakeJetStream(self.state)
+
+    async def drain(self):
+        self.drained = True
+        self.is_closed = True
+
+
+def install(state: FakeJetStreamState):
+    """Install the fake as sys.modules['nats'] (+ js.api); returns an
+    uninstaller. StreamConfig/ConsumerConfig mimic the real dataclasses."""
+
+    async def connect(servers=None, user=None, password=None,
+                      max_reconnect_attempts=None, **kw):
+        state.connect_opts.append({"servers": servers, "user": user,
+                                   "password": password,
+                                   "max_reconnect_attempts": max_reconnect_attempts})
+        if state.connect_error is not None:
+            raise state.connect_error
+        state.connections += 1
+        return _FakeNC(state)
+
+    nats_mod = types.ModuleType("nats")
+    nats_mod.connect = connect
+    js_mod = types.ModuleType("nats.js")
+    api_mod = types.ModuleType("nats.js.api")
+
+    class StreamConfig:
+        def __init__(self, name, subjects, max_msgs=0, max_bytes=0, max_age=0):
+            self.name, self.subjects = name, subjects
+            self.max_msgs, self.max_bytes, self.max_age = max_msgs, max_bytes, max_age
+
+    class DeliverPolicy:
+        BY_START_SEQUENCE = "by_start_sequence"
+
+    class ConsumerConfig:
+        def __init__(self, deliver_policy=None, opt_start_seq=None):
+            self.deliver_policy = deliver_policy
+            self.opt_start_seq = opt_start_seq
+
+    api_mod.StreamConfig = StreamConfig
+    api_mod.DeliverPolicy = DeliverPolicy
+    api_mod.ConsumerConfig = ConsumerConfig
+    js_mod.api = api_mod
+    nats_mod.js = js_mod
+
+    saved = {k: sys.modules.get(k) for k in ("nats", "nats.js", "nats.js.api")}
+    sys.modules["nats"] = nats_mod
+    sys.modules["nats.js"] = js_mod
+    sys.modules["nats.js.api"] = api_mod
+
+    def uninstall():
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v
+
+    return uninstall

@@ -1,0 +1,145 @@
+"""Install execution tests (reference: brainplex/src/installer.ts:22-45 +
+test/integration.test.ts — CLI detection, per-plugin execution, temp-dir
+pip install + extensions copy, all-failed exit code 2)."""
+
+import json
+from pathlib import Path
+
+from vainplex_openclaw_tpu.brainplex.cli import parse_args, run_init
+from vainplex_openclaw_tpu.brainplex.installer import (
+    InstallEntry, extract_version, has_openclaw_cli, install_plugins)
+
+
+def _no_module(name):  # force the non-bundled path
+    return None
+
+
+class TestCliDetection:
+    def test_detects_openclaw_on_path(self):
+        assert has_openclaw_cli(which=lambda n: "/usr/bin/openclaw")
+        assert not has_openclaw_cli(which=lambda n: None)
+
+
+class TestInstallExecution:
+    def test_bundled_plugins_count_as_installed(self, tmp_path):
+        res = install_plugins(["governance", "cortex"], workspace=tmp_path)
+        assert [e.plugin_id for e in res.installed] == ["governance", "cortex"]
+        assert all(e.source == "bundled" for e in res.installed)
+        assert not res.failed
+
+    def test_dry_run_executes_nothing(self, tmp_path):
+        calls = []
+        res = install_plugins(["governance"], workspace=tmp_path, dry_run=True,
+                              run_cmd=lambda *a, **k: calls.append(a))
+        assert not res.installed and not res.failed and not calls
+
+    def test_openclaw_cli_path_used_when_present(self, tmp_path):
+        calls = []
+
+        def fake_run(cmd, cwd=None):
+            calls.append(cmd)
+            return "added vainplex-openclaw-governance-0.8.6"
+
+        res = install_plugins(["governance"], workspace=tmp_path,
+                              run_cmd=fake_run, which=lambda n: "/bin/openclaw",
+                              find_module=_no_module)
+        assert calls == [["openclaw", "plugins", "install",
+                          "vainplex-openclaw-governance"]]
+        assert res.installed[0].source == "openclaw-cli"
+        assert res.installed[0].version == "0.8.6"
+
+    def test_pip_fallback_installs_to_extensions(self, tmp_path):
+        def fake_pip(cmd, cwd=None):
+            assert cmd[:2] == ["pip", "install"]
+            target = Path(cmd[cmd.index("--target") + 1])
+            pkg = target / "vainplex_openclaw_governance"
+            pkg.mkdir(parents=True)
+            (pkg / "__init__.py").write_text("")
+            (target / "foo.dist-info").mkdir()
+            return "Successfully installed vainplex-openclaw-governance-1.2.3"
+
+        res = install_plugins(["governance"], workspace=tmp_path,
+                              run_cmd=fake_pip, which=lambda n: None,
+                              find_module=_no_module, tmp_root=tmp_path)
+        assert res.installed and res.installed[0].version == "1.2.3"
+        assert (tmp_path / "extensions" / "governance" / "__init__.py").exists()
+
+    def test_one_failure_does_not_stop_the_rest(self, tmp_path):
+        def flaky(cmd, cwd=None):
+            if "vainplex-openclaw-governance" in cmd:
+                raise RuntimeError("network down")
+            return "Successfully installed vainplex-openclaw-cortex-1.0.0"
+
+        res = install_plugins(["governance", "cortex"], workspace=tmp_path,
+                              run_cmd=flaky, which=lambda n: "/bin/openclaw",
+                              find_module=_no_module)
+        assert [e.plugin_id for e in res.failed] == ["governance"]
+        assert [e.plugin_id for e in res.installed] == ["cortex"]
+        assert "network down" in res.failed[0].error
+
+    def test_unknown_plugin_id_fails_cleanly(self, tmp_path):
+        res = install_plugins(["nonsense"], workspace=tmp_path)
+        assert res.all_failed and "unknown plugin id" in res.failed[0].error
+
+    def test_extract_version_formats(self):
+        assert extract_version(
+            "Successfully installed vainplex-openclaw-governance-0.8.6") == "0.8.6"
+        assert extract_version("no version here") is None
+
+
+class TestInitIntegration:
+    """init end-to-end against a temp home: scan → plan → install → write →
+    merge → summary (reference test/integration.test.ts)."""
+
+    def _root(self, tmp_path) -> Path:
+        root = tmp_path / "proj"
+        root.mkdir()
+        (root / "openclaw.json").write_text(json.dumps(
+            {"version": "2.1.0", "agents": [{"id": "main"}]}))
+        return root
+
+    def _args(self, **over):
+        base = {"command": "init", "full": False, "dry_run": False,
+                "config": None, "no_color": True, "verbose": True, "yes": True}
+        return {**base, **over}
+
+    def test_init_reports_bundled_installs(self, tmp_path, capsys):
+        root = self._root(tmp_path)
+        code = run_init(self._args(), start_dir=str(root),
+                        home=tmp_path / "nohome")
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "governance installed (bundled" in out
+        cfg = json.loads((root / "openclaw.json").read_text())
+        assert cfg["plugins"]["governance"]["enabled"] is True
+
+    def test_init_exit_2_when_all_installs_fail(self, tmp_path, capsys):
+        root = self._root(tmp_path)
+
+        def always_fail(cmd, cwd=None):
+            raise RuntimeError("registry unreachable")
+
+        import vainplex_openclaw_tpu.brainplex.installer as inst
+        orig = inst.PLUGIN_SPECS
+        inst.PLUGIN_SPECS = {k: ("nonexistent.module_xyz", d)
+                             for k, (m, d) in orig.items()}
+        try:
+            code = run_init(self._args(), start_dir=str(root),
+                            home=tmp_path / "nohome", run_cmd=always_fail)
+        finally:
+            inst.PLUGIN_SPECS = orig
+        assert code == 2
+        assert "All plugin installations failed." in capsys.readouterr().out
+        # nothing configured on total failure
+        cfg = json.loads((root / "openclaw.json").read_text())
+        assert "governance" not in cfg.get("plugins", {})
+
+    def test_dry_run_installs_nothing_but_plans_all(self, tmp_path, capsys):
+        root = self._root(tmp_path)
+        code = run_init(self._args(dry_run=True), start_dir=str(root),
+                        home=tmp_path / "nohome",
+                        run_cmd=lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("must not execute")))
+        assert code == 0
+        assert "dry run" in capsys.readouterr().out
+        assert json.loads((root / "openclaw.json").read_text()).get("plugins") is None

@@ -16,6 +16,7 @@ from .configurator import (
     generate_configs,
     validate_generated,
 )
+from .installer import _default_run_cmd, install_plugins
 from .scanner import scan
 from .writer import update_openclaw_config, write_config
 
@@ -102,7 +103,7 @@ def plan_installation(scan_result: dict, full: bool) -> dict:
 
 def run_init(args: dict, start_dir: Optional[str] = None,
              home: Optional[Path] = None, out: Optional[Output] = None,
-             confirm=None) -> int:
+             confirm=None, run_cmd=None) -> int:
     out = out or Output(color=not args["no_color"], verbose=args["verbose"])
     start_dir = start_dir or os.getcwd()
 
@@ -139,8 +140,28 @@ def run_init(args: dict, start_dir: Optional[str] = None,
             out.warn("aborted")
             return 1
 
-    # 6-8: generate + write per-plugin configs
-    configs = generate_configs(plan["install"], result["agents"])
+    # 6: execute installations (reference cli.ts:168-186: report each entry;
+    # exit 2 when every install failed; configure only what installed)
+    workspace = Path(result["config_path"]).parent
+    install_result = install_plugins(
+        plan["install"], workspace=workspace, dry_run=args["dry_run"],
+        run_cmd=run_cmd or _default_run_cmd)
+    for entry in install_result.installed:
+        ver = ""
+        if entry.version:
+            ver = ", " + ("v" + entry.version if entry.version[:1].isdigit()
+                          else entry.version)
+        out.ok(f"{entry.plugin_id} installed ({entry.source}{ver})")
+    for entry in install_result.failed:
+        out.error(f"{entry.plugin_id} install failed: {entry.error}")
+    if not args["dry_run"] and install_result.all_failed:
+        out.error("All plugin installations failed.")
+        return 2
+    installed_ids = ([e.plugin_id for e in install_result.installed]
+                     if not args["dry_run"] else list(plan["install"]))
+
+    # 7-8: generate + write per-plugin configs
+    configs = generate_configs(installed_ids, result["agents"])
     for plugin_id, errors in validate_generated(configs).items():
         for err in errors:
             out.warn(f"{plugin_id} config schema: {err}")

@@ -11,7 +11,7 @@ from typing import Iterator, Optional
 from .events import NormalizedEvent, normalize_event
 
 
-class NatsTraceSource:  # pragma: no cover - requires a live broker
+class NatsTraceSource:  # contract-tested via tests/fake_nats.py (no live broker in CI)
     def __init__(self, url: str, stream: str = "CLAW_EVENTS", logger=None,
                  fetch_timeout_s: float = 5.0):
         self.url = url

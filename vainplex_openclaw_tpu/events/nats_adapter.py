@@ -18,7 +18,7 @@ from .envelope import ClawEvent
 from .transport import TransportStats, parse_nats_url
 
 
-class NatsTransport:  # pragma: no cover - requires a live broker
+class NatsTransport:  # contract-tested via tests/fake_nats.py (no live broker in CI)
     def __init__(self, url: str, stream: str = "CLAW_EVENTS", prefix: str = "claw",
                  publish_timeout_s: float = 2.0, max_msgs: int = 1_000_000,
                  max_bytes: int = 1 << 30, max_age_s: float = 30 * 86400, logger=None):

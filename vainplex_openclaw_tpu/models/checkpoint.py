@@ -161,8 +161,15 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
         if isinstance(leaf, jax.Array):
             sharding = getattr(leaf, "sharding", None)
             arr = arr.astype(leaf.dtype) if arr.dtype != leaf.dtype else arr
-            restored.append(jax.device_put(arr, sharding)
-                            if sharding is not None else jax.numpy.asarray(arr))
+            # Re-apply the template's sharding only when it actually spans a
+            # mesh. Single-device leaves stay UNCOMMITTED (plain asarray):
+            # optax scalars like count are created uncommitted by init, and
+            # committing them to device 0 would clash with mesh-sharded
+            # params inside one jitted train_step.
+            if sharding is not None and len(sharding.device_set) > 1:
+                restored.append(jax.device_put(arr, sharding))
+            else:
+                restored.append(jax.numpy.asarray(arr))
         else:
             restored.append(arr)
     if arrays:

@@ -9,7 +9,7 @@ This module is the sharded train step the driver dry-runs multi-chip.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,3 +49,72 @@ def train_step(state: TrainState, batch: dict, cfg: EncoderConfig,
     updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params, opt_state, state.step + 1), loss
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _eval_step(params: dict, batch: dict, cfg: EncoderConfig) -> dict:
+    out = forward(params, batch["tokens"], cfg)
+    metrics = {}
+    for head in ("severity", "keep", "mood"):
+        logits = out[head].astype(jnp.float32)
+        metrics[f"{head}_correct"] = (logits.argmax(-1) == batch[head]).astype(jnp.int32)
+        metrics[f"{head}_loss"] = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch[head])
+    return metrics
+
+
+def evaluate(params: dict, data, cfg: EncoderConfig) -> dict:
+    """Accuracy + mean loss per head over ``data.eval_batches()``. Wrapped
+    duplicates in the final static-shape batch are excluded via n_valid."""
+    totals: dict[str, float] = {}
+    n_total = 0
+    for batch, n_valid in data.eval_batches():
+        m = _eval_step(params, batch, cfg)
+        for k, v in m.items():
+            totals[k] = totals.get(k, 0.0) + float(jnp.asarray(v)[:n_valid].sum())
+        n_total += n_valid
+    out = {}
+    for head in ("severity", "keep", "mood"):
+        out[f"{head}_accuracy"] = totals[f"{head}_correct"] / max(n_total, 1)
+        out[f"{head}_loss"] = totals[f"{head}_loss"] / max(n_total, 1)
+    out["n_examples"] = n_total
+    return out
+
+
+def train_loop(state: TrainState, data, cfg: EncoderConfig,
+               optimizer: optax.GradientTransformation, *, total_steps: int,
+               ckpt_dir: Optional[str] = None, save_every: int = 100,
+               eval_data=None, log=None) -> TrainState:
+    """Resumable training: restores the latest checkpoint from ``ckpt_dir``
+    (if any) and runs until ``state.step == total_steps``, checkpointing
+    every ``save_every`` steps and at the end. Batch order is epoch-keyed by
+    the data pipeline, so resume sees the identical stream — combined with
+    the bit-exact checkpoint this makes interrupt+resume ≡ uninterrupted
+    (tests/test_train_loop.py)."""
+    from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+
+    if ckpt_dir and latest_step(ckpt_dir) is not None:
+        state = restore_checkpoint(ckpt_dir, like=state)
+    steps_per_epoch = max(len(data) // data.batch_size, 1)
+    while int(state.step) < total_steps:
+        epoch = int(state.step) // steps_per_epoch
+        offset = int(state.step) % steps_per_epoch
+        for i, batch in enumerate(data.epoch(epoch)):
+            if i < offset:  # resume mid-epoch: skip already-consumed batches
+                continue
+            state, loss = train_step(state, batch, cfg, optimizer)
+            if ckpt_dir and int(state.step) % save_every == 0:
+                save_checkpoint(ckpt_dir, state)
+            if int(state.step) >= total_steps:
+                break
+        if log is not None:
+            msg = f"step {int(state.step)}: loss={float(loss):.4f}"
+            if eval_data is not None:
+                ev = evaluate(state.params, eval_data, cfg)
+                msg += (f" | eval sev={ev['severity_accuracy']:.2f}"
+                        f" keep={ev['keep_accuracy']:.2f}"
+                        f" mood={ev['mood_accuracy']:.2f}")
+            log(msg)
+    if ckpt_dir:
+        save_checkpoint(ckpt_dir, state)
+    return state
