@@ -165,10 +165,23 @@ _TPU_PEAK_BF16 = (
     ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
 )
 
-# Round-1 hardware-measured self-baseline (tokens/s on the real chip, commit
-# 0088192); BASELINE.md records the reference publishes NO model metrics, so
-# the bar is our own prior round — vs_baseline > 1 means we got faster.
-_ENCODER_SELF_BASELINE = 1.42e8
+def _encoder_self_baseline(platform: str) -> float | None:
+    """Per-device self-baseline from the committed BASELINE_SELF.json
+    (VERDICT r2 #6: baselines live in artifacts, not constants). BASELINE.md
+    records the reference publishes NO model metrics, so the bar is our own
+    prior rounds — vs_baseline > 1 means we got faster on the same device."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_SELF.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            table = json.load(f)["encoder_throughput"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        return None
+    family = "tpu" if platform in ("tpu", "axon") else platform
+    entry = table.get(family)
+    return float(entry["value"]) if entry else None
 
 
 def encoder_flops_per_token(cfg) -> float:
@@ -218,9 +231,10 @@ def bench_encoder_throughput(batch: int = 256, steps: int = 20) -> dict:
     peak = next((p for key, p in _TPU_PEAK_BF16
                  if on_tpu and key in kind.lower()), None)
     achieved_flops = tokens_per_s * encoder_flops_per_token(cfg)
+    baseline = _encoder_self_baseline(dev.platform)
     return {"metric": "encoder_throughput", "value": round(tokens_per_s, 0),
             "unit": "tokens/s",
-            "vs_baseline": round(tokens_per_s / _ENCODER_SELF_BASELINE, 2),
+            "vs_baseline": round(tokens_per_s / baseline, 2) if baseline else None,
             "device": dev.platform, "device_kind": kind,
             "achieved_tflops": round(achieved_flops / 1e12, 2),
             "mfu": round(achieved_flops / peak, 4) if peak else None}
@@ -291,6 +305,16 @@ def _run_child(code: str, timeout: float):
     return None, f"rc={child.returncode} {child.stderr.strip()[-200:]}", False
 
 
+def _freshest_capture() -> dict | None:
+    """Latest ok:true record from the round's TPU capture log, if any."""
+    try:
+        import tpu_capture
+
+        return tpu_capture.freshest_success()
+    except Exception:  # noqa: BLE001 — capture log is best-effort
+        return None
+
+
 def _accelerator_benches() -> list[str]:
     """Device-health probe → encoder throughput (retry once) → flash-vs-dense
     sweep. Always returns records — a wedged device yields explicit
@@ -303,12 +327,26 @@ def _accelerator_benches() -> list[str]:
         probe, err, _ = _run_child(probe_code, timeout=90)
     if err is not None:
         reason = f"device init probe failed: {err}"
-        lines.append(json.dumps({"metric": "encoder_throughput", "skipped": True,
-                                 "reason": reason}))
-        lines.append(json.dumps({"metric": "flash_vs_dense", "skipped": True,
-                                 "reason": reason}))
-        # Fallback: still capture a number on forced-CPU (explicitly marked
-        # device: "cpu") so the artifact is never numberless.
+        # VERDICT r2 #1: the tunnel wedges unpredictably, so prefer the
+        # freshest successful capture from the round's opportunistic capture
+        # log (tpu_capture.py) over declaring the TPU numbers lost.
+        captured = _freshest_capture()
+        if captured is not None:
+            enc = dict(captured["encoder"])
+            enc.update({"captured_at": captured["ts"],
+                        "source": "TPUBENCH_r03.jsonl",
+                        "live_probe_error": reason})
+            lines.append(json.dumps(enc))
+            for rec in captured.get("flash_vs_dense") or []:
+                lines.append(json.dumps({**rec, "captured_at": captured["ts"],
+                                         "source": "TPUBENCH_r03.jsonl"}))
+        else:
+            lines.append(json.dumps({"metric": "encoder_throughput",
+                                     "skipped": True, "reason": reason}))
+            lines.append(json.dumps({"metric": "flash_vs_dense", "skipped": True,
+                                     "reason": reason}))
+        # Also capture a live number on forced-CPU (explicitly marked
+        # device: "cpu") so the artifact always has a fresh measurement.
         cpu_code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
                     "import json, bench; "
                     "print(json.dumps(bench.bench_encoder_throughput()))")

@@ -1,0 +1,121 @@
+"""Opportunistic TPU bench capture (VERDICT r2 #1).
+
+The axon TPU tunnel on this image wedges unpredictably — two rounds of
+bench-time-only capture produced zero TPU artifacts. This tool decouples
+capture from bench time: run it repeatedly through the round (start /
+middle / end); every attempt — success or probe failure — is appended with
+a timestamp to the committed ``TPUBENCH_r03.jsonl``. ``bench.py`` prefers
+the freshest successful capture from that log whenever its own live probe
+fails, so one good window anywhere in the round is enough.
+
+Usage:  python tpu_capture.py [--attempts N] [--probe-timeout S]
+
+Each JSONL record:
+  {"ts": iso8601, "attempt": i, "ok": bool,
+   "probe": "tpu|<kind>" | null, "error": str | null,
+   "encoder": {...bench_encoder_throughput record...} | null,
+   "flash_vs_dense": [...sweep records...] | null}
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+import bench
+
+LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPUBENCH_r03.jsonl")
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+def _append(rec: dict) -> None:
+    with open(LOG, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def attempt_capture(probe_timeout: float) -> dict:
+    """One full capture attempt. Device work happens only in child processes
+    (a wedged tunnel blocks inside device init where no exception can fire)."""
+    rec: dict = {"ts": _now(), "ok": False, "probe": None, "error": None,
+                 "encoder": None, "flash_vs_dense": None}
+    probe_code = ("import jax; d = jax.devices()[0]; "
+                  "print(d.platform + '|' + (d.device_kind or ''))")
+    probe, err, _ = bench._run_child(probe_code, timeout=probe_timeout)
+    if err is not None:
+        rec["error"] = f"device init probe failed: {err}"
+        return rec
+    rec["probe"] = probe
+    if probe.split("|")[0] not in ("tpu", "axon"):
+        rec["error"] = f"probe found non-TPU backend: {probe}"
+        return rec
+
+    enc_code = ("import json, bench; "
+                "print(json.dumps(bench.bench_encoder_throughput()))")
+    out, err, timed_out = bench._run_child(enc_code, timeout=300)
+    if timed_out:
+        out, err, _ = bench._run_child(enc_code, timeout=300)
+    if err is not None:
+        rec["error"] = f"encoder bench failed post-probe: {err}"
+        return rec
+    rec["encoder"] = json.loads(out)
+
+    fvd_code = ("import json, bench; "
+                "print(json.dumps(bench.bench_flash_vs_dense()))")
+    out, err, _ = bench._run_child(fvd_code, timeout=420)
+    if err is not None:
+        # Encoder number alone is still a successful capture; record the
+        # sweep failure explicitly rather than discarding the attempt.
+        rec["flash_vs_dense"] = [{"metric": "flash_vs_dense", "skipped": True,
+                                  "reason": err}]
+    else:
+        rec["flash_vs_dense"] = json.loads(out)
+    rec["ok"] = rec["encoder"].get("device") in ("tpu", "axon")
+    if not rec["ok"]:
+        rec["error"] = (f"encoder ran on {rec['encoder'].get('device')!r}, "
+                        "not the TPU")
+    return rec
+
+
+def freshest_success(log_path: str | None = None) -> dict | None:
+    """Latest ok:true record from the capture log, or None."""
+    try:
+        with open(log_path or LOG, encoding="utf-8") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+    except (OSError, json.JSONDecodeError):
+        return None
+    ok = [r for r in recs if r.get("ok")]
+    return ok[-1] if ok else None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--attempts", type=int, default=3)
+    ap.add_argument("--probe-timeout", type=float, default=180.0)
+    args = ap.parse_args()
+
+    delay = 15.0
+    for i in range(1, args.attempts + 1):
+        rec = attempt_capture(args.probe_timeout)
+        rec["attempt"] = i
+        _append(rec)
+        print(json.dumps(rec), file=sys.stderr)
+        if rec["ok"]:
+            print(json.dumps({"captured": True, "ts": rec["ts"],
+                              "encoder": rec["encoder"]}))
+            return 0
+        if i < args.attempts:
+            time.sleep(delay)
+            delay = min(delay * 2, 120.0)  # capped exponential backoff
+    print(json.dumps({"captured": False, "attempts": args.attempts}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
