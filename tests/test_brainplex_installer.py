@@ -50,7 +50,11 @@ class TestInstallExecution:
 
     def test_pip_fallback_installs_to_extensions(self, tmp_path):
         def fake_pip(cmd, cwd=None):
-            assert cmd[:2] == ["pip", "install"]
+            # Regression (ADVICE r2): must invoke THIS interpreter's pip, not
+            # whatever "pip" happens to resolve first on PATH.
+            import sys
+
+            assert cmd[:4] == [sys.executable, "-m", "pip", "install"]
             target = Path(cmd[cmd.index("--target") + 1])
             pkg = target / "vainplex_openclaw_governance"
             pkg.mkdir(parents=True)

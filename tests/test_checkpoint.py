@@ -79,6 +79,20 @@ class TestCheckpointRoundTrip:
         import os
         assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
 
+    def test_failed_save_leaks_no_fd(self, tmp_path):
+        """Regression (ADVICE r2): when json.dump raises before the npz fd is
+        wrapped by os.fdopen, the raw fd must still be closed."""
+        import os
+
+        tree = {"a": jnp.ones((2,)), "step": jnp.zeros((), jnp.int32)}
+        before = len(os.listdir("/proc/self/fd"))
+        for _ in range(5):
+            with pytest.raises(TypeError):
+                save_checkpoint(str(tmp_path), tree, step=3,
+                                metadata={"bad": object()})
+        after = len(os.listdir("/proc/self/fd"))
+        assert after <= before, f"fd leak: {before} -> {after}"
+
 
 class TestBitExactResume:
     def test_train_resume_equivalence(self, tmp_path):

@@ -93,17 +93,9 @@ class TestPatterns:
         p = MergedPatterns(["en"], {"decision": [r"ship it:"]})
         assert extract_signals("ship it: new release", p).decisions
 
-    def test_r033_performance_budget_all_languages(self):
-        import time as _t
-
-        p = MergedPatterns(list(BUILTIN_LANGUAGES))
-        msg = "we decided to migrate the database because the old one is slow " * 5
-        start = _t.perf_counter()
-        for _ in range(100):
-            extract_signals(msg, p)
-            p.detect_mood(msg)
-        per_message_ms = (_t.perf_counter() - start) * 1000 / 100
-        assert per_message_ms < 2.0, f"{per_message_ms:.2f}ms > 2ms budget (R-033)"
+# (The R-033 perf-budget test lives ONLY in tests/test_perf_budgets.py with
+# 4× scheduling slack; the slack-less duplicate that used to sit here was
+# removed per VERDICT r2 #4 — a flaky twin adds risk, not coverage.)
 
 
 # ── thread tracker ───────────────────────────────────────────────────

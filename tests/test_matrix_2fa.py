@@ -109,6 +109,24 @@ class TestMatrixNotifier:
             notifier.send("msg")
         assert len(set(homeserver.txn_ids)) == 5
 
+    def test_retry_reuses_same_txn_id(self):
+        """A transient PUT failure is retried with the SAME txn id, so Matrix
+        dedup makes the retry safe even if the first attempt landed."""
+        urls, fail_first = [], [True]
+
+        def flaky_put(url, headers, body, timeout=10.0):
+            urls.append(url)
+            if fail_first[0]:
+                fail_first[0] = False
+                raise OSError("connection reset")
+            return {"event_id": "$retried"}
+
+        notifier = MatrixNotifier(
+            {"homeserver": "http://hs", "accessToken": "t",
+             "roomId": "!r:m.org"}, list_logger(), http_put=flaky_put)
+        assert notifier.send("msg") == "$retried"
+        assert len(urls) == 2 and urls[0] == urls[1]  # identical txn id
+
     def test_failure_is_fail_open(self):
         logger = list_logger()
         notifier = MatrixNotifier(

@@ -1,0 +1,131 @@
+"""Shipped trained checkpoint: quality pins + production wiring
+(VERDICT r3 #2 — local triage/embeddings must run TRAINED weights).
+
+These tests exercise the COMMITTED artifact under
+vainplex_openclaw_tpu/models/pretrained/triage-tiny — if it is missing,
+that is a shipping regression and the suite must fail, not skip.
+"""
+
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.models.data import TextClassificationData, synthetic_examples
+from vainplex_openclaw_tpu.models.pretrained import (
+    DEFAULT_DIR, TINY_CONFIG, available, load_pretrained)
+from vainplex_openclaw_tpu.models.train import evaluate
+
+
+@pytest.fixture(scope="module")
+def shipped():
+    assert available(), f"shipped checkpoint missing from {DEFAULT_DIR}"
+    cfg, params = load_pretrained()
+    return cfg, params
+
+
+class TestShippedArtifact:
+    def test_checkpoint_present_and_small(self):
+        import os
+
+        assert available()
+        npz = [f for f in os.listdir(DEFAULT_DIR) if f.endswith(".npz")]
+        assert len(npz) == 1
+        size_mb = os.path.getsize(os.path.join(DEFAULT_DIR, npz[0])) / 2**20
+        assert size_mb < 2.0, f"checkpoint ballooned to {size_mb:.1f} MB"
+
+    def test_loaded_config_matches_tiny(self, shipped):
+        cfg, _ = shipped
+        assert cfg == TINY_CONFIG
+
+    def test_weights_are_not_random_init(self, shipped):
+        import jax
+
+        from vainplex_openclaw_tpu.models import init_params
+
+        _, params = shipped
+        fresh = init_params(jax.random.PRNGKey(0), TINY_CONFIG)
+        w_shipped = np.asarray(params["heads"]["keep"])
+        w_fresh = np.asarray(fresh["heads"]["keep"])
+        assert not np.allclose(w_shipped, w_fresh)
+
+    def test_load_is_cached(self):
+        assert load_pretrained() is load_pretrained()
+
+
+class TestTriageQuality:
+    """Trained triage accuracy ≥ the rule baseline on a held-out split the
+    training run never saw (fresh seed)."""
+
+    def test_heldout_accuracy_beats_rule_baseline(self, shipped):
+        cfg, params = shipped
+        examples = synthetic_examples(512, seed=1234)  # ship-time seed was 0
+        data = TextClassificationData(examples, batch_size=64,
+                                      seq_len=cfg.seq_len,
+                                      vocab_size=cfg.vocab_size)
+        m = evaluate(params, data, cfg)
+        labels = {h: np.asarray([lab[h] for _, lab in examples])
+                  for h in ("severity", "keep", "mood")}
+        # Rule baseline: keep-everything (what no-LLM triage does) scores
+        # majority-class accuracy; severity baseline likewise.
+        for head in ("severity", "keep", "mood"):
+            majority = max(np.bincount(labels[head]) / len(examples))
+            assert m[f"{head}_accuracy"] >= majority, (
+                f"{head}: trained {m[f'{head}_accuracy']:.3f} < "
+                f"majority-class baseline {majority:.3f}")
+        assert m["keep_accuracy"] >= 0.9
+        assert m["severity_accuracy"] >= 0.9
+
+    def test_shiptime_eval_metrics_recorded(self):
+        import json
+        import os
+
+        with open(os.path.join(DEFAULT_DIR, "config.json"), encoding="utf-8") as f:
+            meta = json.load(f)
+        assert meta["eval"]["keep_accuracy"] >= 0.9
+        assert meta["eval"]["severity_accuracy"] >= 0.9
+        assert "synthetic_examples" in meta["provenance"]["corpus"]
+
+
+class TestProductionWiring:
+    def _finding(self, summary, severity="info"):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import FailureSignal
+
+        return FailureSignal(signal="tool_failure", severity=severity,
+                             chain_id="c1", agent="a", session="s", ts=0.0,
+                             summary=summary, evidence=[])
+
+    def test_local_triage_runs_trained_keep_head(self):
+        """With the rule floor out of reach (min_severity=critical), the
+        decision is the MODEL's: failure-shaped text kept, pleasantry text
+        dropped — impossible with random weights."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.classifier import local_triage
+
+        failure = self._finding("error: deployment exceeded progress deadline")
+        noise = self._finding("thanks, cache works perfectly now")
+        decisions = local_triage([failure, noise], min_severity="critical")
+        assert decisions == [True, False]
+
+    def test_analyzer_auto_enables_local_triage(self):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.analyzer import TraceAnalyzer
+        from vainplex_openclaw_tpu.core.api import list_logger
+
+        a = TraceAnalyzer({}, "/tmp/unused", list_logger())
+        assert a.config["classify"]["useLocalTriage"] is None  # auto
+
+    def test_local_embeddings_semantic_retrieval_beats_bag_of_tokens(self):
+        """Query and target share a failure 'label neighborhood' but ZERO
+        tokens; the distractor shares neither. Pure bag-of-tokens scores
+        both ~0 — only the trained learned half can rank the target first."""
+        from vainplex_openclaw_tpu.core.api import list_logger
+        from vainplex_openclaw_tpu.knowledge.embeddings import LocalEmbeddings
+
+        class Fact:
+            def __init__(self, id, s, p, o):
+                self.id, self.subject, self.predicate, self.object = id, s, p, o
+                self.source, self.created_at = "test", "2026-01-01"
+
+        emb = LocalEmbeddings(list_logger())
+        emb.sync([Fact("f1", "deploy", "failed-with", "connection refused"),
+                  Fact("f2", "team", "enjoyed", "lunch menu")])
+        hits = emb.search("error: build exceeded progress deadline", k=2)
+        assert hits[0]["id"] == "f1", f"expected failure fact first, got {hits}"
+        assert hits[0]["score"] > hits[1]["score"]

@@ -315,6 +315,91 @@ class TestDoomLoop:
         assert len(sigs) == 1 and sigs[0].extra["tool_name"] == "write"
 
 
+# ── batched similarity wiring (VERDICT r3 #6) ────────────────────────
+
+
+def _mixed_big_window(n_exec=20, n_write=16):
+    """One chain with a long mixed failing window: an exec doom loop (small
+    per-retry command edits), a success break, then a write doom loop, a
+    dissimilar break, and a repeated-failure pair."""
+    f = EventFactory()
+    raws = []
+    for i in range(n_exec):
+        raws += f.failing_call(
+            "exec", {"command": "kubectl rollout status deployment/app "
+                                f"--namespace prod # retry {i}"},
+            "progress deadline exceeded")
+    raws += [f.tool_call("exec", {"command": "kubectl get pods"}),
+             f.tool_result("exec")]  # success breaks the run
+    for _ in range(n_write):
+        raws += f.failing_call("write", {"path": "/etc/app.conf", "mode": "w"},
+                               "permission denied")
+    raws += f.failing_call("write", {"path": "/srv/other/totally.different",
+                                     "mode": "a", "fsync": True}, "enospc")
+    return raws
+
+
+class TestBatchedSimilarityWiring:
+    def _detect(self, raws, monkeypatch, force_scalar):
+        import vainplex_openclaw_tpu.cortex.trace_analyzer.signals as sig_mod
+
+        if force_scalar:
+            monkeypatch.setattr(sig_mod, "BATCH_SIMILARITY_MIN", 10**9)
+        chain = one_chain(raws)  # fresh chain → no cached sims
+        return (detect_doom_loops(chain, EN) +
+                detect_tool_failures(chain, EN))
+
+    def test_batched_verdicts_equal_scalar(self, monkeypatch):
+        """The same large window must yield IDENTICAL signals through the
+        batched kernels and the reference-exact scalar path."""
+        raws = _mixed_big_window()
+        batched = self._detect(raws, monkeypatch, force_scalar=False)
+        scalar = self._detect(raws, monkeypatch, force_scalar=True)
+        assert [s.to_dict() for s in batched] == [s.to_dict() for s in scalar]
+        assert any(s.signal == "SIG-DOOM-LOOP" for s in batched)
+
+    def test_large_window_reaches_jax_kernels(self, monkeypatch):
+        """Production path must actually call the batched ops.similarity
+        kernels (not fall back to scalar) for windows ≥ BATCH_SIMILARITY_MIN."""
+        import vainplex_openclaw_tpu.ops.similarity as ops_sim
+
+        calls = []
+        real_lev, real_jac = ops_sim.batch_levenshtein_ratio, ops_sim.jaccard_matrix
+        monkeypatch.setattr(ops_sim, "batch_levenshtein_ratio",
+                            lambda *a, **k: calls.append("lev") or real_lev(*a, **k))
+        monkeypatch.setattr(ops_sim, "jaccard_matrix",
+                            lambda *a, **k: calls.append("jac") or real_jac(*a, **k))
+        sigs = self._detect(_mixed_big_window(), monkeypatch, force_scalar=False)
+        assert "lev" in calls and "jac" in calls
+        assert any(s.signal == "SIG-DOOM-LOOP" for s in sigs)
+
+    def test_non_ascii_commands_keep_scalar_parity(self, monkeypatch):
+        """The batched DP kernel is byte-level; non-ASCII command pairs must
+        fall back to the char-level scalar path so verdicts never depend on
+        the window size (code-review r4 finding)."""
+        f = EventFactory()
+        raws = []
+        for i in range(40):  # ≥ BATCH_SIMILARITY_MIN attempts
+            raws += f.failing_call(
+                "exec", {"command": f"kubectl 配置部署 サービス № {i % 2}"},
+                "权限 denied")
+        batched = self._detect(raws, monkeypatch, force_scalar=False)
+        scalar = self._detect(raws, monkeypatch, force_scalar=True)
+        assert [s.to_dict() for s in batched] == [s.to_dict() for s in scalar]
+
+    def test_small_window_stays_scalar(self, monkeypatch):
+        import vainplex_openclaw_tpu.ops.similarity as ops_sim
+
+        calls = []
+        monkeypatch.setattr(ops_sim, "batch_levenshtein_ratio",
+                            lambda *a, **k: calls.append("lev"))
+        monkeypatch.setattr(ops_sim, "jaccard_matrix",
+                            lambda *a, **k: calls.append("jac"))
+        self._detect(_mixed_big_window(n_exec=3, n_write=2),
+                     monkeypatch, force_scalar=False)
+        assert calls == []  # dispatch overhead not worth it below the cutoff
+
+
 # ── SIG-REPEAT-FAIL ──────────────────────────────────────────────────
 
 

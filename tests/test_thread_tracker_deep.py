@@ -1,0 +1,339 @@
+"""Deep thread-tracker suite — ported case-by-case from the reference's
+cortex/test/thread-tracker.test.ts (533 LoC; VERDICT r3 #5 test-depth
+parity). Structure mirrors the reference: matchesThread, extractSignals,
+basic operations, pruning, maxThreads cap, loading existing state, flush,
+priority inference.
+"""
+
+import json
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.cortex.patterns import MergedPatterns
+from vainplex_openclaw_tpu.cortex.storage import iso_now, reboot_dir
+from vainplex_openclaw_tpu.cortex.thread_tracker import (
+    ThreadTracker, extract_signals, matches_thread)
+
+from helpers import FakeClock
+
+DAY = 86400.0
+BOTH = MergedPatterns(["en", "de"])
+
+
+def make_tracker(ws, clock=None, config=None):
+    return ThreadTracker(ws, config or {"pruneDays": 7, "maxThreads": 50},
+                         BOTH, list_logger(), clock or FakeClock())
+
+
+def make_thread(clock=None, **overrides):
+    now = iso_now(clock or FakeClock())
+    base = {"id": "test-id", "title": "auth migration OAuth2", "status": "open",
+            "priority": "medium", "summary": "test thread", "decisions": [],
+            "waiting_for": None, "mood": "neutral",
+            "last_activity": now, "created": now}
+    base.update(overrides)
+    return base
+
+
+def seed_threads(ws, threads, mood="neutral", events=1, clock=None):
+    path = reboot_dir(ws) / "threads.json"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    now = iso_now(clock or FakeClock())
+    path.write_text(json.dumps({
+        "version": 2, "updated": now, "threads": threads,
+        "integrity": {"last_event_timestamp": now, "events_processed": events,
+                      "source": "hooks"},
+        "session_mood": mood}))
+    return path
+
+
+class TestMatchesThread:
+    # thread-tracker.test.ts:40-82
+    def test_two_title_words_in_text(self):
+        assert matches_thread("auth migration OAuth2",
+                              "the auth migration is progressing")
+
+    def test_one_overlapping_word_insufficient(self):
+        assert not matches_thread("auth migration OAuth2", "auth is broken")
+
+    def test_zero_overlap(self):
+        assert not matches_thread("auth migration OAuth2", "the weather is nice")
+
+    def test_case_insensitive(self):
+        assert matches_thread("Auth Migration", "the AUTH MIGRATION works")
+
+    def test_short_words_ignored(self):
+        assert not matches_thread("a b c migration", "a b c something")
+
+    def test_custom_min_overlap(self):
+        assert matches_thread("auth migration OAuth2",
+                              "auth migration oauth2 is great", 3)
+        assert not matches_thread("auth migration OAuth2",
+                                  "the auth migration is progressing", 3)
+
+    def test_empty_title(self):
+        assert not matches_thread("", "some text")
+
+    def test_empty_text(self):
+        assert not matches_thread("auth migration", "")
+
+
+class TestExtractSignals:
+    # thread-tracker.test.ts:87-152
+    def test_decisions(self):
+        s = extract_signals("We decided to use TypeScript for all plugins", BOTH)
+        assert s.decisions and "decided" in s.decisions[0]
+
+    def test_closures(self):
+        assert extract_signals("The bug is fixed and working now", BOTH).closures
+
+    def test_waits(self):
+        s = extract_signals("We are waiting for the code review", BOTH)
+        assert s.waits and "waiting for" in s.waits[0]
+
+    def test_topics(self):
+        s = extract_signals("Let's get back to the auth migration", BOTH)
+        assert s.topics and "auth migration" in s.topics[0]
+
+    def test_multiple_signal_types_one_text(self):
+        s = extract_signals(
+            "Back to the auth module. We decided to fix it. It's done!", BOTH)
+        assert s.topics and s.decisions and s.closures
+
+    def test_german_with_both(self):
+        assert extract_signals("Wir haben beschlossen, das zu machen",
+                               BOTH).decisions
+
+    def test_unrelated_text_empty(self):
+        s = extract_signals("The sky is blue and the grass is green", BOTH)
+        assert not s.decisions and not s.closures
+        assert not s.waits and not s.topics
+
+    def test_decision_context_window_trimmed(self):
+        text = "x" * 60 + "decided to use TypeScript" + "y" * 120
+        s = extract_signals(text, MergedPatterns(["en"]))
+        assert s.decisions
+        # 50 before / 100 after the match — never the whole text
+        assert len(s.decisions[0]) < len(text)
+
+    def test_empty_text(self):
+        s = extract_signals("", BOTH)
+        assert not s.decisions and not s.closures
+        assert not s.waits and not s.topics
+
+
+class TestBasicOperations:
+    # thread-tracker.test.ts:157-275
+    def test_starts_empty(self, tmp_path):
+        assert make_tracker(tmp_path).threads == []
+
+    def test_new_topic_creates_thread(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("Let's get back to the auth migration", "user")
+        assert any("auth migration" in th["title"].lower() for th in t.threads)
+
+    def test_thread_defaults(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the deployment pipeline", "user")
+        th = next(th for th in t.threads
+                  if "deployment pipeline" in th["title"].lower())
+        assert th["status"] == "open"
+        assert th["decisions"] == []
+        assert th["waiting_for"] is None
+        assert th["id"] and th["created"] and th["last_activity"]
+
+    def test_no_duplicate_threads_for_same_topic(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the deployment pipeline", "user")
+        t.process_message("back to the deployment pipeline", "user")
+        assert sum("deployment pipeline" in th["title"].lower()
+                   for th in t.threads) == 1
+
+    def test_closure_closes_matching_thread(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the login bug fix", "user")
+        t.process_message("the login bug fix is done ✅", "assistant")
+        th = next(th for th in t.threads if "login bug" in th["title"].lower())
+        assert th["status"] == "closed"
+
+    def test_decisions_appended_to_matching_thread(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the auth migration plan", "user")
+        t.process_message("For the auth migration plan, we decided to use "
+                          "OAuth2 with PKCE", "assistant")
+        th = next(th for th in t.threads
+                  if "auth migration" in th["title"].lower())
+        assert th["decisions"]
+
+    def test_waiting_for_updated(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the deployment pipeline work", "user")
+        t.process_message("The deployment pipeline is waiting for the staging "
+                          "environment fix", "user")
+        th = next(th for th in t.threads
+                  if "deployment pipeline" in th["title"].lower())
+        assert th["waiting_for"]
+
+    def test_mood_updated_on_matching_thread(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the auth migration work", "user")
+        t.process_message("this auth migration is awesome! "
+                          "auth migration rocks 🚀", "user")
+        th = next(th for th in t.threads
+                  if "auth migration" in th["title"].lower())
+        assert th["mood"] != "neutral"
+
+    def test_persists_to_disk_v2(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the config refactor", "user")
+        data = json.loads((reboot_dir(tmp_path) / "threads.json").read_text())
+        assert data["version"] == 2
+        assert data["threads"]
+
+    def test_session_mood_tracked(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("This is awesome! 🚀", "user")
+        assert t.session_mood != "neutral"
+
+    def test_events_processed_increment(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("hello", "user")
+        t.process_message("world", "user")
+        assert t.events_processed == 2
+
+    def test_empty_content_skipped(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("", "user")
+        assert t.events_processed == 0
+
+    def test_integrity_block_persisted(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to something here now", "user")
+        data = json.loads((reboot_dir(tmp_path) / "threads.json").read_text())
+        assert data["integrity"]["source"] == "hooks"
+        assert data["integrity"]["events_processed"] == 1
+
+
+class TestPruning:
+    # thread-tracker.test.ts:280-356
+    def test_old_closed_thread_pruned(self, tmp_path):
+        clock = FakeClock()
+        old = iso_now(lambda: clock() - 10 * DAY)
+        seed_threads(tmp_path, [
+            make_thread(id="old-closed", title="old deployment pipeline issue",
+                        status="closed", last_activity=old, created=old),
+            make_thread(id="recent-open", title="recent auth migration work",
+                        status="open", last_activity=iso_now(clock)),
+        ])
+        t = make_tracker(tmp_path, clock=clock)
+        t.process_message("back to the recent auth migration work update", "user")
+        ids = {th["id"] for th in t.threads}
+        assert "old-closed" not in ids
+        assert "recent-open" in ids
+
+    def test_recent_closed_thread_kept(self, tmp_path):
+        clock = FakeClock()
+        recent = iso_now(lambda: clock() - 2 * DAY)
+        seed_threads(tmp_path, [
+            make_thread(id="recent-closed", title="recent fix completed done",
+                        status="closed", last_activity=recent),
+        ])
+        t = make_tracker(tmp_path, clock=clock)
+        t.process_message("back to the something else here", "user")
+        assert any(th["id"] == "recent-closed" for th in t.threads)
+
+
+class TestMaxThreadsCap:
+    # thread-tracker.test.ts:361-411
+    def test_cap_removes_oldest_closed_first(self, tmp_path):
+        clock = FakeClock()
+        threads = []
+        for i in range(5):
+            threads.append(make_thread(
+                id=f"open-{i}", title=f"open thread number {i} task",
+                status="open",
+                last_activity=iso_now(lambda: clock() - i * 60)))
+        for i in range(3):
+            threads.append(make_thread(
+                id=f"closed-{i}", title=f"closed thread number {i} done",
+                status="closed",
+                last_activity=iso_now(lambda: clock() - i * 60)))
+        seed_threads(tmp_path, threads)
+        t = make_tracker(tmp_path, clock=clock,
+                         config={"pruneDays": 7, "maxThreads": 6})
+        t.process_message("back to some topic here now", "user")
+        assert len(t.threads) <= 7  # 6 + possibly 1 new
+        assert sum(th["status"] == "open" for th in t.threads) >= 5
+
+    def test_cap_keeps_most_recent_closed(self, tmp_path):
+        clock = FakeClock()
+        threads = [make_thread(
+            id=f"closed-{i}", title=f"closed thread number {i} done",
+            status="closed",
+            last_activity=iso_now(lambda: clock() - i * 60))
+            for i in range(5)]
+        seed_threads(tmp_path, threads)
+        t = make_tracker(tmp_path, clock=clock,
+                         config={"pruneDays": 7, "maxThreads": 2})
+        t.process_message("unrelated chatter", "user")
+        survivors = {th["id"] for th in t.threads if th["id"].startswith("closed")}
+        # closed-0 is most recent (smallest age); oldest go first
+        assert "closed-0" in survivors
+        assert "closed-4" not in survivors
+
+
+class TestLoadingExistingState:
+    # thread-tracker.test.ts:416-468
+    def test_loads_existing_threads(self, tmp_path):
+        seed_threads(tmp_path, [make_thread(id="existing-1",
+                                            title="existing auth migration thread")],
+                     mood="excited", events=5)
+        t = make_tracker(tmp_path)
+        assert len(t.threads) == 1
+        assert t.threads[0]["id"] == "existing-1"
+        assert t.session_mood == "excited"
+        assert t.events_processed == 5
+
+    def test_missing_file_ok(self, tmp_path):
+        assert make_tracker(tmp_path).threads == []
+
+    def test_corrupt_file_ok(self, tmp_path):
+        path = reboot_dir(tmp_path) / "threads.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text("not valid json{{{")
+        assert make_tracker(tmp_path).threads == []
+
+    def test_legacy_bare_array_format(self, tmp_path):
+        path = reboot_dir(tmp_path) / "threads.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps([make_thread(id="legacy-1")]))
+        t = make_tracker(tmp_path)
+        assert [th["id"] for th in t.threads] == ["legacy-1"]
+
+
+class TestFlush:
+    # thread-tracker.test.ts:474-498
+    def test_flush_persists_dirty_state(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the pipeline review", "user")
+        assert t.flush() is True
+
+    def test_flush_clean_state_true(self, tmp_path):
+        assert make_tracker(tmp_path).flush() is True
+
+
+class TestPriorityInference:
+    # thread-tracker.test.ts:503-533
+    def test_high_priority_for_impact_keywords(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the security audit review", "user")
+        th = next(th for th in t.threads if "security" in th["title"].lower())
+        assert th["priority"] == "high"
+
+    def test_medium_priority_for_generic_topics(self, tmp_path):
+        t = make_tracker(tmp_path)
+        t.process_message("back to the feature flag setup", "user")
+        th = next(th for th in t.threads
+                  if "feature flag" in th["title"].lower())
+        assert th["priority"] == "medium"

@@ -51,6 +51,17 @@ class TestTrainLoop:
         assert _leaves_equal(uninterrupted.params, resumed.params)
         assert _leaves_equal(uninterrupted.opt_state, resumed.opt_state)
 
+    def test_dataset_smaller_than_batch_raises(self):
+        """Regression (ADVICE r2): drop-remainder yields zero batches when
+        len(data) < batch_size while steps_per_epoch floors at 1 — the loop
+        used to spin forever without advancing state.step."""
+        import pytest
+
+        opt = make_optimizer()
+        tiny = _data(n=4, batch=8)
+        with pytest.raises(ValueError, match="cannot fill one batch"):
+            train_loop(_fresh_state(opt), tiny, CFG, opt, total_steps=3)
+
     def test_logs_loss_and_eval(self):
         opt = make_optimizer()
         lines = []

@@ -21,6 +21,7 @@ from __future__ import annotations
 import importlib.util
 import shutil
 import subprocess
+import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Optional
@@ -125,7 +126,10 @@ def _install_one(pid: str, workspace: Path, use_cli: bool, run_cmd: Callable,
         with tempfile.TemporaryDirectory(
                 dir=str(tmp_root) if tmp_root else None,
                 prefix="brainplex-install-") as tmp:
-            out = run_cmd(["pip", "install", "--no-deps", "--target", tmp, dist])
+            # sys.executable -m pip: bare "pip" from PATH can belong to a
+            # different interpreter than the one running brainplex.
+            out = run_cmd([sys.executable, "-m", "pip", "install",
+                           "--no-deps", "--target", tmp, dist])
             pkg_dir = next((p for p in Path(tmp).iterdir()
                             if p.is_dir() and not p.name.endswith(".dist-info")
                             and p.name != "__pycache__"), None)

@@ -30,7 +30,10 @@ DEFAULT_ANALYZER_CONFIG = {
     "gapMinutes": 30,
     "maxEventsPerChain": 1000,
     "signals": {},            # per-signal {enabled, severity}
-    "classify": {"enabled": False, "useLocalTriage": False},
+    # useLocalTriage: None = auto — on exactly when the shipped trained
+    # checkpoint is present (models/pretrained.py, VERDICT r3 #2); operators
+    # can still pin True/False explicitly.
+    "classify": {"enabled": False, "useLocalTriage": None},
     "scheduleMinutes": 0,     # 0 = manual only
     "natsUrl": None,
     "stream": "CLAW_EVENTS",
@@ -89,9 +92,14 @@ class TraceAnalyzer:
             ccfg = self.config.get("classify", {})
             if signals and (ccfg.get("enabled") or self.triage_llm or self.deep_llm):
                 chains_by_id = {c.id: c for c in chains}
+                use_local = ccfg.get("useLocalTriage")
+                if use_local is None:  # auto: on iff trained weights shipped
+                    from ...models.pretrained import available
+
+                    use_local = available()
                 classified = classify_findings(
                     signals, chains_by_id, self.triage_llm, self.deep_llm,
-                    self.logger, use_local_triage=ccfg.get("useLocalTriage", False))
+                    self.logger, use_local_triage=bool(use_local))
             else:
                 from .classifier import ClassifiedFinding
 

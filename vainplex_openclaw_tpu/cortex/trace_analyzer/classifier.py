@@ -91,15 +91,26 @@ def deep_prompt(finding: FailureSignal, chain) -> str:
 SEVERITY_RANK = {"info": 0, "low": 1, "medium": 2, "high": 3, "critical": 4}
 
 
-def local_triage(findings: list[FailureSignal], min_severity: str = "medium"):
+def local_triage(findings: list[FailureSignal], min_severity: str = "medium",
+                 checkpoint_dir: Optional[str] = None):
     """On-device triage: CortexEncoder severity/keep heads score each
-    finding's text — no HTTP, fully batched (TPU path)."""
-    import jax
+    finding's text — no HTTP, fully batched (TPU path). Runs the SHIPPED
+    trained checkpoint (models/pretrained.py, VERDICT r3 #2); when no
+    checkpoint is present it falls back to random init, where the rule
+    floor below carries all the recall."""
+    from ...models import encode_texts, forward
+    from ...models.pretrained import load_pretrained
 
-    from ...models import EncoderConfig, encode_texts, forward, init_params
+    loaded = load_pretrained(checkpoint_dir)
+    if loaded is None:
+        import jax
 
-    cfg = EncoderConfig()
-    params = init_params(jax.random.PRNGKey(7), cfg)
+        from ...models import EncoderConfig, init_params
+
+        cfg = EncoderConfig()
+        params = init_params(jax.random.PRNGKey(7), cfg)
+    else:
+        cfg, params = loaded
     texts = [f"{f.signal} {f.summary} {' '.join(map(str, f.evidence))}" for f in findings]
     tokens = encode_texts(texts, cfg.seq_len, cfg.vocab_size)
     out = forward(params, tokens, cfg)
@@ -107,8 +118,8 @@ def local_triage(findings: list[FailureSignal], min_severity: str = "medium"):
     import numpy as np
 
     keep = np.asarray(keep_logits).argmax(axis=-1).astype(bool)
-    # Untrained model → keep everything at its rule severity; once distilled
-    # (models/train.py) the keep head prunes. Rule floor guarantees recall:
+    # The trained keep head prunes noise findings; the rule floor guarantees
+    # recall either way — a rule-severe finding is never dropped by the model.
     floor = SEVERITY_RANK[min_severity]
     decisions = []
     for i, f in enumerate(findings):

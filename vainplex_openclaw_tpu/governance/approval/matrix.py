@@ -5,8 +5,9 @@ code-reading poller alone leaves open).
 
 Speaks the client-server API directly: ``PUT
 /_matrix/client/v3/rooms/{room}/send/m.room.message/{txnId}`` with a
-process-unique transaction id (Matrix dedupes retried PUTs on the txn id, so
-a network retry can never double-post a prompt). The HTTP call goes through
+process-unique transaction id. ``send`` retries a failed PUT once with the
+SAME txn id, so Matrix-side dedup guarantees the retry can never double-post
+a prompt even when the first attempt actually landed. The HTTP call goes through
 a DI'd ``http_put`` so tests run against a fake homeserver and the
 zero-egress environment degrades to a logged warning — fail-open: a lost
 notification must never block the agent, since the TOTP code still resolves
@@ -54,23 +55,30 @@ class MatrixNotifier:
         return (f"claw2fa-{self._nonce}-{int(self.clock() * 1000)}"
                 f"-{next(self._seq)}")
 
-    def send(self, message: str) -> Optional[str]:
+    def send(self, message: str, retries: int = 1) -> Optional[str]:
         """Post one text message; returns the event id, or None on failure
-        (logged, never raised — notification is fail-open)."""
+        (logged, never raised — notification is fail-open). A failed PUT is
+        retried with the SAME txn id: if the first attempt actually reached
+        the homeserver, Matrix dedup makes the retry a no-op instead of a
+        duplicate prompt."""
         base = self.creds["homeserver"].rstrip("/")
         room = urllib.parse.quote(self.creds["roomId"], safe="")
         url = (f"{base}/_matrix/client/v3/rooms/{room}"
                f"/send/m.room.message/{self._txn_id()}")
         body = {"msgtype": "m.text", "body": message}
-        try:
-            resp = self.http_put(
-                url, {"Authorization": f"Bearer {self.creds['accessToken']}"}, body)
-            event_id = (resp or {}).get("event_id")
-            self.logger.info(f"[2fa] Matrix notification sent ({event_id})")
-            return event_id
-        except Exception as exc:  # noqa: BLE001 — lost prompt must not block the agent
-            self.logger.warn(f"[2fa] Matrix notification failed: {exc}")
-            return None
+        last_exc = None
+        for _ in range(1 + max(retries, 0)):
+            try:
+                resp = self.http_put(
+                    url, {"Authorization": f"Bearer {self.creds['accessToken']}"},
+                    body)
+                event_id = (resp or {}).get("event_id")
+                self.logger.info(f"[2fa] Matrix notification sent ({event_id})")
+                return event_id
+            except Exception as exc:  # noqa: BLE001 — lost prompt must not block the agent
+                last_exc = exc
+        self.logger.warn(f"[2fa] Matrix notification failed: {last_exc}")
+        return None
 
     def notify_fn(self) -> Callable[[str, str, str], None]:
         """Adapter matching Approval2FA.set_notify_fn's (agent, conversation,

@@ -101,15 +101,19 @@ class ChromaEmbeddings:
 
 class LocalEmbeddings:
     """On-device fact embeddings: CortexEncoder vector ⊕ hashed bag-of-tokens,
-    cosine top-k by one matmul. The bag-of-tokens half guarantees lexical
-    grounding while the encoder is untrained; once distilled
-    (models/train.py) the learned half carries semantics. Lazy model init
-    (first sync pays compile)."""
+    cosine top-k by one matmul. The learned half runs the SHIPPED trained
+    checkpoint (models/pretrained.py, VERDICT r3 #2) so label-semantic
+    neighborhoods (failure-ish facts near failure-ish queries) come for free;
+    the bag-of-tokens half guarantees lexical grounding. Falls back to
+    random init only when no checkpoint is present. Lazy model init (first
+    sync pays compile/restore)."""
 
-    def __init__(self, logger, seed: int = 11, learned_weight: float = 0.5):
+    def __init__(self, logger, seed: int = 11, learned_weight: float = 0.5,
+                 checkpoint_dir: Optional[str] = None):
         self.logger = logger
         self.seed = seed
         self.learned_weight = learned_weight
+        self.checkpoint_dir = checkpoint_dir
         self._model = None
         self._ids: list[str] = []
         self._vectors: Optional[np.ndarray] = None
@@ -120,6 +124,10 @@ class LocalEmbeddings:
 
     def _embed(self, texts: list[str]) -> np.ndarray:
         if self._model is None:
+            from ..models.pretrained import load_pretrained
+
+            self._model = load_pretrained(self.checkpoint_dir)
+        if self._model is None:  # no shipped checkpoint anywhere
             import jax
 
             from ..models import EncoderConfig, init_params
@@ -194,5 +202,6 @@ def create_embeddings(config: dict, logger, http_post: Callable = _default_http_
     if backend == "chroma":
         return ChromaEmbeddings(config, logger, http_post)
     if backend == "local":
-        return LocalEmbeddings(logger)
+        return LocalEmbeddings(logger,
+                               checkpoint_dir=(config or {}).get("checkpointDir"))
     return None

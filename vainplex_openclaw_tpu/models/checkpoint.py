@@ -100,10 +100,19 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: Optional[int] = None,
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".npz.tmp")
     mfd, mtmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".json.tmp")
     try:
-        with os.fdopen(mfd, "w") as f:
-            json.dump(manifest, f)
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
+        try:
+            with os.fdopen(mfd, "w") as f:
+                mfd = None  # ownership passed; context manager closes it
+                json.dump(manifest, f)
+            with os.fdopen(fd, "wb") as f:
+                fd = None
+                np.savez(f, **arrays)
+        finally:
+            # An early failure (e.g. non-JSON-serializable metadata) must not
+            # leak the raw fd that was never wrapped (ADVICE r2).
+            for leaked in (fd, mfd):
+                if leaked is not None:
+                    os.close(leaked)
         os.replace(mtmp, os.path.join(ckpt_dir, f"step-{step}.manifest.json"))
         os.replace(tmp, final)
     except BaseException:

@@ -93,6 +93,13 @@ def train_loop(state: TrainState, data, cfg: EncoderConfig,
     (tests/test_train_loop.py)."""
     from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 
+    if len(data) < data.batch_size:
+        # Drop-remainder batching would yield ZERO batches per epoch while
+        # steps_per_epoch floors at 1 — the loop below would spin forever
+        # without ever advancing state.step (ADVICE r2).
+        raise ValueError(
+            f"dataset of {len(data)} examples cannot fill one batch of "
+            f"{data.batch_size} (drop-remainder); shrink batch_size or add data")
     if ckpt_dir and latest_step(ckpt_dir) is not None:
         state = restore_checkpoint(ckpt_dir, like=state)
     steps_per_epoch = max(len(data) // data.batch_size, 1)
