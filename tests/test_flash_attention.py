@@ -67,6 +67,35 @@ class TestFlashAttention:
             flash_attention(q, k, v, block_q=24, block_k=16)
 
 
+class TestDefaultBlock:
+    """Tuned block picker (FLASH_SWEEP_r04.json): 512-cap up to L=4096,
+    1024-cap beyond, always an MXU-aligned divisor of L."""
+
+    @pytest.mark.parametrize("L,expected", [
+        (64, 64), (128, 128), (512, 512), (2048, 512), (4096, 512),
+        (8192, 1024), (16384, 1024), (192, 192), (96, 96)])
+    def test_picks_measured_optimum(self, L, expected):
+        from vainplex_openclaw_tpu.ops.flash_attention import default_block
+
+        assert default_block(L) == expected
+
+    @pytest.mark.parametrize("L", [131, 100, 7])
+    def test_no_aligned_divisor_returns_none(self, L):
+        from vainplex_openclaw_tpu.ops.flash_attention import default_block
+
+        assert default_block(L) is None
+
+    def test_default_blocks_used_when_unspecified(self, qkv):
+        # Auto blocks (64 at the fixture's L=64) ≡ explicitly pinned blocks.
+        import numpy as np
+
+        q, k, v, mask = qkv
+        auto = flash_attention(q, k, v, mask)
+        pinned = flash_attention(q, k, v, mask, block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(auto, np.float32),
+                                   np.asarray(pinned, np.float32), atol=3e-2)
+
+
 class TestEncoderFlashPath:
     def test_forward_parity_dense_vs_flash(self):
         base = dict(vocab_size=512, seq_len=64, d_model=64, n_heads=4,

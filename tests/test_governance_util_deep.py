@@ -1,0 +1,237 @@
+"""Granular util suite — ported case-by-case from the reference's
+governance/test/util.test.ts (47 cases; VERDICT r3 #5 test-depth parity).
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.governance.util import (
+    clamp, current_time_context, extract_agent_id, extract_agent_ids,
+    extract_parent_session_key, glob_to_regex, is_in_time_range, is_sub_agent,
+    now_us, parse_time_to_minutes, resolve_agent_id, score_to_tier,
+    tier_ordinal)
+
+
+class TestParseTimeToMinutes:
+    @pytest.mark.parametrize("text,minutes", [
+        ("00:00", 0), ("12:30", 750), ("23:59", 1439)])
+    def test_valid(self, text, minutes):
+        assert parse_time_to_minutes(text) == minutes
+
+    @pytest.mark.parametrize("text", ["25:00", "abc", "12:60"])
+    def test_invalid_is_minus_one(self, text):
+        assert parse_time_to_minutes(text) == -1
+
+
+class TestIsInTimeRange:
+    def test_normal_range(self):
+        assert is_in_time_range(600, 480, 1020)       # 10:00 in 08–17
+        assert not is_in_time_range(300, 480, 1020)   # 05:00 not in 08–17
+
+    def test_midnight_wrap(self):
+        assert is_in_time_range(1400, 1380, 360)      # 23:20 in 23–06
+        assert is_in_time_range(100, 1380, 360)       # 01:40 in 23–06
+        assert not is_in_time_range(600, 1380, 360)   # 10:00 not in 23–06
+
+    def test_equal_start_end_empty(self):
+        assert not is_in_time_range(600, 480, 480)
+
+
+class TestCurrentTimeContext:
+    def test_fields_in_range(self):
+        tc = current_time_context()
+        assert 0 <= tc.hour < 24
+        assert 0 <= tc.minute < 60
+        assert 0 <= tc.day_of_week < 7
+        import re
+
+        assert re.match(r"^\d{4}-\d{2}-\d{2}$", tc.date)
+
+    def test_day_of_week_sunday_zero_convention(self):
+        # 2026-07-26 was a Sunday; struct_tm wday (Mon=0) must map to 0.
+        import calendar
+
+        ts = calendar.timegm((2026, 7, 26, 12, 0, 0, 0, 0, 0))
+        import time as _t
+
+        # current_time_context uses localtime; compute expected from the
+        # same conversion instead of assuming the box's TZ.
+        expected = (_t.localtime(ts).tm_wday + 1) % 7
+        assert current_time_context(ts).day_of_week == expected
+
+
+class TestGlobToRegex:
+    def test_exact_match(self):
+        assert glob_to_regex("exec").match("exec")
+        assert not glob_to_regex("exec").match("exec2")
+
+    def test_star_wildcard(self):
+        assert glob_to_regex("memory_*").match("memory_search")
+        assert not glob_to_regex("memory_*").match("exec")
+
+    def test_question_wildcard(self):
+        assert glob_to_regex("rea?").match("read")
+        assert not glob_to_regex("rea?").match("reading")
+
+    def test_regex_specials_escaped(self):
+        assert glob_to_regex("file.txt").match("file.txt")
+        assert not glob_to_regex("file.txt").match("filextxt")
+
+
+class TestClampAndTiers:
+    def test_clamp(self):
+        assert clamp(50, 0, 100) == 50
+        assert clamp(-10, 0, 100) == 0
+        assert clamp(150, 0, 100) == 100
+
+    def test_now_us_positive_monotonicish(self):
+        assert now_us() > 0
+
+    @pytest.mark.parametrize("score,tier", [
+        (0, "untrusted"), (19, "untrusted"), (20, "restricted"),
+        (39, "restricted"), (40, "standard"), (59, "standard"),
+        (60, "trusted"), (79, "trusted"), (80, "elevated"), (100, "elevated")])
+    def test_score_to_tier_boundaries(self, score, tier):
+        assert score_to_tier(score) == tier
+
+    @pytest.mark.parametrize("tier,ordinal", [
+        ("untrusted", 0), ("restricted", 1), ("standard", 2),
+        ("trusted", 3), ("elevated", 4)])
+    def test_tier_ordinal(self, tier, ordinal):
+        assert tier_ordinal(tier) == ordinal
+
+
+class TestExtractAgentId:
+    def test_explicit_agent_id_wins(self):
+        assert extract_agent_id("agent:main", "forge") == "forge"
+
+    def test_root_session_key(self):
+        assert extract_agent_id("agent:main") == "main"
+
+    def test_subagent_session_key(self):
+        assert extract_agent_id("agent:main:subagent:forge:abc") == "forge"
+
+    def test_missing_everything_unknown(self):
+        assert extract_agent_id() == "unknown"
+
+
+class TestIsSubAgent:
+    def test_detects_subagents(self):
+        assert is_sub_agent("agent:main:subagent:forge:abc")
+
+    def test_root_is_not_subagent(self):
+        assert not is_sub_agent("agent:main")
+
+    def test_none_is_not_subagent(self):
+        assert not is_sub_agent(None)
+
+
+class TestExtractParentSessionKey:
+    def test_parent_for_subagent(self):
+        assert extract_parent_session_key(
+            "agent:main:subagent:forge:abc") == "agent:main"
+
+    def test_none_for_root(self):
+        assert extract_parent_session_key("agent:main") is None
+
+
+class TestResolveAgentId:
+    def test_agent_id_when_provided(self):
+        assert resolve_agent_id({"agent_id": "atlas"}) == "atlas"
+
+    def test_parse_from_session_key(self):
+        assert resolve_agent_id({"session_key": "agent:forge:abc"}) == "forge"
+
+    def test_parse_subagent_from_session_key(self):
+        assert resolve_agent_id(
+            {"session_key": "agent:main:subagent:forge:abc"}) == "forge"
+
+    def test_unresolved_when_all_absent(self):
+        assert resolve_agent_id({}) == "unresolved"
+
+    def test_unresolved_for_uuid_session_key(self):
+        assert resolve_agent_id(
+            {"session_key": "78b1f33b-e9a4-4eae-8341-7c57bbc69843"}) == "unresolved"
+
+    def test_session_id_fallback(self):
+        assert resolve_agent_id({"session_id": "agent:leuko:session123"}) == "leuko"
+
+    def test_event_metadata_last_resort(self):
+        assert resolve_agent_id({}, {"metadata": {"agent_id": "forge"}}) == "forge"
+
+    def test_debug_logged_when_unresolved(self):
+        logger = list_logger()
+        resolve_agent_id({}, None, logger)
+        msgs = logger.messages("debug")
+        assert len(msgs) == 1 and "resolve" in msgs[0]
+
+    def test_no_warning_when_resolved(self):
+        logger = list_logger()
+        resolve_agent_id({"agent_id": "atlas"}, None, logger)
+        assert logger.messages("warn") == []
+
+    def test_agent_id_beats_session_key(self):
+        assert resolve_agent_id({"agent_id": "atlas",
+                                 "session_key": "agent:forge"}) == "atlas"
+
+    def test_session_key_beats_session_id(self):
+        assert resolve_agent_id({"session_key": "agent:forge",
+                                 "session_id": "agent:leuko"}) == "forge"
+
+    def test_session_id_beats_event_metadata(self):
+        assert resolve_agent_id({"session_id": "agent:leuko"},
+                                {"metadata": {"agent_id": "other"}}) == "leuko"
+
+    def test_empty_string_agent_id_falls_through(self):
+        assert resolve_agent_id({"agent_id": "",
+                                 "session_key": "agent:forge"}) == "forge"
+
+
+class TestExtractAgentIds:
+    def test_object_array(self):
+        cfg = {"agents": {"list": [{"id": "main"}, {"id": "forge"},
+                                   {"id": "cerberus"}]}}
+        assert extract_agent_ids(cfg) == ["main", "forge", "cerberus"]
+
+    def test_string_array(self):
+        assert extract_agent_ids({"agents": {"list": ["main", "forge"]}}) == \
+            ["main", "forge"]
+
+    def test_mixed_array_skips_junk(self):
+        cfg = {"agents": {"list": ["main", {"id": "forge"}, 42, None]}}
+        assert extract_agent_ids(cfg) == ["main", "forge"]
+
+    def test_missing_agents_key(self):
+        assert extract_agent_ids({}) == []
+
+    def test_missing_list_key_named_shape(self):
+        # agents as a dict without list/definitions → named-key shape.
+        assert extract_agent_ids({"agents": {}}) == []
+
+    def test_non_array_list(self):
+        assert extract_agent_ids({"agents": {"list": "not-an-array"}}) == []
+
+    def test_entries_without_id_use_name_or_skip(self):
+        cfg = {"agents": {"list": [{"name": "named"}, {"id": "valid"},
+                                   {"other": 1}]}}
+        assert extract_agent_ids(cfg) == ["named", "valid"]
+
+    def test_non_string_id_skipped(self):
+        cfg = {"agents": {"list": [{"id": 42}, {"id": "valid"}]}}
+        assert extract_agent_ids(cfg) == ["valid"]
+
+    def test_agents_as_non_object(self):
+        assert extract_agent_ids({"agents": "string"}) == []
+        assert extract_agent_ids({"agents": None}) == []
+
+    def test_flat_list_shape(self):
+        assert extract_agent_ids({"agents": ["main", {"id": "forge"}]}) == \
+            ["main", "forge"]
+
+    def test_definitions_shape(self):
+        cfg = {"agents": {"definitions": [{"id": "a"}, {"id": "b"}]}}
+        assert extract_agent_ids(cfg) == ["a", "b"]
+
+    def test_named_keys_shape(self):
+        cfg = {"agents": {"main": {}, "forge": {}, "defaults": {}}}
+        assert sorted(extract_agent_ids(cfg)) == ["forge", "main"]

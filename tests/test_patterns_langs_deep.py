@@ -1,0 +1,245 @@
+"""Per-language depth for the cortex pattern packs — ~13 cases per language
+× 10 languages (VERDICT r3 #5; reference: cortex/test/patterns-lang-*.test.ts,
+one file per language). Every case drives the REAL merged-compiled pack for
+exactly one language: two decision phrasings, two closure phrasings, a wait,
+a topic extraction (with the captured topic pinned), all five moods, a
+high-impact priority, and a blacklist noise topic.
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.cortex.patterns import MergedPatterns
+from vainplex_openclaw_tpu.cortex.thread_tracker import extract_signals
+
+# lang → dict of cases. "topic" is (text, expected-substring-of-capture).
+LANG_CASES = {
+    "en": {
+        "decisions": ["we agreed on the rollout plan",
+                      "we'll go with postgres for storage"],
+        "closes": ["that's resolved now", "it works after the patch"],
+        "wait": "blocked by the infra team",
+        "topic": ("let's talk about the database sharding plan",
+                  "database sharding"),
+        "moods": {"frustrated": "this is annoying",
+                  "excited": "awesome result",
+                  "tense": "careful with that",
+                  "productive": "deployed the fix",
+                  "exploratory": "what if we cache it"},
+        "high": "production rollout",
+        "noise": "something else",
+    },
+    "de": {
+        "decisions": ["wir haben entschieden zu migrieren",
+                      "machen wir so"],
+        "closes": ["das ist erledigt", "es funktioniert jetzt"],
+        "wait": "warten auf das Review",
+        "topic": ("zurück zu datenbank migration", "datenbank migration"),
+        "moods": {"frustrated": "das ist nervig",
+                  "excited": "das ist mega",
+                  "tense": "achtung, das ist heikel",
+                  "productive": "der build läuft",
+                  "exploratory": "vielleicht geht das anders"},
+        "high": "produktion freigabe",
+        "noise": "etwas anderes",
+    },
+    "fr": {
+        "decisions": ["c'est convenu entre nous", "le plan est simple"],
+        "closes": ["c'est réglé", "ça marche bien"],
+        "wait": "bloqué par l'équipe infra",
+        "topic": ("parlons de la migration des données", "migration"),
+        "moods": {"frustrated": "quelle galère",
+                  "excited": "c'est génial",
+                  "tense": "attention au risque",
+                  "productive": "déployé hier soir",
+                  "exploratory": "et si on essayait"},
+        "high": "audit de sécurité",
+        "noise": "rien du tout",
+    },
+    "es": {
+        "decisions": ["hemos acordado el plan", "el plan es simple"],
+        "closes": ["ya está hecho", "eso funciona ahora"],
+        "wait": "esperando a que termine el build",
+        "topic": ("hablemos de la migración de datos", "migración"),
+        "moods": {"frustrated": "qué fastidio",
+                  "excited": "resultado increíble",
+                  "tense": "cuidado con eso",
+                  "productive": "desplegado y estable",
+                  "exploratory": "quizás podamos probarlo"},
+        "high": "entorno de producción",
+        "noise": "algo más",
+    },
+    "pt": {
+        "decisions": ["foi combinado com o time", "o plano é este"],
+        "closes": ["está feito", "isso funciona agora"],
+        "wait": "aguardando o deploy",
+        "topic": ("vamos falar de migração de dados", "migração"),
+        "moods": {"frustrated": "que droga",
+                  "excited": "ficou incrível",
+                  "tense": "cuidado com isso",
+                  "productive": "consertado ontem",
+                  "exploratory": "talvez funcione melhor"},
+        "high": "ambiente de produção",
+        "noise": "algo diferente",
+    },
+    "it": {
+        "decisions": ["abbiamo concordato il rollout", "il piano è chiaro"],
+        "closes": ["è fatto", "questo funziona adesso"],
+        "wait": "in attesa di review",
+        "topic": ("parliamo di migrazione del database", "migrazione"),
+        "moods": {"frustrated": "che palle",
+                  "excited": "risultato fantastico",
+                  "tense": "attenzione al rischio",
+                  "productive": "sistemato ieri",
+                  "exploratory": "forse possiamo provare"},
+        "high": "sicurezza del sistema",
+        "noise": "qualcosa di nuovo",
+    },
+    "zh": {
+        "decisions": ["我们决定用新方案", "方案敲定了"],
+        "closes": ["问题解决了", "已经搞定"],
+        "wait": "等待审核通过",
+        "topic": ("关于数据库迁移", "数据库迁移"),
+        "moods": {"frustrated": "烦死了",
+                  "excited": "太好了",
+                  "tense": "小心点",
+                  "productive": "部署了新版本",
+                  "exploratory": "试试这个办法"},
+        "high": "生产环境部署",
+        "noise": "这个",
+    },
+    "ja": {
+        "decisions": ["方針は明確です", "これで行きましょう"],
+        "closes": ["修正済みです", "解決しました"],
+        "wait": "レビュー待ちです",
+        "topic": ("アーキテクチャについて話しましょう", "アーキテクチャ"),
+        "moods": {"frustrated": "最悪だ",
+                  "excited": "最高です",
+                  "tense": "危険です",
+                  "productive": "デプロイしました",
+                  "exploratory": "たぶん大丈夫"},
+        "high": "セキュリティの見直し",
+        "noise": "これ",
+    },
+    "ko": {
+        "decisions": ["배포하기로 했습니다", "계획은 이렇습니다"],
+        "closes": ["버그를 고쳤습니다", "완료했습니다"],
+        "wait": "리뷰 대기 중입니다",
+        "topic": ("마이그레이션에 대해 이야기합시다", "마이그레이션"),
+        "moods": {"frustrated": "짜증나요",
+                  "excited": "대박이다",
+                  "tense": "조심하세요",
+                  "productive": "이제 됩니다",
+                  "exploratory": "아마 가능할 겁니다"},
+        "high": "보안 점검",
+        "noise": "이것",
+    },
+    "ru": {
+        "decisions": ["мы решили мигрировать", "договорились об этом"],
+        "closes": ["уже готово", "теперь работает"],
+        "wait": "ожидаем деплой",
+        "topic": ("поговорим о миграции базы", "миграции"),
+        "moods": {"frustrated": "это бесит",
+                  "excited": "отлично вышло",
+                  "tense": "осторожно с этим",
+                  "productive": "задеплоил вчера",
+                  "exploratory": "а что если попробовать"},
+        "high": "безопасность сервиса",
+        "noise": "ничего",
+    },
+}
+
+_PACKS = {code: MergedPatterns([code]) for code in LANG_CASES}
+
+
+def _cases(kind):
+    out = []
+    for code, table in LANG_CASES.items():
+        if kind == "decision":
+            out += [(code, t) for t in table["decisions"]]
+        elif kind == "close":
+            out += [(code, t) for t in table["closes"]]
+        elif kind == "mood":
+            out += [(code, mood, text) for mood, text in table["moods"].items()]
+        else:
+            out.append((code, table[kind]))
+    return out
+
+
+class TestDecisionsPerLanguage:
+    @pytest.mark.parametrize("code,text", _cases("decision"),
+                             ids=lambda v: str(v)[:28])
+    def test_decision_detected(self, code, text):
+        assert extract_signals(text, _PACKS[code]).decisions, f"{code}: {text}"
+
+
+class TestClosuresPerLanguage:
+    @pytest.mark.parametrize("code,text", _cases("close"),
+                             ids=lambda v: str(v)[:28])
+    def test_closure_detected(self, code, text):
+        assert extract_signals(text, _PACKS[code]).closures, f"{code}: {text}"
+
+
+class TestWaitsPerLanguage:
+    @pytest.mark.parametrize("code,text", _cases("wait"),
+                             ids=lambda v: str(v)[:28])
+    def test_wait_detected(self, code, text):
+        assert extract_signals(text, _PACKS[code]).waits, f"{code}: {text}"
+
+
+class TestTopicsPerLanguage:
+    @pytest.mark.parametrize("code,case", _cases("topic"),
+                             ids=lambda v: str(v)[:28])
+    def test_topic_captured(self, code, case):
+        text, expected = case
+        topics = extract_signals(text, _PACKS[code]).topics
+        assert topics, f"{code}: no topic in {text!r}"
+        assert any(expected in t for t in topics), f"{code}: {topics}"
+
+
+class TestMoodsPerLanguage:
+    @pytest.mark.parametrize("code,mood,text", _cases("mood"),
+                             ids=lambda v: str(v)[:24])
+    def test_mood_detected(self, code, mood, text):
+        assert _PACKS[code].detect_mood(text) == mood, f"{code}: {text}"
+
+
+class TestPriorityPerLanguage:
+    @pytest.mark.parametrize("code,text", _cases("high"),
+                             ids=lambda v: str(v)[:28])
+    def test_high_impact_keyword_high_priority(self, code, text):
+        assert _PACKS[code].infer_priority(text) == "high", f"{code}: {text}"
+
+    @pytest.mark.parametrize("code", sorted(LANG_CASES))
+    def test_plain_topic_medium_priority(self, code):
+        assert _PACKS[code].infer_priority("zzz qqq plain") == "medium"
+
+
+class TestNoisePerLanguage:
+    @pytest.mark.parametrize("code,text", _cases("noise"),
+                             ids=lambda v: str(v)[:28])
+    def test_blacklisted_topic_is_noise(self, code, text):
+        assert _PACKS[code].is_noise_topic(text), f"{code}: {text}"
+
+    @pytest.mark.parametrize("code", sorted(LANG_CASES))
+    def test_real_topic_not_noise(self, code):
+        # A real multi-word technical topic is never noise in any pack.
+        assert not _PACKS[code].is_noise_topic("kubernetes cluster upgrade")
+
+
+class TestCrossLanguageIsolation:
+    """A single-language pack must NOT fire on other languages' cue words —
+    merged packs exist for that (registry merge semantics)."""
+
+    def test_en_only_ignores_german_decision(self):
+        assert not extract_signals("wir haben beschlossen", _PACKS["en"]).decisions
+
+    def test_de_only_ignores_english_decision(self):
+        assert not extract_signals("we decided to ship", _PACKS["de"]).decisions
+
+    def test_zh_only_ignores_korean_closure(self):
+        assert not extract_signals("완료했습니다", _PACKS["zh"]).closures
+
+    def test_merged_pack_fires_on_both(self):
+        merged = MergedPatterns(["en", "de"])
+        assert extract_signals("wir haben beschlossen", merged).decisions
+        assert extract_signals("we decided to ship", merged).decisions

@@ -1,0 +1,163 @@
+"""Redaction engine edge matrix: JSON-in-string reparse, allowlist
+interplay, overlapping/adjacent matches through the vault, and nested
+structures (VERDICT r3 #5 — the engine-level halves of the reference's
+registry.test.ts / engine coverage not already pinned by
+test_redaction_deep.py).
+"""
+
+import json
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.governance.redaction.engine import RedactionEngine
+from vainplex_openclaw_tpu.governance.redaction.hooks import (
+    _engine_for, _engine_for_channel, init_redaction)
+from vainplex_openclaw_tpu.governance.redaction.registry import PatternRegistry
+from vainplex_openclaw_tpu.governance.redaction.vault import RedactionVault
+
+GHP = "ghp_" + "a" * 36
+EMAIL = "leak@example.com"
+CARD = "4111 1111 1111 1111"
+
+
+def make_engine(categories=("credential", "pii", "financial")):
+    vault = RedactionVault(list_logger(), 3600)
+    return RedactionEngine(PatternRegistry(list(categories), []), vault)
+
+
+def make_state(**config):
+    return init_redaction(config, list_logger())
+
+
+class TestJsonInString:
+    def test_secret_inside_json_string_value(self):
+        payload = json.dumps({"config": {"token": None, "gh": GHP}})
+        res = make_engine().scan(payload)
+        assert GHP not in res.output
+        assert res.redaction_count >= 1
+        json.loads(res.output)  # still valid JSON after redaction
+
+    def test_json_array_in_string(self):
+        payload = json.dumps([GHP, "clean", EMAIL])
+        res = make_engine().scan(payload)
+        out = json.loads(res.output)
+        assert GHP not in out[0] and out[1] == "clean" and EMAIL not in out[2]
+
+    def test_doubly_nested_json_strings(self):
+        inner = json.dumps({"secret": GHP})
+        outer = json.dumps({"wrapped": inner})
+        res = make_engine().scan(outer)
+        assert GHP not in res.output
+        assert res.redaction_count >= 1
+
+    def test_json_lookalike_that_fails_parse_still_scanned(self):
+        text = '{"broken: ' + GHP + "}"
+        res = make_engine().scan(text)
+        assert GHP not in res.output
+
+    def test_non_json_string_plain_scan(self):
+        res = make_engine().scan(f"push with {GHP} now")
+        assert GHP not in res.output
+
+
+class TestOverlapAdjacencyThroughVault:
+    def test_adjacent_secrets_each_get_distinct_placeholder(self):
+        other = "ghp_" + "b" * 36
+        res = make_engine().scan(f"{GHP} {other}")
+        assert GHP not in res.output and other not in res.output
+        placeholders = [w for w in res.output.split() if "REDACTED" in w]
+        assert len(placeholders) == 2
+        assert placeholders[0] != placeholders[1]
+
+    def test_same_secret_twice_same_placeholder(self):
+        res = make_engine().scan(f"a {GHP} b {GHP} c")
+        ph = [w for w in res.output.split() if "REDACTED" in w]
+        assert len(ph) == 2 and ph[0] == ph[1]
+
+    def test_kv_credential_swallows_overlapping_inner_key(self):
+        res = make_engine().scan("api_key=sk-proj-abc123def456 trailing")
+        assert "sk-proj-abc123def456" not in res.output
+        assert res.redaction_count == 1  # one merged match, not two
+
+    def test_mixed_categories_counted(self):
+        res = make_engine().scan(f"{GHP} then {EMAIL} then {CARD}")
+        assert res.categories == {"credential", "pii", "financial"}
+        assert res.redaction_count == 3
+
+    def test_count_and_elapsed_recorded(self):
+        res = make_engine().scan({"a": GHP})
+        assert res.redaction_count == 1
+        assert res.elapsed_ms >= 0.0
+
+
+class TestAllowlistInterplay:
+    def test_exempt_tool_gets_credential_only_engine(self):
+        state = make_state(enabled=True,
+                           allowlist={"exemptTools": ["screenshot"]})
+        eng = _engine_for(state, "screenshot", "main")
+        res = eng.scan(f"{GHP} and {EMAIL}")
+        assert GHP not in res.output      # credentials ALWAYS scrubbed
+        assert EMAIL in res.output        # pii allowed for exempt tool
+
+    def test_exempt_agent_gets_credential_only_engine(self):
+        state = make_state(enabled=True,
+                           allowlist={"exemptAgents": ["forge"]})
+        assert _engine_for(state, "exec", "forge") is state.credential_only_engine
+        assert _engine_for(state, "exec", "main") is state.engine
+
+    def test_pii_allowed_channel_keeps_financial_scrubbing(self):
+        state = make_state(enabled=True,
+                           allowlist={"piiAllowedChannels": ["dm"]})
+        eng = _engine_for_channel(state, "dm")
+        res = eng.scan(f"{EMAIL} pays with {CARD}")
+        assert EMAIL in res.output        # pii allowed on this channel
+        assert "4111" not in res.output   # financial still scrubbed
+
+    def test_financial_allowed_channel_keeps_pii_scrubbing(self):
+        state = make_state(enabled=True,
+                           allowlist={"financialAllowedChannels": ["billing"]})
+        eng = _engine_for_channel(state, "billing")
+        res = eng.scan(f"{EMAIL} pays with {CARD}")
+        assert EMAIL not in res.output
+        assert "4111" in res.output
+
+    def test_unlisted_channel_full_engine(self):
+        state = make_state(enabled=True,
+                           allowlist={"piiAllowedChannels": ["dm"]})
+        assert _engine_for_channel(state, "public") is state.engine
+
+    def test_both_allowances_stack(self):
+        state = make_state(enabled=True,
+                           allowlist={"piiAllowedChannels": ["x"],
+                                      "financialAllowedChannels": ["x"]})
+        eng = _engine_for_channel(state, "x")
+        res = eng.scan(f"{EMAIL} {CARD} {GHP}")
+        assert EMAIL in res.output and "4111" in res.output
+        assert GHP not in res.output      # credentials never allowlisted
+
+
+class TestNestedStructures:
+    def test_dict_keys_preserved_values_scrubbed(self):
+        res = make_engine().scan({"outer": {"inner": [GHP, {"deep": EMAIL}]}})
+        assert GHP not in json.dumps(res.output)
+        assert EMAIL not in json.dumps(res.output)
+        assert set(res.output) == {"outer"}
+
+    def test_unicode_keys_and_values_survive(self):
+        res = make_engine().scan({"schlüssel": f"wert {GHP} 結束"})
+        assert "schlüssel" in res.output
+        assert "結束" in res.output["schlüssel"]
+        assert GHP not in res.output["schlüssel"]
+
+    def test_numbers_and_bools_untouched(self):
+        res = make_engine().scan({"n": 42, "f": 1.5, "b": True, "z": None})
+        assert res.output == {"n": 42, "f": 1.5, "b": True, "z": None}
+        assert res.redaction_count == 0
+
+    def test_vault_roundtrip_restores_original(self):
+        vault = RedactionVault(list_logger(), 3600)
+        eng = RedactionEngine(PatternRegistry(["credential"], []), vault)
+        res = eng.scan(f"use {GHP} here")
+        restored, n = vault.resolve_placeholders(res.output)
+        assert restored == f"use {GHP} here" and n == 1

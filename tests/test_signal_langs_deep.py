@@ -1,0 +1,132 @@
+"""Second wave of per-language signal-pack depth (VERDICT r3 #5 — ~10 cases
+per language per pack). Complements tests/test_signal_langs.py's five
+behaviors with five more, each driven through the REAL chain reconstructor
+and detectors: short-negative corrections, resolution cancelling
+dissatisfaction, unverified completion claims, and alternate correction /
+dissatisfaction phrasings.
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.cortex.trace_analyzer import (
+    MemoryTraceSource, reconstruct_chains)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signal_patterns import (
+    compile_signal_patterns)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import detect_all_signals
+
+from trace_helpers import EventFactory
+
+# lang → (short_negative, resolution_phrase, correction_alt,
+#         dissatisfaction_alt, completion_claim)
+CASES = {
+    "en": ("Nope.", "my apologies, here's the corrected config",
+           "actually, the port is 8080", "forget it",
+           "I have successfully deployed the service"),
+    "de": ("Nein.", "entschuldigung, das ist jetzt behoben",
+           "das stimmt nicht, Port ist 8080", "vergiss es",
+           "erfolgreich abgeschlossen"),
+    "fr": ("non", "désolé, voici la correction",
+           "en fait, c'est le port 8080", "laisse tomber",
+           "j'ai terminé le déploiement avec succès"),
+    "es": ("No.", "disculpa, aquí está la corrección",
+           "te equivocas, es el puerto 8080", "olvídalo",
+           "he completado el despliegue con éxito"),
+    "pt": ("não!", "desculpa, aqui está a correção",
+           "na verdade, é a porta 8080", "esquece",
+           "concluído com sucesso"),
+    "it": ("No.", "scusa, ecco la correzione",
+           "ti sbagli, è la porta 8080", "lascia perdere",
+           "ho completato il deploy con successo"),
+    "zh": ("不是。", "抱歉，已修复",
+           "搞错了，端口是8080", "算了",
+           "部署成功，已完成"),
+    "ja": ("いいえ。", "すみません、修正しました",
+           "誤解です、ポートは8080です", "もういい",
+           "デプロイは成功しました"),
+    "ko": ("아니요.", "죄송합니다, 고쳤습니다",
+           "잘못 이해했어요, 포트는 8080입니다", "포기할래요",
+           "배포 성공, 완료했습니다"),
+    "ru": ("Нет.", "извините, вот исправление",
+           "на самом деле порт 8080", "забудь",
+           "успешно завершено"),
+}
+
+
+def signals_for(raws, lang):
+    patterns = compile_signal_patterns([lang])
+    chains = reconstruct_chains(MemoryTraceSource(raws).fetch())
+    return {s.signal for s in detect_all_signals(chains, patterns)}
+
+
+class TestShortNegatives:
+    """Reference contract (signals/correction.ts:44-49): a bare short
+    negative NEVER fires SIG-CORRECTION on its own — it must match a
+    correction indicator; shortNegatives exist only to EXCLUDE valid
+    answers to agent questions."""
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_bare_short_negative_not_a_correction(self, lang):
+        f = EventFactory()
+        raws = [f.msg_out("the staging environment has been deleted now"),
+                f.msg_in(CASES[lang][0])]
+        if lang == "ko":
+            # The ko pack deliberately lists bare 아니요 as a correction
+            # INDICATOR (politeness makes a bare "no" after an assertion a
+            # correction in Korean usage) — so in ko, unlike every other
+            # pack, this DOES fire; the question-exclusion still applies.
+            assert "SIG-CORRECTION" in signals_for(raws, lang)
+        else:
+            assert "SIG-CORRECTION" not in signals_for(raws, lang), lang
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_short_negative_answer_to_question_excluded(self, lang):
+        f = EventFactory()
+        raws = [f.msg_out("should I also delete the staging environment?"),
+                f.msg_in(CASES[lang][0])]
+        assert "SIG-CORRECTION" not in signals_for(raws, lang), lang
+
+
+class TestResolutionCancelsDissatisfaction:
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_agent_resolution_cancels(self, lang):
+        # Dissatisfaction followed by the agent's resolution phrase must not
+        # end the chain flagged SIG-DISSATISFIED.
+        f = EventFactory()
+        # Use the base dissatisfaction phrase from the companion suite.
+        from test_signal_langs import CASES as BASE
+
+        raws = [f.msg_in(BASE[lang][1]), f.msg_out(CASES[lang][1])]
+        assert "SIG-DISSATISFIED" not in signals_for(raws, lang), lang
+
+
+class TestUnverifiedClaims:
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_claim_without_tool_evidence_flagged(self, lang):
+        f = EventFactory()
+        raws = [f.msg_in("deploy the service"), f.msg_out(CASES[lang][4])]
+        assert "SIG-UNVERIFIED-CLAIM" in signals_for(raws, lang), lang
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_claim_with_tool_evidence_clean(self, lang):
+        f = EventFactory()
+        raws = [f.msg_in("deploy the service"),
+                f.tool_call("exec", {"command": "kubectl apply"}),
+                f.tool_result("exec"),
+                f.msg_out(CASES[lang][4])]
+        assert "SIG-UNVERIFIED-CLAIM" not in signals_for(raws, lang), lang
+
+
+class TestAlternatePhrasings:
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_second_correction_phrasing(self, lang):
+        f = EventFactory()
+        raws = [f.msg_out("the service listens on port 9090"),
+                f.msg_in(CASES[lang][2])]
+        assert "SIG-CORRECTION" in signals_for(raws, lang), lang
+
+    @pytest.mark.parametrize("lang", sorted(CASES))
+    def test_second_dissatisfaction_phrasing(self, lang):
+        f = EventFactory()
+        raws = [f.msg_in("please fix the deploy"), f.msg_out("done"),
+                f.msg_in(CASES[lang][3])]
+        assert "SIG-DISSATISFIED" in signals_for(raws, lang), lang

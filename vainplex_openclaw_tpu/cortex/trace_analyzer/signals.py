@@ -215,60 +215,74 @@ def _tool_attempts(chain: ConversationChain) -> list[dict]:
 
 def _consecutive_similarities(chain, attempts: list[dict]) -> "list | object":
     """``sims[i]`` = similarity(attempts[i], attempts[i+1]) for every
-    consecutive pair, cached on the chain (both detectors below consume the
-    same pairs). Small windows use the reference-exact scalar path; windows
-    of ≥ BATCH_SIMILARITY_MIN attempts batch ALL pairs through the
-    TPU-friendly kernels — exec-command pairs via batch_levenshtein_ratio
-    (one vmapped DP scan), the rest via one jaccard_matrix matmul
-    (tests/test_signals.py pins batched ≡ scalar verdicts)."""
+    consecutive ERROR→ERROR same-tool pair — the ONLY pairs the detectors
+    below ever read; all other slots stay 0.0 and healthy chains cost ~zero
+    (code-review r4: an eager all-pairs version taxed the trace-analyzer
+    headline path on success-only telemetry). Cached on the chain.
+
+    Relevant-pair counts ≥ BATCH_SIMILARITY_MIN route the expensive
+    Levenshtein half through the batched vmapped-DP kernel
+    (ops/similarity.batch_levenshtein_ratio, power-of-two batch buckets so
+    XLA retraces are bounded). Jaccard pairs always use the exact scalar
+    set computation: it is O(#params) — cheap — and the hashed
+    jaccard_matrix approximation could flip a near-threshold verdict on
+    bin collisions, breaking the batched ≡ scalar invariant
+    (tests/test_signals.py pins it). The matmul kernel remains the right
+    tool for true all-pairs workloads and stays covered by its parity
+    tests."""
     cached = getattr(chain, "_pair_sims", None)
     if cached is not None:
         return cached
     n = len(attempts) - 1
-    if n < 1:
-        sims = []
-    elif len(attempts) < BATCH_SIMILARITY_MIN:
-        sims = [param_similarity(attempts[i]["params"], attempts[i + 1]["params"])
-                for i in range(n)]
+    sims = [0.0] * max(n, 0)
+    relevant = [i for i in range(n)
+                if attempts[i]["is_error"] and attempts[i + 1]["is_error"]
+                and attempts[i]["tool"] == attempts[i + 1]["tool"]]
+    if not relevant:
+        chain._pair_sims = sims
+        return sims
+
+    from ...ops.similarity import (
+        LEVENSHTEIN_CAP, batch_levenshtein_ratio, jaccard_similarity,
+        levenshtein_ratio)
+
+    def cmd(i: int) -> str:
+        p = attempts[i]["params"] or {}
+        c = p.get("command")
+        return c if isinstance(c, str) else ""
+
+    # The batched DP kernel is BYTE-level; the scalar reference path is
+    # CHAR-level. They agree exactly only on ASCII, so non-ASCII command
+    # pairs keep the scalar path (rare in exec commands, and parity with
+    # the small-window verdicts must hold bit-for-bit).
+    lev_idx, scalar_lev_idx, jac_idx = [], [], []
+    for i in relevant:
+        a, b = cmd(i), cmd(i + 1)
+        if a and b:
+            if a[:LEVENSHTEIN_CAP].isascii() and b[:LEVENSHTEIN_CAP].isascii():
+                lev_idx.append(i)
+            else:
+                scalar_lev_idx.append(i)
+        else:
+            jac_idx.append(i)
+
+    if len(lev_idx) >= BATCH_SIMILARITY_MIN:
+        # Pad the BATCH dim to a power-of-two bucket: the kernel is jitted
+        # per shape, so unbucketed windows would retrace XLA for every
+        # distinct pair count. length ≥ the scalar 500-char cap.
+        pairs = [(cmd(i), cmd(i + 1)) for i in lev_idx]
+        bucket = 1 << max(len(pairs) - 1, 0).bit_length()
+        pairs += [("", "")] * (bucket - len(pairs))
+        ratios = batch_levenshtein_ratio(pairs, length=LEVENSHTEIN_CAP + 12)
+        for j, i in enumerate(lev_idx):
+            sims[i] = float(ratios[j])
     else:
-        import numpy as np
-
-        from ...ops.similarity import (
-            LEVENSHTEIN_CAP, batch_levenshtein_ratio, jaccard_matrix,
-            levenshtein_ratio)
-
-        params = [a["params"] or {} for a in attempts]
-        cmds = [p.get("command") if isinstance(p.get("command"), str) else ""
-                for p in params]
-        # The batched DP kernel is BYTE-level; the scalar reference path is
-        # CHAR-level. They agree exactly only on ASCII, so non-ASCII command
-        # pairs keep the scalar path (rare in exec commands, and parity with
-        # the small-window verdicts must hold bit-for-bit).
-        ascii_cmd = [bool(c) and c[:LEVENSHTEIN_CAP].isascii() for c in cmds]
-        lev_idx = [i for i in range(n) if ascii_cmd[i] and ascii_cmd[i + 1]]
-        slev_idx = [i for i in range(n) if (cmds[i] and cmds[i + 1])
-                    and i not in set(lev_idx)]
-        jac_idx = [i for i in range(n) if not (cmds[i] and cmds[i + 1])]
-        sims = np.zeros(n, dtype=np.float32)
-
-        def pow2(k: int) -> int:
-            return 1 << max(k - 1, 0).bit_length()
-
-        if lev_idx:
-            # Pad the BATCH dim to a power-of-two bucket: the kernels are
-            # jitted per shape, so unbucketed windows would retrace XLA for
-            # every distinct pair count. length ≥ the scalar 500-char cap.
-            pairs = [(cmds[i], cmds[i + 1]) for i in lev_idx]
-            pairs += [("", "")] * (pow2(len(pairs)) - len(pairs))
-            ratios = batch_levenshtein_ratio(pairs, length=LEVENSHTEIN_CAP + 12)
-            sims[lev_idx] = ratios[:len(lev_idx)]
-        for i in slev_idx:
-            sims[i] = levenshtein_ratio(cmds[i], cmds[i + 1])
-        if jac_idx:
-            padded = params + [{}] * (pow2(len(params)) - len(params))
-            M = jaccard_matrix(padded)
-            sims[jac_idx] = [M[i, i + 1] for i in jac_idx]
-        sims = sims.tolist()
+        scalar_lev_idx = lev_idx + scalar_lev_idx
+    for i in scalar_lev_idx:
+        sims[i] = levenshtein_ratio(cmd(i), cmd(i + 1))
+    for i in jac_idx:
+        sims[i] = jaccard_similarity(attempts[i]["params"] or {},
+                                     attempts[i + 1]["params"] or {})
     chain._pair_sims = sims
     return sims
 

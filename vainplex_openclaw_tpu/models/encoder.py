@@ -109,25 +109,30 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
         # under jit. The Pallas kernel is the TPU hot path (VERDICT r1 #3);
         # dense lets XLA fuse on CPU/GPU where interpret-mode Pallas is slow.
         # "axon" is the image's experimental TPU-tunnel platform — real TPU.
+        # Routing justified by measurement, not vibes (round-4 interleaved
+        # A/B + block sweep on v5e, FLASH_SWEEP_r04.json): with the tuned
+        # block defaults (ops/flash_attention.default_block) flash is at
+        # parity with dense-XLA below ~1k tokens (both sit on the ~6.7 ms
+        # dispatch floor), 2.1× faster at L=2048, and the ONLY feasible
+        # path at L≥8192 where dense's [B,H,L,L] scores tensor fails to
+        # compile at all — so auto stays flash on TPU at every length.
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
     if impl == "flash":
-        from ..ops.flash_attention import flash_attention
+        from ..ops.flash_attention import default_block, flash_attention
 
         # Pallas blocks must divide L and keep the sublane dimension a
-        # multiple of 8 for MXU/VPU alignment. Prefer an aligned divisor of
-        # L ≤128; otherwise pad L up to a multiple of 128 — padded keys are
-        # excluded via the kv mask, padded query rows are sliced away.
-        block = next((b for b in range(min(128, L), 7, -1)
-                      if L % b == 0 and b % 8 == 0), None)
-        if block is not None:
-            out = flash_attention(q, k, v, mask, block_q=block, block_k=block)
+        # multiple of 8 for MXU/VPU alignment; default_block picks the
+        # measured-optimal size. When no aligned divisor exists, pad L up
+        # to a block multiple — padded keys are excluded via the kv mask,
+        # padded query rows are sliced away.
+        if default_block(L) is not None:
+            out = flash_attention(q, k, v, mask)
         else:
             pad = (-L) % 128
             qp, kp, vp = (jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0)))
                           for t in (q, k, v))
             maskp = jnp.pad(mask, ((0, 0), (0, pad)))
-            out = flash_attention(qp, kp, vp, maskp,
-                                  block_q=128, block_k=128)[:, :, :L]
+            out = flash_attention(qp, kp, vp, maskp)[:, :, :L]
     else:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
         scores = jnp.where(mask[:, None, None, :], scores, -1e30)

@@ -82,14 +82,20 @@ def param_similarity(a: dict, b: dict) -> float:
 
 
 def hashed_multi_hot(param_sets: list[dict], dim: int = 1024) -> np.ndarray:
-    """Hash each param-set's key=value entries into a {0,1}^dim vector."""
+    """Hash each param-set's key=value entries into a {0,1}^dim vector.
+
+    Uses crc32, NOT Python's ``hash()``: the builtin is salted per process
+    (PYTHONHASHSEED), so collision behavior — and therefore batched-vs-
+    scalar similarity parity — would vary run to run."""
+    import zlib
+
     X = np.zeros((len(param_sets), dim), dtype=np.float32)
     for i, params in enumerate(param_sets):
         for k, v in (params or {}).items():
             if k in VOLATILE_KEYS:
                 continue
-            h = hash(f"{k}={json.dumps(v, sort_keys=True, default=str)}")
-            X[i, h % dim] = 1.0
+            entry = f"{k}={json.dumps(v, sort_keys=True, default=str)}"
+            X[i, zlib.crc32(entry.encode("utf-8")) % dim] = 1.0
     return X
 
 
