@@ -315,7 +315,16 @@ class TestDoomLoop:
         assert len(sigs) == 1 and sigs[0].extra["tool_name"] == "write"
 
 
-# ── batched similarity wiring (VERDICT r3 #6) ────────────────────────
+# ── batched similarity wiring (VERDICT r3 #6, contract settled r5) ───
+#
+# Contract: the batch gate counts QUALIFYING PAIRS — consecutive
+# error→error same-tool attempts whose commands are both ASCII — not raw
+# window size. Healthy chains (no qualifying pairs) cost ~zero; a window
+# with ≥ BATCH_SIMILARITY_MIN qualifying pairs routes its Levenshtein half
+# through the batched vmapped-DP kernel. Jaccard pairs stay exact-scalar in
+# the consecutive-pair path (cheap, and hashed bins could flip verdicts);
+# jaccard_matrix's production consumer is cross-chain clustering, tested in
+# TestFailureClustering below.
 
 
 def _mixed_big_window(n_exec=20, n_write=16):
@@ -339,6 +348,19 @@ def _mixed_big_window(n_exec=20, n_write=16):
     return raws
 
 
+def _exec_loop_window(n_attempts):
+    """n consecutive same-tool failing exec attempts with ASCII commands →
+    exactly n-1 qualifying Levenshtein pairs."""
+    f = EventFactory()
+    raws = []
+    for i in range(n_attempts):
+        raws += f.failing_call(
+            "exec", {"command": "kubectl rollout status deployment/app "
+                                f"--namespace prod # retry {i}"},
+            "progress deadline exceeded")
+    return raws
+
+
 class TestBatchedSimilarityWiring:
     def _detect(self, raws, monkeypatch, force_scalar):
         import vainplex_openclaw_tpu.cortex.trace_analyzer.signals as sig_mod
@@ -349,29 +371,66 @@ class TestBatchedSimilarityWiring:
         return (detect_doom_loops(chain, EN) +
                 detect_tool_failures(chain, EN))
 
+    def _spy_lev(self, monkeypatch):
+        import vainplex_openclaw_tpu.ops.similarity as ops_sim
+
+        calls = []
+        real_lev = ops_sim.batch_levenshtein_ratio
+        monkeypatch.setattr(ops_sim, "batch_levenshtein_ratio",
+                            lambda *a, **k: calls.append("lev") or real_lev(*a, **k))
+        return calls
+
     def test_batched_verdicts_equal_scalar(self, monkeypatch):
         """The same large window must yield IDENTICAL signals through the
-        batched kernels and the reference-exact scalar path."""
-        raws = _mixed_big_window()
+        batched kernel and the reference-exact scalar path."""
+        raws = _exec_loop_window(40)
         batched = self._detect(raws, monkeypatch, force_scalar=False)
         scalar = self._detect(raws, monkeypatch, force_scalar=True)
         assert [s.to_dict() for s in batched] == [s.to_dict() for s in scalar]
         assert any(s.signal == "SIG-DOOM-LOOP" for s in batched)
 
-    def test_large_window_reaches_jax_kernels(self, monkeypatch):
-        """Production path must actually call the batched ops.similarity
-        kernels (not fall back to scalar) for windows ≥ BATCH_SIMILARITY_MIN."""
-        import vainplex_openclaw_tpu.ops.similarity as ops_sim
+    def test_mixed_window_verdicts_equal_scalar(self, monkeypatch):
+        """Mixed exec/write window (lev + jaccard + breaks) must also be
+        verdict-identical regardless of the gate."""
+        raws = _mixed_big_window(n_exec=40)
+        batched = self._detect(raws, monkeypatch, force_scalar=False)
+        scalar = self._detect(raws, monkeypatch, force_scalar=True)
+        assert [s.to_dict() for s in batched] == [s.to_dict() for s in scalar]
+        assert any(s.signal == "SIG-DOOM-LOOP" for s in batched)
 
-        calls = []
-        real_lev, real_jac = ops_sim.batch_levenshtein_ratio, ops_sim.jaccard_matrix
-        monkeypatch.setattr(ops_sim, "batch_levenshtein_ratio",
-                            lambda *a, **k: calls.append("lev") or real_lev(*a, **k))
-        monkeypatch.setattr(ops_sim, "jaccard_matrix",
-                            lambda *a, **k: calls.append("jac") or real_jac(*a, **k))
-        sigs = self._detect(_mixed_big_window(), monkeypatch, force_scalar=False)
-        assert "lev" in calls and "jac" in calls
-        assert any(s.signal == "SIG-DOOM-LOOP" for s in sigs)
+    def test_at_gate_reaches_batched_lev_kernel(self, monkeypatch):
+        """33 consecutive failing exec attempts = 32 qualifying pairs =
+        BATCH_SIMILARITY_MIN → the batched DP kernel MUST be invoked."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import (
+            BATCH_SIMILARITY_MIN)
+
+        calls = self._spy_lev(monkeypatch)
+        sigs = self._detect(_exec_loop_window(BATCH_SIMILARITY_MIN + 1),
+                            monkeypatch, force_scalar=False)
+        assert "lev" in calls
+        assert any(s.signal == "SIG-DOOM-LOOP" and s.severity == "critical"
+                   for s in sigs)
+
+    def test_below_gate_stays_scalar(self, monkeypatch):
+        """32 attempts = 31 qualifying pairs = one below the gate → scalar
+        path only, no kernel dispatch."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import (
+            BATCH_SIMILARITY_MIN)
+
+        calls = self._spy_lev(monkeypatch)
+        sigs = self._detect(_exec_loop_window(BATCH_SIMILARITY_MIN),
+                            monkeypatch, force_scalar=False)
+        assert calls == []
+        assert any(s.signal == "SIG-DOOM-LOOP" for s in sigs)  # verdict unchanged
+
+    def test_gate_counts_qualifying_pairs_not_window_size(self, monkeypatch):
+        """A big mixed window whose exec loop yields only 19 qualifying
+        pairs must NOT dispatch the kernel, however many attempts the window
+        holds in total — the gate is on relevant work, not window length."""
+        calls = self._spy_lev(monkeypatch)
+        raws = _mixed_big_window(n_exec=20, n_write=40)  # 61+ attempts total
+        self._detect(raws, monkeypatch, force_scalar=False)
+        assert calls == []
 
     def test_non_ascii_commands_keep_scalar_parity(self, monkeypatch):
         """The batched DP kernel is byte-level; non-ASCII command pairs must
@@ -387,17 +446,161 @@ class TestBatchedSimilarityWiring:
         scalar = self._detect(raws, monkeypatch, force_scalar=True)
         assert [s.to_dict() for s in batched] == [s.to_dict() for s in scalar]
 
-    def test_small_window_stays_scalar(self, monkeypatch):
+    def test_healthy_chain_costs_no_similarity(self, monkeypatch):
+        """Success-only telemetry has zero qualifying pairs: neither kernel
+        nor scalar similarity should run (code-review r4 lazy-pairs win)."""
         import vainplex_openclaw_tpu.ops.similarity as ops_sim
 
         calls = []
         monkeypatch.setattr(ops_sim, "batch_levenshtein_ratio",
                             lambda *a, **k: calls.append("lev"))
-        monkeypatch.setattr(ops_sim, "jaccard_matrix",
-                            lambda *a, **k: calls.append("jac"))
-        self._detect(_mixed_big_window(n_exec=3, n_write=2),
-                     monkeypatch, force_scalar=False)
-        assert calls == []  # dispatch overhead not worth it below the cutoff
+        monkeypatch.setattr(ops_sim, "levenshtein_ratio",
+                            lambda *a, **k: calls.append("slev") or 0.0)
+        f = EventFactory()
+        raws = []
+        for i in range(50):
+            raws += [f.tool_call("exec", {"command": f"make step{i}"}),
+                     f.tool_result("exec")]
+        sigs = self._detect(raws, monkeypatch, force_scalar=False)
+        assert calls == [] and sigs == []
+
+
+# ── cross-chain failure clustering (jaccard_matrix consumer) ─────────
+
+
+class TestFailureClustering:
+    def _signals_from(self, sessions_errors):
+        raws = []
+        for session, cmd, error in sessions_errors:
+            f = EventFactory(session=session)
+            for _ in range(3):  # 3 similar failures → one doom-loop signal
+                raws += f.failing_call("exec", {"command": cmd}, error)
+        chains = reconstruct_chains(MemoryTraceSource(raws).fetch())
+        sigs = []
+        for c in chains:
+            sigs += detect_doom_loops(c, EN)
+        return sigs
+
+    def test_near_duplicate_failures_cluster_across_chains(self):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        sigs = self._signals_from([
+            ("s1", "kubectl apply -f app.yaml", "connection refused to apiserver 10.0.0.1"),
+            ("s2", "kubectl apply -f app.yaml", "connection refused to apiserver 10.0.0.9"),
+            ("s3", "pip install torch", "disk quota exceeded on /var"),
+        ])
+        assert len(sigs) == 3
+        clusters = cluster_failure_signals(sigs)
+        assert len(clusters) == 1  # the two kubectl chains merge; pip stays solo
+        assert clusters[0]["size"] == 2
+        assert len(clusters[0]["chains"]) == 2
+        assert clusters[0]["tools"] == ["exec"]
+        assert 0.0 < clusters[0]["meanSimilarity"] <= 1.0
+
+    def test_dissimilar_failures_do_not_cluster(self):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        sigs = self._signals_from([
+            ("s1", "kubectl apply -f app.yaml", "connection refused to apiserver"),
+            ("s2", "pip install torch", "disk quota exceeded on /var"),
+        ])
+        assert cluster_failure_signals(sigs) == []
+
+    def test_same_tool_unrelated_errors_stay_apart(self):
+        """The summary's detector-template words must NOT drive similarity:
+        two exec doom loops with unrelated root causes share the template
+        ('consecutive similar failing calls of exec') but nothing else
+        (code-review r5 finding)."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        sigs = self._signals_from([
+            ("s1", "kubectl apply -f app.yaml", "connection refused to apiserver"),
+            ("s2", "make -j8 all", "disk full while writing object file"),
+        ])
+        assert len(sigs) == 2
+        assert cluster_failure_signals(sigs) == []
+
+    def test_fewer_than_two_signals_no_clusters(self):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        assert cluster_failure_signals([]) == []
+        sigs = self._signals_from([("s1", "make build", "compile error")])
+        assert cluster_failure_signals(sigs) == []
+
+    def test_conversational_signals_excluded(self):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        f = EventFactory()
+        chain = one_chain([
+            f.msg_out("The database is migrated."),
+            f.msg_in("no, that's wrong"),
+        ])
+        corr = detect_corrections(chain, EN)
+        assert corr and cluster_failure_signals(corr * 2) == []
+
+    def test_cap_truncates_warns_and_reports_stats(self):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        sigs = self._signals_from([
+            (f"s{i}", "kubectl apply -f app.yaml", "connection refused")
+            for i in range(6)
+        ])
+        logger = list_logger()
+        stats = {}
+        clusters = cluster_failure_signals(sigs, max_signals=4, logger=logger,
+                                           stats=stats)
+        assert clusters and clusters[0]["size"] == 4
+        assert any("capped" in m for lvl, m in logger.records if lvl == "warn")
+        assert stats["candidates"] == len(sigs) and stats["truncated"] == len(sigs) - 4
+
+    def test_clustering_failure_does_not_kill_run(self, tmp_path, monkeypatch):
+        """A clustering bug must cost the report its clusters, never the
+        run: state still advances and the report still saves."""
+        import vainplex_openclaw_tpu.cortex.trace_analyzer.analyzer as an_mod
+        from vainplex_openclaw_tpu.core.api import list_logger as ll
+        from vainplex_openclaw_tpu.cortex.trace_analyzer import TraceAnalyzer
+
+        def boom(*a, **k):
+            raise RuntimeError("cluster bug")
+
+        monkeypatch.setattr(an_mod, "cluster_failure_signals", boom)
+        f = EventFactory()
+        raws = []
+        for _ in range(3):
+            raws += f.failing_call("exec", {"command": "x"}, "err")
+        logger = ll()
+        analyzer = TraceAnalyzer({}, tmp_path, logger,
+                                 source=MemoryTraceSource(raws))
+        report = analyzer.run()
+        assert report["failureClusters"] == []
+        assert report["runStats"]["signals"] > 0  # run completed
+        assert (tmp_path / "trace-analysis-report.json").exists()
+        assert any("clustering failed" in m
+                   for lvl, m in logger.records if lvl == "error")
+
+    def test_report_carries_clusters(self, tmp_path):
+        """End to end: an analyzer run over clustered failures publishes
+        failureClusters in the report."""
+        from vainplex_openclaw_tpu.core.api import list_logger as ll
+        from vainplex_openclaw_tpu.cortex.trace_analyzer import TraceAnalyzer
+
+        raws = []
+        for session in ("s1", "s2"):
+            f = EventFactory(session=session)
+            for _ in range(3):
+                raws += f.failing_call("exec", {"command": "kubectl apply -f app.yaml"},
+                                       "connection refused to apiserver")
+        analyzer = TraceAnalyzer({}, tmp_path, ll(),
+                                 source=MemoryTraceSource(raws))
+        report = analyzer.run()
+        assert report["failureClusters"]
+        assert report["failureClusters"][0]["size"] >= 2
 
 
 # ── SIG-REPEAT-FAIL ──────────────────────────────────────────────────

@@ -15,6 +15,7 @@ ops/similarity.py for large windows.
 
 from .analyzer import TraceAnalyzer
 from .chains import ConversationChain, reconstruct_chains
+from .clusters import cluster_failure_signals
 from .events import NormalizedEvent, detect_schema, map_event_type, normalize_event
 from .signals import FailureSignal, detect_all_signals
 from .source import MemoryTraceSource, TransportTraceSource, create_nats_trace_source
@@ -26,6 +27,7 @@ __all__ = [
     "NormalizedEvent",
     "TraceAnalyzer",
     "TransportTraceSource",
+    "cluster_failure_signals",
     "create_nats_trace_source",
     "detect_all_signals",
     "detect_schema",

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 from ...core.api import PluginCommand, PluginService
 from .chains import reconstruct_chains
 from .classifier import classify_findings
+from .clusters import cluster_failure_signals
 from .outputs import generate_outputs
 from .report import ProcessingState, assemble_report, rule_effectiveness, save_report
 from .signal_patterns import compile_signal_patterns
@@ -106,6 +107,15 @@ class TraceAnalyzer:
                 classified = [ClassifiedFinding(s, True, s.severity) for s in signals]
 
             outputs = generate_outputs(classified)
+            # Clustering is an optional enrichment stage: like the per-
+            # detector try/catch, it must never cost the run its report.
+            cluster_stats: dict = {}
+            try:
+                clusters = cluster_failure_signals(signals, logger=self.logger,
+                                                   stats=cluster_stats)
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(f"failure clustering failed: {exc}")
+                clusters, cluster_stats = [], {}
 
             signal_counts: dict = {}
             for s in signals:
@@ -121,7 +131,8 @@ class TraceAnalyzer:
                 "incrementalFromSeq": state.last_processed_seq,
             }
             report = assemble_report(run_stats, signals, classified, outputs,
-                                     effectiveness, self.clock)
+                                     effectiveness, self.clock, clusters=clusters,
+                                     clusters_truncated=cluster_stats.get("truncated", 0))
             save_report(report, self.state_dir)
 
             if events:
@@ -172,6 +183,10 @@ def _summary_text(report: dict) -> str:
              f"({rs['eventsPerMinute']:.0f} ev/min)"]
     for signal, stats in report["signalStats"].items():
         lines.append(f"  {signal}: {stats['count']}")
+    for cluster in report.get("failureClusters", [])[:3]:
+        lines.append(f"  ≈ cluster ×{cluster['size']} across "
+                     f"{len(cluster['chains'])} chains "
+                     f"[{', '.join(cluster['tools'])}]: {cluster['sample'][:80]}")
     for output in report["outputs"][:5]:
         lines.append(f"  → [{output['actionType']}] {output['actionText'][:80]} "
                      f"(×{output['observations']})")

@@ -1,0 +1,130 @@
+"""Cross-chain failure clustering — the all-pairs similarity stage.
+
+``failure_signature`` (signals.py) catches *exact* recurrences: same tool,
+same digit-normalized error text. Real fleets fail fuzzier than that — the
+same root cause surfaces with different paths, hosts, or phrasing across
+chains. This stage groups tool-failure signals whose token sets are *near*
+duplicates, so the report can say "these 14 signals across 9 chains are one
+problem" instead of listing them 14 times.
+
+This is the production all-pairs workload: for N signals the pairwise
+Jaccard matrix is one ``X @ X.T`` on the MXU via ``ops.similarity
+.jaccard_matrix`` (hashed multi-hot features), not N²/2 Python set
+intersections. Consecutive-pair similarity inside one window stays scalar/
+batched-DP in signals.py; *this* is where the matmul kernel earns its keep.
+
+No reference counterpart: the reference's trace analyzer stops at exact
+signatures (doom-loop.ts / report.ts); clustering is an original extension
+enabled by having a cheap all-pairs kernel.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import TYPE_CHECKING, Optional
+
+import numpy as np
+
+from ...ops.similarity import jaccard_matrix
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .signals import FailureSignal
+
+# Signals need to share about half their (tool ∪ error-token) feature set to
+# merge — loose enough to bridge paraphrase, tight enough that "permission
+# denied" and "disk full" stay apart.
+CLUSTER_THRESHOLD = 0.5
+# O(N²) matrix: cap the signal count per run; the analyzer surfaces the
+# dropped count in the report (failureClustersTruncated) via ``stats``.
+MAX_CLUSTER_SIGNALS = 512
+_TOKEN_RE = re.compile(r"[^\W\d_]{2,}", re.UNICODE)
+_MAX_TOKENS = 48
+
+
+def signal_features(sig: "FailureSignal") -> dict:
+    """Feature dict for one signal: tool name + digit-normalized unique
+    tokens of the EVIDENCE (the captured error/claim text). The summary is
+    deliberately excluded — its detector template words ("consecutive
+    similar failing calls of …") are shared by every signal of a type and
+    would merge unrelated failures. Shaped as a param-dict so
+    ``jaccard_matrix`` can hash it exactly like tool params (key=value
+    multi-hot)."""
+    text = " ".join(str(e) for e in (sig.evidence or []))
+    norm = re.sub(r"\d+", "N", text.lower())
+    tokens = sorted(set(_TOKEN_RE.findall(norm)))[:_MAX_TOKENS]
+    feats = {f"tok:{t}": 1 for t in tokens}
+    feats["tool"] = (sig.extra or {}).get("tool_name") or ""
+    return feats
+
+
+class _UnionFind:
+    def __init__(self, n: int):
+        self.parent = list(range(n))
+
+    def find(self, x: int) -> int:
+        while self.parent[x] != x:
+            self.parent[x] = self.parent[self.parent[x]]
+            x = self.parent[x]
+        return x
+
+    def union(self, a: int, b: int) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[rb] = ra
+
+
+def cluster_failure_signals(signals: list, threshold: float = CLUSTER_THRESHOLD,
+                            max_signals: int = MAX_CLUSTER_SIGNALS,
+                            logger=None, stats: Optional[dict] = None) -> list[dict]:
+    """Group tool-failure signals into near-duplicate clusters.
+
+    Returns report-ready dicts for every cluster of ≥ 2 signals, largest
+    first. Only signals carrying a ``tool_name`` participate (tool-fail,
+    doom-loop, hallucination, repeat-fail); conversational signals have no
+    comparable failure text. If ``stats`` is given it receives
+    ``candidates`` / ``truncated`` counts so callers can surface capping.
+    """
+    candidates = [s for s in signals if (s.extra or {}).get("tool_name")]
+    truncated = max(len(candidates) - max_signals, 0)
+    if stats is not None:
+        stats["candidates"] = len(candidates)
+        stats["truncated"] = truncated
+    if truncated:
+        if logger is not None:
+            logger.warn(f"failure clustering capped at {max_signals} of "
+                        f"{len(candidates)} signals")
+        candidates = candidates[:max_signals]
+    n = len(candidates)
+    if n < 2:
+        return []
+
+    sim = np.asarray(jaccard_matrix([signal_features(s) for s in candidates]))
+    uf = _UnionFind(n)
+    for i, j in np.argwhere(np.triu(sim >= threshold, 1)):
+        uf.union(int(i), int(j))
+
+    groups: dict[int, list[int]] = {}
+    for i in range(n):
+        groups.setdefault(uf.find(i), []).append(i)
+
+    clusters = []
+    for members in groups.values():
+        if len(members) < 2:
+            continue
+        sims = [float(sim[a, b]) for k, a in enumerate(members)
+                for b in members[k + 1:]]
+        sigs = [candidates[i] for i in members]
+        clusters.append({
+            "size": len(sigs),
+            "tools": sorted({(s.extra or {}).get("tool_name") or "" for s in sigs}),
+            "signals": sorted({s.signal for s in sigs}),
+            "chains": sorted({s.chain_id for s in sigs}),
+            "sessions": sorted({s.session for s in sigs}),
+            "severities": sorted({s.severity for s in sigs}),
+            "meanSimilarity": round(sum(sims) / len(sims), 3) if sims else 1.0,
+            "sample": (sigs[0].summary or "")[:160],
+            "firstTs": min(s.ts for s in sigs),
+            "lastTs": max(s.ts for s in sigs),
+        })
+    clusters.sort(key=lambda c: (-c["size"], c["firstTs"]))
+    return clusters

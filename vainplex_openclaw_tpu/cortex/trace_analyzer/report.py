@@ -64,7 +64,9 @@ def rule_effectiveness(state: ProcessingState, signal_counts: dict) -> list[dict
 
 def assemble_report(run_stats: dict, signals: list, classified: list,
                     outputs: list, effectiveness: list,
-                    clock: Callable[[], float] = time.time) -> dict:
+                    clock: Callable[[], float] = time.time,
+                    clusters: Optional[list] = None,
+                    clusters_truncated: int = 0) -> dict:
     by_signal: dict = {}
     for s in signals:
         entry = by_signal.setdefault(s.signal, {"count": 0, "severities": {}})
@@ -74,6 +76,8 @@ def assemble_report(run_stats: dict, signals: list, classified: list,
         "generatedAt": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(clock())),
         "runStats": run_stats,
         "signalStats": by_signal,
+        "failureClusters": clusters or [],
+        "failureClustersTruncated": clusters_truncated,
         "ruleEffectiveness": effectiveness,
         "findings": [c.to_dict() for c in classified],
         "outputs": [o.to_dict() for o in outputs],

@@ -227,9 +227,9 @@ def _consecutive_similarities(chain, attempts: list[dict]) -> "list | object":
     set computation: it is O(#params) — cheap — and the hashed
     jaccard_matrix approximation could flip a near-threshold verdict on
     bin collisions, breaking the batched ≡ scalar invariant
-    (tests/test_signals.py pins it). The matmul kernel remains the right
-    tool for true all-pairs workloads and stays covered by its parity
-    tests."""
+    (tests/test_signals.py pins it at the 31/32-pair gate boundary). The
+    matmul kernel's production workload is the true all-pairs one:
+    cross-chain failure clustering in clusters.py."""
     cached = getattr(chain, "_pair_sims", None)
     if cached is not None:
         return cached
