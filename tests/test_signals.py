@@ -523,6 +523,23 @@ class TestFailureClustering:
         assert len(sigs) == 2
         assert cluster_failure_signals(sigs) == []
 
+    def test_single_chain_fanout_not_a_cluster(self):
+        """One retry storm in ONE chain emits several signals (doom-loop +
+        tool-fails over the same evidence); that detector fan-out must not
+        masquerade as cross-chain recurrence (code-review r5)."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        f = EventFactory()
+        raws = []
+        for _ in range(3):
+            raws += f.failing_call("exec", {"command": "make build"},
+                                   "compile error: missing header")
+        chain = one_chain(raws)
+        sigs = (detect_doom_loops(chain, EN) + detect_tool_failures(chain, EN))
+        assert len(sigs) >= 2  # fan-out really happens
+        assert cluster_failure_signals(sigs) == []
+
     def test_fewer_than_two_signals_no_clusters(self):
         from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
             cluster_failure_signals)

@@ -91,6 +91,35 @@ class TestBenchPrefersCapture:
         rec = bench._freshest_capture()
         assert rec["ok"] and rec["encoder"]["mfu"] == 0.41
 
+    def test_fresh_capture_not_marked_stale(self):
+        import datetime
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        fresh = bench._capture_freshness(now.isoformat(timespec="seconds"), "log")
+        assert "stale" not in fresh
+        assert fresh["age_hours"] is not None and fresh["age_hours"] < 1
+
+    def test_old_capture_marked_stale(self):
+        import datetime
+
+        old = (datetime.datetime.now(datetime.timezone.utc) -
+               datetime.timedelta(hours=bench.STALE_CAPTURE_HOURS + 5))
+        fresh = bench._capture_freshness(old.isoformat(timespec="seconds"), "log")
+        assert fresh["stale"] is True
+        assert fresh["age_hours"] > bench.STALE_CAPTURE_HOURS
+
+    def test_unparseable_ts_conservatively_stale(self):
+        assert bench._capture_freshness("t2", "log")["stale"] is True
+        assert bench._capture_freshness(None, "log")["stale"] is True
+
+    def test_dense_infeasibility_structured(self):
+        rec = bench._dense_infeasibility(4, 8, 16384, "HTTP 500 remote compile blew up\n"
+                                         + "Traceback (most recent call last): ...")
+        assert rec["dense_infeasible"] is True
+        assert "Traceback" not in rec["dense_infeasible_reason"]
+        assert "32.0 GB" in rec["dense_infeasible_reason"]
+        assert rec["dense_error_kind"] == "remote_compile_error"
+
     def test_capture_errors_swallowed(self, monkeypatch):
         monkeypatch.setattr(tpu_capture, "freshest_success",
                             lambda *a, **k: (_ for _ in ()).throw(RuntimeError))
@@ -117,12 +146,38 @@ class TestSanityBounds:
         rec = bench.validate_throughput_record({"value": 7180.0, "mfu": None})
         assert "invalid" not in rec
 
-    def test_flash_sweep_decreasing_latency_flagged(self):
-        # The r03 fiction: flash *faster* at 16k than at 128.
-        recs = [{"metric": "flash_vs_dense", "seq_len": 128, "flash_ms": 0.047},
-                {"metric": "flash_vs_dense", "seq_len": 16384, "flash_ms": 0.021}]
+    def test_flash_sweep_decreasing_latency_flags_later_point(self):
+        # The r03 fiction: flash *faster* at 16k than at 128. Only the LATER
+        # point of a non-monotone pair is suspect (ADVICE r4): the earlier
+        # one was vetted against its own predecessor.
+        recs = [{"metric": "flash_vs_dense", "seq_len": 128, "flash_ms": 25.0},
+                {"metric": "flash_vs_dense", "seq_len": 16384, "flash_ms": 20.0}]
         out = bench.validate_flash_sweep(recs, peak=197e12)
-        assert all(r["invalid"] for r in out)
+        assert "invalid" not in out[0]
+        assert out[1]["invalid"] is True
+
+    def test_flash_sweep_flat_above_floor_flagged(self):
+        # 64x the work with zero latency growth, both points well above the
+        # dispatch floor — elision, even though nothing *decreased*.
+        recs = [{"metric": "flash_vs_dense", "seq_len": 2048, "flash_ms": 20.0},
+                {"metric": "flash_vs_dense", "seq_len": 16384, "flash_ms": 20.0}]
+        out = bench.validate_flash_sweep(recs, peak=197e12)
+        assert out[1]["invalid"] is True
+
+    def test_dense_infeasibility_oom_with_500_digits(self):
+        # '8500000000 bytes' must classify as oom, not remote_compile_error.
+        rec = bench._dense_infeasibility(
+            4, 8, 16384, "std::bad_alloc allocating 8500000000 bytes")
+        assert rec["dense_error_kind"] == "oom"
+
+    def test_flash_sweep_floor_jitter_not_flagged(self):
+        # ADVICE r4: at the ~6.7 ms dispatch floor latency is legitimately
+        # flat, so tiny inversions between floor-dominated points are
+        # jitter, not elision — neither record may be flagged.
+        recs = [{"metric": "flash_vs_dense", "seq_len": 512, "flash_ms": 6.808},
+                {"metric": "flash_vs_dense", "seq_len": 1024, "flash_ms": 6.695}]
+        out = bench.validate_flash_sweep(recs, peak=197e12)
+        assert not any(r.get("invalid") for r in out)
 
     def test_flash_sweep_super_peak_flagged(self):
         # 0.021 ms at L=16384 implies ~105 PFLOP/s on a 197 TFLOP/s chip.

@@ -105,10 +105,10 @@ def local_triage(findings: list[FailureSignal], min_severity: str = "medium",
     if loaded is None:
         import jax
 
-        from ...models import EncoderConfig, init_params
+        from ...models import EncoderConfig, cast_params, init_params
 
         cfg = EncoderConfig()
-        params = init_params(jax.random.PRNGKey(7), cfg)
+        params = cast_params(init_params(jax.random.PRNGKey(7), cfg), cfg.dtype)
     else:
         cfg, params = loaded
     texts = [f"{f.signal} {f.summary} {' '.join(map(str, f.evidence))}" for f in findings]

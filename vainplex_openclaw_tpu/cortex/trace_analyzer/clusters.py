@@ -84,7 +84,20 @@ def cluster_failure_signals(signals: list, threshold: float = CLUSTER_THRESHOLD,
     comparable failure text. If ``stats`` is given it receives
     ``candidates`` / ``truncated`` counts so callers can surface capping.
     """
-    candidates = [s for s in signals if (s.extra or {}).get("tool_name")]
+    # One incident emits several signals in ITS OWN chain (a doom loop also
+    # raises tool-fails over the same evidence); keep one representative per
+    # (chain, tool) so clusters measure cross-chain recurrence, not the
+    # detector fan-out of a single retry storm (code-review r5).
+    best: dict = {}
+    rank = {"critical": 4, "high": 3, "medium": 2, "low": 1, "info": 0}
+    for s in signals:
+        tool = (s.extra or {}).get("tool_name")
+        if not tool:
+            continue
+        key = (s.chain_id, tool)
+        if key not in best or rank.get(s.severity, 0) > rank.get(best[key].severity, 0):
+            best[key] = s
+    candidates = sorted(best.values(), key=lambda s: s.ts)
     truncated = max(len(candidates) - max_signals, 0)
     if stats is not None:
         stats["candidates"] = len(candidates)
@@ -111,9 +124,11 @@ def cluster_failure_signals(signals: list, threshold: float = CLUSTER_THRESHOLD,
     for members in groups.values():
         if len(members) < 2:
             continue
+        sigs = [candidates[i] for i in members]
+        if len({s.chain_id for s in sigs}) < 2:
+            continue  # recurrence means ACROSS chains, by definition
         sims = [float(sim[a, b]) for k, a in enumerate(members)
                 for b in members[k + 1:]]
-        sigs = [candidates[i] for i in members]
         clusters.append({
             "size": len(sigs),
             "tools": sorted({(s.extra or {}).get("tool_name") or "" for s in sigs}),

@@ -130,10 +130,11 @@ class LocalEmbeddings:
         if self._model is None:  # no shipped checkpoint anywhere
             import jax
 
-            from ..models import EncoderConfig, init_params
+            from ..models import EncoderConfig, cast_params, init_params
 
             cfg = EncoderConfig()
-            self._model = (cfg, init_params(jax.random.PRNGKey(self.seed), cfg))
+            self._model = (cfg, cast_params(init_params(jax.random.PRNGKey(self.seed), cfg),
+                                            cfg.dtype))
         cfg, params = self._model
         from ..models import encode_texts, forward
 

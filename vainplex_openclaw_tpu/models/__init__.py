@@ -1,7 +1,8 @@
 """Flagship numeric models backing the framework's analysis surfaces."""
 
-from .encoder import EncoderConfig, forward, init_params
+from .encoder import EncoderConfig, cast_params, forward, init_params, stack_blocks
 from .long_context import forward_long
 from .tokenizer import encode_texts
 
-__all__ = ["EncoderConfig", "encode_texts", "forward", "forward_long", "init_params"]
+__all__ = ["EncoderConfig", "cast_params", "encode_texts", "forward",
+           "forward_long", "init_params", "stack_blocks"]

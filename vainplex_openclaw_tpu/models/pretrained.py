@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
-from .encoder import EncoderConfig, init_params
+from .encoder import EncoderConfig, cast_params, init_params
 
 # Small enough that the f16 npz stays ~0.5 MB (committable), big enough to
 # drive held-out accuracy >0.95 on the triage corpus.
@@ -70,8 +70,11 @@ def available(ckpt_dir: Optional[str] = None) -> bool:
 def load_pretrained(ckpt_dir: Optional[str] = None):
     """(cfg, params) from the shipped checkpoint, or None when absent.
     Cached per directory — repeated triage/embedding calls pay the restore
-    once. Params are restored to fp32 (training dtype); forward casts to the
-    config's activation dtype as usual."""
+    once. This is the INFERENCE loader: the big matrices are cast to the
+    config's activation dtype (bf16) once here, so forwards read a half-
+    width weight tree from HBM instead of converting fp32 masters per step
+    (VERDICT r4 #3). Training paths restore via checkpoint.py directly and
+    keep fp32 masters."""
     d = os.path.abspath(ckpt_dir or DEFAULT_DIR)
     if d in _cache:
         return _cache[d]
@@ -82,7 +85,7 @@ def load_pretrained(ckpt_dir: Optional[str] = None):
         meta = json.load(f)
     cfg = _config_from_manifest(meta["config"])
     like = init_params(jax.random.PRNGKey(0), cfg)
-    params = restore_checkpoint(d, like=like)
+    params = cast_params(restore_checkpoint(d, like=like), cfg.dtype)
     _cache[d] = (cfg, params)
     return _cache[d]
 
