@@ -51,6 +51,37 @@ class TestFlashAttention:
                                        np.asarray(ref) * valid, atol=1e-5,
                                        err_msg=f"blocks ({bq},{bk})")
 
+    def test_return_stats_reconstructs_output(self, qkv):
+        """Stats mode emits (unnormalized fp32 acc, m, l); normalizing acc
+        by l must equal the standard output, and l must equal the true
+        softmax denominator."""
+        q, k, v, mask = qkv
+        acc, m, l = flash_attention(q, k, v, mask, block_q=16, block_k=16,
+                                    return_stats=True)
+        assert acc.dtype == jnp.float32 and m.shape == l.shape == q.shape[:3]
+        out = flash_attention(q, k, v, mask, block_q=16, block_k=16)
+        recon = acc / np.maximum(np.asarray(l), 1e-30)[..., None]
+        valid = np.asarray(mask)[:, None, :, None]
+        np.testing.assert_allclose(np.asarray(recon) * valid,
+                                   np.asarray(out) * valid, atol=1e-5)
+        # l against the dense log-sum-exp denominator
+        Dh = q.shape[-1]
+        scores = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) / np.sqrt(Dh)
+        scores = np.where(np.asarray(mask)[:, None, None, :], scores, -1e30)
+        mm = scores.max(-1)
+        ll = np.exp(scores - mm[..., None]).sum(-1)
+        np.testing.assert_allclose(np.asarray(l), ll, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m), mm, atol=1e-6)
+
+    def test_cross_length_kv(self, qkv):
+        """Lq != Lk (the ring's rotated-block shape when shards differ)."""
+        q, k, v, _ = qkv
+        q_half = q[:, :, :32]
+        out = flash_attention(q_half, k, v, block_q=16, block_k=16)
+        full = jnp.ones((q.shape[0], q.shape[2]), bool)
+        ref = dense_attention_reference(q_half, k, v, full)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
     def test_bf16_inputs(self, qkv):
         q, k, v, mask = qkv
         out = flash_attention(*(x.astype(jnp.bfloat16) for x in (q, k, v)), mask,

@@ -540,6 +540,38 @@ class TestFailureClustering:
         assert len(sigs) >= 2  # fan-out really happens
         assert cluster_failure_signals(sigs) == []
 
+    def test_distinct_failures_same_tool_same_chain_both_cluster(self):
+        """Dedupe keys on evidence, not just (chain, tool): a chain with TWO
+        different exec failures must still contribute its disk-full signal
+        to a cross-chain disk-full cluster (code-review r5 #2)."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            cluster_failure_signals)
+
+        # chain A: compile-error doom loop THEN a disk-full retry pair
+        fa = EventFactory(session="sA")
+        raws_a = []
+        for _ in range(3):
+            raws_a += fa.failing_call("exec", {"command": "make build"},
+                                      "compile error: missing header foo.h")
+        for _ in range(2):
+            raws_a += fa.failing_call("exec", {"command": "make build"},
+                                      "disk full writing /var/obj")
+        # chain B: only the disk-full failure
+        fb = EventFactory(session="sB")
+        raws_b = []
+        for _ in range(3):
+            raws_b += fb.failing_call("exec", {"command": "make build"},
+                                      "disk full writing /var/obj")
+        chains = reconstruct_chains(MemoryTraceSource(raws_a + raws_b).fetch())
+        sigs = []
+        for c in chains:
+            sigs += detect_doom_loops(c, EN) + detect_tool_failures(c, EN)
+        clusters = cluster_failure_signals(sigs)
+        disk = [c for c in clusters
+                if "disk full" in c["sample"] or len(c["chains"]) == 2]
+        assert disk, f"disk-full recurrence across sA+sB lost: {clusters}"
+        assert sorted(disk[0]["sessions"]) == ["sA", "sB"]
+
     def test_fewer_than_two_signals_no_clusters(self):
         from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
             cluster_failure_signals)

@@ -86,18 +86,23 @@ def cluster_failure_signals(signals: list, threshold: float = CLUSTER_THRESHOLD,
     """
     # One incident emits several signals in ITS OWN chain (a doom loop also
     # raises tool-fails over the same evidence); keep one representative per
-    # (chain, tool) so clusters measure cross-chain recurrence, not the
-    # detector fan-out of a single retry storm (code-review r5).
+    # (chain, tool, evidence-token-set) so clusters measure cross-chain
+    # recurrence, not detector fan-out — while DISTINCT failures of the
+    # same tool in one chain (different evidence) each stay in play
+    # (code-review r5 ×2).
     best: dict = {}
     rank = {"critical": 4, "high": 3, "medium": 2, "low": 1, "info": 0}
     for s in signals:
         tool = (s.extra or {}).get("tool_name")
         if not tool:
             continue
-        key = (s.chain_id, tool)
-        if key not in best or rank.get(s.severity, 0) > rank.get(best[key].severity, 0):
-            best[key] = s
-    candidates = sorted(best.values(), key=lambda s: s.ts)
+        feats = signal_features(s)
+        key = (s.chain_id, tool, frozenset(feats))
+        if key not in best or rank.get(s.severity, 0) > rank.get(best[key][0].severity, 0):
+            best[key] = (s, feats)
+    kept = sorted(best.values(), key=lambda sf: sf[0].ts)
+    candidates = [s for s, _ in kept]
+    feats_by_idx = [f for _, f in kept]
     truncated = max(len(candidates) - max_signals, 0)
     if stats is not None:
         stats["candidates"] = len(candidates)
@@ -107,11 +112,12 @@ def cluster_failure_signals(signals: list, threshold: float = CLUSTER_THRESHOLD,
             logger.warn(f"failure clustering capped at {max_signals} of "
                         f"{len(candidates)} signals")
         candidates = candidates[:max_signals]
+        feats_by_idx = feats_by_idx[:max_signals]
     n = len(candidates)
     if n < 2:
         return []
 
-    sim = np.asarray(jaccard_matrix([signal_features(s) for s in candidates]))
+    sim = np.asarray(jaccard_matrix(feats_by_idx))
     uf = _UnionFind(n)
     for i, j in np.argwhere(np.triu(sim >= threshold, 1)):
         uf.union(int(i), int(j))

@@ -61,7 +61,8 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
                 return (h @ w.astype(dt)).reshape(B, L_loc, H, Dh).transpose(0, 2, 1, 3)
 
             out = ring_attention_local(heads(a["q"]), heads(a["k"]), heads(a["v"]),
-                                       mask, axis_name=sp_axis)
+                                       mask, axis_name=sp_axis,
+                                       impl=cfg.attn_impl)
             out = out.transpose(0, 2, 1, 3).reshape(B, L_loc, cfg.d_model)
             x = x + out @ a["o"].astype(dt)
             h = _rmsnorm(x, p["norm2"]["scale"])

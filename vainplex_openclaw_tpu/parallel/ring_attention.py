@@ -36,18 +36,30 @@ def _rotate(x, axis_name: str, n: int):
 
 
 def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = False,
-                         scale: float | None = None):
+                         scale: float | None = None, impl: str = "auto"):
     """The per-device kernel; call inside shard_map/psum scope.
 
     q:       [B, H, Lq, Dh]  local query shard
     k, v:    [B, H, Lk, Dh]  local key/value shard (rotates around the ring)
     kv_mask: [B, Lk] bool    valid-key mask for the local shard (rotates too)
     Returns [B, H, Lq, Dh] in q.dtype.
+
+    ``impl``: how each per-rotation local block is computed. "flash" runs
+    the Pallas kernel in stats mode (ops/flash_attention.py) and merges its
+    online-softmax partials into the ring carry — rings rotate K/V *across*
+    chips, the kernel tiles *within* a chip, so at sp=8 over L=64k the
+    8k×8k local block never materialises. "dense" keeps the fused-XLA
+    score matrix (the parity oracle, and the CPU-mesh default). "auto"
+    picks flash on TPU. Causal or custom-scale calls always use dense: the
+    kernel's causal mask is block-local and its scale is 1/√Dh.
     """
     sp = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, H, Lq, Dh = q.shape
     Lk = k.shape[2]
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
+    use_flash = impl == "flash" and not causal and scale is None
     scale = scale if scale is not None else 1.0 / np.sqrt(Dh)
 
     m = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
@@ -55,7 +67,7 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
     acc = jnp.zeros((B, H, Lq, Dh), jnp.float32)
     q_pos = my_idx * Lq + jnp.arange(Lq)
 
-    def attend(carry, k, v, kv_mask, i):
+    def attend_dense(carry, k, v, kv_mask, i):
         m, l, acc = carry
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
         keep = kv_mask[:, None, None, :]
@@ -74,6 +86,35 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
             "bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
         return m_new, l, acc
 
+    def attend_flash(carry, k, v, kv_mask, i):
+        from ..ops.flash_attention import default_block, flash_attention
+
+        m, l, acc = carry
+        # Shard lengths without an MXU-aligned block divisor are padded up
+        # to a 128 multiple, exactly like encoder._attention: padded keys
+        # are masked out via kv_mask, padded query rows sliced away.
+        pad_q = ((-Lq) % 128) if default_block(Lq) is None else 0
+        pad_k = ((-Lk) % 128) if default_block(Lk) is None else 0
+        qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+        kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+        vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+        km = jnp.pad(kv_mask, ((0, 0), (0, pad_k))) if pad_k else kv_mask
+        # Tiled local block; the kernel returns its UNNORMALIZED fp32
+        # accumulator + softmax partials, so the cross-rotation merge is
+        # pure fp32 — numerically the same online softmax the dense path
+        # runs, just tiled within the chip.
+        acc_i, m_i, l_i = flash_attention(qq, kk, vv, km, return_stats=True)
+        if pad_q:
+            acc_i, m_i, l_i = (acc_i[:, :, :Lq], m_i[:, :, :Lq], l_i[:, :, :Lq])
+        m_new = jnp.maximum(m, m_i)
+        corr = jnp.exp(m - m_new)
+        corr_i = jnp.exp(m_i - m_new)
+        l = l * corr + l_i * corr_i
+        acc = acc * corr[..., None] + acc_i * corr_i[..., None]
+        return m_new, l, acc
+
+    attend = attend_flash if use_flash else attend_dense
+
     def body(i, carry):
         # Rotate at the top so the loop runs sp-1 rotations total; the local
         # block was consumed before the loop, and the last block processed
@@ -91,9 +132,12 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
 
 
 def ring_attention(q, k, v, kv_mask, mesh: Mesh, *, dp_axis: str = "dp",
-                   sp_axis: str = "sp", causal: bool = False):
+                   sp_axis: str = "sp", causal: bool = False,
+                   impl: str = "auto"):
     """Sharded exact attention: q/k/v [B, H, L, Dh] sharded (dp, -, sp, -),
-    kv_mask [B, L] sharded (dp, sp). Returns out with q's sharding."""
+    kv_mask [B, L] sharded (dp, sp). Returns out with q's sharding.
+    ``impl`` selects the per-rotation block kernel (see
+    ``ring_attention_local``): flash-tiled on TPU, dense-XLA elsewhere."""
     qkv_spec = P(dp_axis, None, sp_axis, None)
     mask_spec = P(dp_axis, sp_axis)
 
@@ -102,7 +146,7 @@ def ring_attention(q, k, v, kv_mask, mesh: Mesh, *, dp_axis: str = "dp",
              out_specs=qkv_spec, check_vma=False)
     def run(q, k, v, kv_mask):
         return ring_attention_local(q, k, v, kv_mask, axis_name=sp_axis,
-                                    causal=causal)
+                                    causal=causal, impl=impl)
 
     return run(q, k, v, kv_mask)
 
