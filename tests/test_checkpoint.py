@@ -164,6 +164,18 @@ class TestDataPipeline:
         assert total == 30
         assert all(b["tokens"].shape == (8, 32) for b, _ in data.eval_batches())
 
+    def test_synthetic_split_noun_disjoint(self):
+        """No eval text may appear in training, and eval nouns must be
+        absent from every training text (ADVICE r4)."""
+        from vainplex_openclaw_tpu.models.data import _EVAL_NOUNS, _NOUNS, synthetic_split
+
+        train, evals = synthetic_split(400, 100, seed=0)
+        train_texts = {t for t, _ in train}
+        assert not train_texts & {t for t, _ in evals}
+        for noun in _NOUNS[-_EVAL_NOUNS:]:
+            assert not any(noun in t for t in train_texts), noun
+        assert all(lab["severity"] in range(4) for _, lab in evals)
+
     def test_synthetic_examples_deterministic_and_labelled(self):
         a, b = synthetic_examples(20, seed=5), synthetic_examples(20, seed=5)
         assert a == b

@@ -82,7 +82,8 @@ class TestTriageQuality:
             meta = json.load(f)
         assert meta["eval"]["keep_accuracy"] >= 0.9
         assert meta["eval"]["severity_accuracy"] >= 0.9
-        assert "synthetic_examples" in meta["provenance"]["corpus"]
+        assert "synthetic_split" in meta["provenance"]["corpus"]
+        assert "noun-disjoint" in meta["provenance"]["heldout_protocol"]
 
 
 class TestProductionWiring:

@@ -155,21 +155,11 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
         # compile at all — so auto stays flash on TPU at every length.
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
     if impl == "flash":
-        from ..ops.flash_attention import default_block, flash_attention
+        from ..ops.flash_attention import flash_attention
 
-        # Pallas blocks must divide L and keep the sublane dimension a
-        # multiple of 8 for MXU/VPU alignment; default_block picks the
-        # measured-optimal size. When no aligned divisor exists, pad L up
-        # to a block multiple — padded keys are excluded via the kv mask,
-        # padded query rows are sliced away.
-        if default_block(L) is not None:
-            out = flash_attention(q, k, v, mask)
-        else:
-            pad = (-L) % 128
-            qp, kp, vp = (jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0)))
-                          for t in (q, k, v))
-            maskp = jnp.pad(mask, ((0, 0), (0, pad)))
-            out = flash_attention(qp, kp, vp, maskp)[:, :, :L]
+        # The kernel pads unaligned lengths internally (padded keys masked,
+        # padded query rows sliced) and picks measured-optimal blocks.
+        out = flash_attention(q, k, v, mask)
     else:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
         scores = jnp.where(mask[:, None, None, :], scores, -1e30)

@@ -101,17 +101,21 @@ def train_and_ship(out_dir: Optional[str] = None, total_steps: int = 600,
     held-out split AFTER the float16 ship round-trip (what users load is
     what was measured), and write the committable checkpoint. Returns the
     eval metrics dict that also lands in config.json."""
-    from .data import TextClassificationData, synthetic_examples
+    from .data import TextClassificationData, synthetic_split
     from .train import evaluate, init_state, make_optimizer, train_loop
 
     out_dir = out_dir or DEFAULT_DIR
     cfg = TINY_CONFIG
-    examples = synthetic_examples(n_examples, seed=seed)
     n_eval = max(batch_size, n_examples // 9)
-    train_data = TextClassificationData(examples[:-n_eval], batch_size,
+    # Noun-disjoint split (ADVICE r4): eval texts use nouns absent from
+    # every training example, so the recorded metric is generalization over
+    # surface variation, not exact-text recall.
+    train_examples, eval_examples = synthetic_split(n_examples - n_eval,
+                                                    n_eval, seed=seed)
+    train_data = TextClassificationData(train_examples, batch_size,
                                         seq_len=cfg.seq_len,
                                         vocab_size=cfg.vocab_size, seed=seed)
-    heldout = TextClassificationData(examples[-n_eval:], batch_size,
+    heldout = TextClassificationData(eval_examples, batch_size,
                                      seq_len=cfg.seq_len,
                                      vocab_size=cfg.vocab_size, seed=seed)
 
@@ -135,8 +139,12 @@ def train_and_ship(out_dir: Optional[str] = None, total_steps: int = 600,
         "config": _config_to_manifest(cfg),
         "eval": {k: float(v) for k, v in metrics.items()},
         "provenance": {
-            "corpus": f"synthetic_examples(n={n_examples}, seed={seed})",
-            "heldout": n_eval, "total_steps": total_steps,
+            "corpus": f"synthetic_split(n_train={n_examples - n_eval}, "
+                      f"n_eval={n_eval}, seed={seed})",
+            "heldout": n_eval,
+            "heldout_protocol": "noun-disjoint: eval nouns never appear in "
+                                "any training text (same 16 templates)",
+            "total_steps": total_steps,
             "batch_size": batch_size,
             "trained_by": "models/pretrained.py:train_and_ship",
         },

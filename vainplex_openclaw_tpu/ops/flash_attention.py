@@ -112,45 +112,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, *refs,
             o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret", "return_stats"))
-def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
-                    block_q: "int | None" = None, block_k: "int | None" = None,
-                    interpret: bool | None = None, return_stats: bool = False):
-    """q: [B, H, Lq, Dh]; k/v: [B, H, Lk, Dh]; kv_mask: optional [B, Lk]
-    bool. Returns [B, H, Lq, Dh] — or, with ``return_stats``, the tuple
-    ``(acc, m, l)``: the UNNORMALIZED fp32 accumulator plus the online-
-    softmax running max and (unclamped) sum per query ([B, H, Lq]). The
-    normalized output is ``acc / max(l, eps)[..., None]``; ring attention
-    merges the raw partials across KV rotations instead
-    (parallel/ring_attention.py).
-
-    block_q/block_k default to the measured-optimal ``default_block(L)``
-    (VERDICT r3 #3 — the round-3 fixed 128² default left 3-8× on the table
-    at long L). Lq/Lk must be divisible by their blocks (callers pad;
-    padding is excluded via kv_mask). ``causal`` requires Lq == Lk (global
-    positions are block-local). interpret=None auto-selects the Pallas
-    interpreter off-TPU.
-    """
+def _pallas_flash(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
+                  interpret: bool, return_stats: bool):
+    """Raw pallas_call over pre-padded [B, H, L, Dh] inputs."""
     B, H, Lq, Dh = q.shape
     Lk = k.shape[2]
-    if causal and Lq != Lk:
-        raise ValueError("causal flash attention requires Lq == Lk")
-    block_q = min(block_q or default_block(Lq) or 128, Lq)
-    block_k = min(block_k or default_block(Lk) or 128, Lk)
-    if Lq % block_q or Lk % block_k:
-        raise ValueError(f"Lq={Lq}/Lk={Lk} not divisible by blocks "
-                         f"({block_q},{block_k})")
-    if interpret is None:
-        # "axon" = the image's TPU-tunnel platform (real TPU, real Mosaic
-        # compile via PALLAS_AXON_REMOTE_COMPILE); only interpret elsewhere.
-        interpret = jax.default_backend() not in ("tpu", "axon")
-
-    if kv_mask is None:
-        bias = jnp.zeros((B, 1, Lk), jnp.float32)
-    else:
-        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)[:, None, :]
-
     qf = q.reshape(B * H, Lq, Dh)
     kf = k.reshape(B * H, Lk, Dh)
     vf = v.reshape(B * H, Lk, Dh)
@@ -193,3 +159,140 @@ def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
     out, m3, l3 = result
     return (out.reshape(B, H, Lq, Dh),
             m3[:, :, 0].reshape(B, H, Lq), l3[:, :, 0].reshape(B, H, Lq))
+
+
+def _dense_stats_ref(q, k, v, bias, causal: bool):
+    """Dense fp32 (acc, m, l) — the same online-softmax quantities the
+    kernel computes, expressed in plain XLA ops. This is the backward-pass
+    reference for the custom VJP: the Pallas kernel has no autodiff rule,
+    so gradients recompute the block densely (correct everywhere; a tiled
+    backward kernel is the remaining optimization)."""
+    Dh = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
+    scores = scores + bias[:, :, None, :].astype(jnp.float32)
+    if causal:
+        Lq, Lk = q.shape[2], k.shape[2]
+        pos_q = jnp.arange(Lq)
+        pos_k = jnp.arange(Lk)
+        scores = jnp.where((pos_q[:, None] >= pos_k[None, :])[None, None],
+                           scores, NEG_INF)
+    m = scores.max(axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return acc, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_norm(causal, block_q, block_k, interpret, q, k, v, bias):
+    return _pallas_flash(q, k, v, bias, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret,
+                         return_stats=False)
+
+
+def _flash_norm_fwd(causal, block_q, block_k, interpret, q, k, v, bias):
+    out = _pallas_flash(q, k, v, bias, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret,
+                        return_stats=False)
+    return out, (q, k, v, bias)
+
+
+def _flash_norm_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias = res
+
+    def dense_norm(q, k, v):
+        acc, m, l = _dense_stats_ref(q, k, v, bias, causal)
+        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+    _, vjp = jax.vjp(dense_norm, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_norm.defvjp(_flash_norm_fwd, _flash_norm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash_stats(causal, block_q, block_k, interpret, q, k, v, bias):
+    return _pallas_flash(q, k, v, bias, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret,
+                         return_stats=True)
+
+
+def _flash_stats_fwd(causal, block_q, block_k, interpret, q, k, v, bias):
+    out = _pallas_flash(q, k, v, bias, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret,
+                        return_stats=True)
+    return out, (q, k, v, bias)
+
+
+def _flash_stats_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense_stats_ref(q, k, v, bias, causal),
+                     q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_stats.defvjp(_flash_stats_fwd, _flash_stats_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret", "return_stats"))
+def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
+                    block_q: "int | None" = None, block_k: "int | None" = None,
+                    interpret: bool | None = None, return_stats: bool = False):
+    """q: [B, H, Lq, Dh]; k/v: [B, H, Lk, Dh]; kv_mask: optional [B, Lk]
+    bool. Returns [B, H, Lq, Dh] — or, with ``return_stats``, the tuple
+    ``(acc, m, l)``: the UNNORMALIZED fp32 accumulator plus the online-
+    softmax running max and (unclamped) sum per query ([B, H, Lq]). The
+    normalized output is ``acc / max(l, eps)[..., None]``; ring attention
+    merges the raw partials across KV rotations instead
+    (parallel/ring_attention.py).
+
+    block_q/block_k default to the measured-optimal ``default_block(L)``
+    (VERDICT r3 #3 — the round-3 fixed 128² default left 3-8× on the table
+    at long L). Lengths without an aligned block divisor are padded to a
+    block multiple internally (padded keys masked out, padded query rows
+    sliced away) — callers never pad. ``causal`` requires Lq == Lk (global
+    positions are block-local). interpret=None auto-selects the Pallas
+    interpreter off-TPU.
+
+    Differentiable: the forward runs the Pallas kernel; the backward is a
+    custom VJP that recomputes the block densely (O(Lq·Lk) memory during
+    grad only — a tiled backward kernel is future work). Training through
+    ``forward``/``forward_long`` on TPU therefore works (code-review r5).
+    """
+    B, H, Lq, Dh = q.shape
+    Lk = k.shape[2]
+    if causal and Lq != Lk:
+        raise ValueError("causal flash attention requires Lq == Lk")
+    block_q = min(block_q or default_block(Lq) or 128, max(Lq, 8))
+    block_k = min(block_k or default_block(Lk) or 128, max(Lk, 8))
+    pad_q = (-Lq) % block_q
+    pad_k = (-Lk) % block_k
+    if interpret is None:
+        # "axon" = the image's TPU-tunnel platform (real TPU, real Mosaic
+        # compile via PALLAS_AXON_REMOTE_COMPILE); only interpret elsewhere.
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    if kv_mask is None:
+        bias = jnp.zeros((B, 1, Lk), jnp.float32)
+    else:
+        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)[:, None, :]
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_k)),
+                       constant_values=NEG_INF)
+
+    if return_stats:
+        acc, m, l = _flash_stats(causal, block_q, block_k, interpret,
+                                 q, k, v, bias)
+        if pad_q:
+            acc, m, l = acc[:, :, :Lq], m[:, :, :Lq], l[:, :, :Lq]
+        return acc, m, l
+    out = _flash_norm(causal, block_q, block_k, interpret, q, k, v, bias)
+    return out[:, :, :Lq] if pad_q else out

@@ -87,25 +87,15 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
         return m_new, l, acc
 
     def attend_flash(carry, k, v, kv_mask, i):
-        from ..ops.flash_attention import default_block, flash_attention
+        from ..ops.flash_attention import flash_attention
 
         m, l, acc = carry
-        # Shard lengths without an MXU-aligned block divisor are padded up
-        # to a 128 multiple, exactly like encoder._attention: padded keys
-        # are masked out via kv_mask, padded query rows sliced away.
-        pad_q = ((-Lq) % 128) if default_block(Lq) is None else 0
-        pad_k = ((-Lk) % 128) if default_block(Lk) is None else 0
-        qq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
-        kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
-        vv = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
-        km = jnp.pad(kv_mask, ((0, 0), (0, pad_k))) if pad_k else kv_mask
         # Tiled local block; the kernel returns its UNNORMALIZED fp32
         # accumulator + softmax partials, so the cross-rotation merge is
         # pure fp32 — numerically the same online softmax the dense path
-        # runs, just tiled within the chip.
-        acc_i, m_i, l_i = flash_attention(qq, kk, vv, km, return_stats=True)
-        if pad_q:
-            acc_i, m_i, l_i = (acc_i[:, :, :Lq], m_i[:, :, :Lq], l_i[:, :, :Lq])
+        # runs, just tiled within the chip. Unaligned shard lengths are
+        # padded inside the kernel wrapper.
+        acc_i, m_i, l_i = flash_attention(q, k, v, kv_mask, return_stats=True)
         m_new = jnp.maximum(m, m_i)
         corr = jnp.exp(m - m_new)
         corr_i = jnp.exp(m_i - m_new)
