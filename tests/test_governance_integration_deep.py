@@ -1,0 +1,446 @@
+"""Full-engine integration depth: the reference's biggest governance suite
+ported scenario-by-scenario at the GovernanceEngine level — custom policies,
+deny-wins, per-rule trust gates, builtins under a controlled clock, fail
+modes, cross-agent inheritance/ceiling, performance budgets, and the output
+validation pipeline end to end
+(reference: governance/test/integration.test.ts, 712 LoC; VERDICT r4 #5).
+
+Unlike test_governance_engine.py (which drives the gateway/plugin harness),
+these tests construct GovernanceEngine directly against a real filesystem
+workspace, mirroring the reference's engine-level style.
+"""
+
+import time
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.governance.engine import GovernanceEngine
+from vainplex_openclaw_tpu.governance.validation import (
+    FactRegistry,
+    LlmValidator,
+    OutputValidator,
+)
+
+from helpers import FakeClock
+
+# Anchor clocks at explicit UTC hours: epoch + h*3600 is 1970-01-01 h:00 UTC.
+def day_clock(hour=12):
+    return FakeClock(hour * 3600.0)
+
+
+def make_engine(workspace, config=None, clock=None):
+    cfg = {
+        "enabled": True,
+        "failMode": "open",
+        "builtinPolicies": {},
+        "timezone": "utc",
+        "trust": {"enabled": True, "defaults": {"main": 60, "forge": 60, "*": 10}},
+        "sessionTrust": {"enabled": False},  # session tier ≡ agent tier
+        **(config or {}),
+    }
+    engine = GovernanceEngine(cfg, str(workspace), list_logger(),
+                              clock=clock or day_clock())
+    engine.start()
+    return engine
+
+
+def ctx_for(engine, tool="exec", params=None, agent="main", session=None,
+            channel=None, message=None):
+    return engine.build_context(
+        "before_tool_call", agent, session or f"agent:{agent}",
+        tool_name=tool, tool_params=params if params is not None else {"command": "ls -la"},
+        message_content=message, channel=channel)
+
+
+def deny_policy(id="block-docker", contains="docker rm", reason="Docker rm is restricted",
+                scope=None, **rule_kw):
+    return {
+        "id": id, "name": id, "version": "1.0.0", "scope": scope or {},
+        "rules": [{
+            "id": "r1",
+            "conditions": [{"type": "tool", "name": "exec",
+                            "params": {"command": {"contains": contains}}}],
+            "effect": {"action": "deny", "reason": reason},
+            **rule_kw,
+        }],
+    }
+
+
+class TestEvaluatePipeline:
+    def test_deny_matching_custom_policy(self, workspace):
+        engine = make_engine(workspace, {"policies": [deny_policy()]})
+        verdict = engine.evaluate(
+            ctx_for(engine, params={"command": "docker rm container-x"}))
+        assert verdict.action == "deny"
+        assert "Docker rm" in verdict.reason
+        assert len(verdict.matched_policies) >= 1
+        engine.stop()
+
+    def test_allow_when_no_policies_match(self, workspace):
+        engine = make_engine(workspace)
+        verdict = engine.evaluate(ctx_for(engine, tool="read", params={}))
+        assert verdict.action == "allow"
+        engine.stop()
+
+    def test_deny_wins_across_multiple_policies(self, workspace):
+        allow_p = {"id": "allow-exec", "name": "Allow Exec", "version": "1.0.0",
+                   "scope": {}, "rules": [{
+                       "id": "r1", "conditions": [{"type": "tool", "name": "exec"}],
+                       "effect": {"action": "allow"}}]}
+        deny_p = {"id": "deny-exec", "name": "Deny Exec", "version": "1.0.0",
+                  "scope": {}, "rules": [{
+                      "id": "r1", "conditions": [{"type": "tool", "name": "exec"}],
+                      "effect": {"action": "deny", "reason": "Denied"}}]}
+        engine = make_engine(workspace, {"policies": [allow_p, deny_p]})
+        assert engine.evaluate(ctx_for(engine)).action == "deny"
+        engine.stop()
+
+    def test_min_trust_gate_on_rules(self, workspace):
+        policy = deny_policy(contains="", reason="Must be trusted", minTrust="trusted")
+        engine = make_engine(workspace, {
+            "policies": [policy],
+            "trust": {"enabled": True, "defaults": {"low": 45, "high": 80, "*": 10}}})
+        # standard-tier agent (45) — rule gated out
+        assert engine.evaluate(ctx_for(engine, agent="low")).action == "allow"
+        # elevated-tier agent (80) — rule applies
+        v = engine.evaluate(ctx_for(engine, agent="high"))
+        assert v.action == "deny" and "Must be trusted" in v.reason
+        engine.stop()
+
+    def test_verdict_carries_risk_and_timing(self, workspace):
+        engine = make_engine(workspace)
+        verdict = engine.evaluate(ctx_for(engine))
+        assert verdict.risk is not None and verdict.risk.level in (
+            "low", "medium", "high", "critical")
+        assert verdict.evaluation_us > 0
+        assert verdict.trust["tier"] == "trusted"  # main seeded at 60
+        engine.stop()
+
+    def test_matched_policy_surfaces_controls(self, workspace):
+        policy = dict(deny_policy(), controls=["A.8.11", "SOC2-CC6.1"])
+        engine = make_engine(workspace, {"policies": [policy]})
+        verdict = engine.evaluate(
+            ctx_for(engine, params={"command": "docker rm x"}))
+        assert verdict.matched_policies[0].controls == ["A.8.11", "SOC2-CC6.1"]
+        engine.stop()
+
+
+class TestBuiltinsUnderClock:
+    def test_night_mode_denies_exec_allows_read(self, workspace):
+        cfg = {"builtinPolicies": {"nightMode": {"after": "23:00", "before": "08:00"}}}
+        night = make_engine(workspace, cfg, clock=day_clock(hour=2))
+        assert night.evaluate(ctx_for(night)).action == "deny"
+        assert night.evaluate(ctx_for(night, tool="read", params={})).action == "allow"
+        night.stop()
+
+        day = make_engine(workspace, cfg, clock=day_clock(hour=12))
+        assert day.evaluate(ctx_for(day)).action == "allow"
+        day.stop()
+
+    def test_night_mode_denial_does_not_poison_trust(self, workspace):
+        cfg = {"builtinPolicies": {"nightMode": True}}
+        engine = make_engine(workspace, cfg, clock=day_clock(hour=2))
+        engine.evaluate(ctx_for(engine))
+        signals = engine.trust_manager.get_agent_trust("main")["signals"]
+        assert signals["violationCount"] == 0
+        engine.stop()
+
+    def test_custom_denial_records_violation(self, workspace):
+        engine = make_engine(workspace, {"policies": [deny_policy()]})
+        engine.evaluate(ctx_for(engine, params={"command": "docker rm y"}))
+        signals = engine.trust_manager.get_agent_trust("main")["signals"]
+        assert signals["violationCount"] == 1
+        engine.stop()
+
+
+class TestFailModes:
+    def test_internal_error_fails_open(self, workspace):
+        engine = make_engine(workspace, {"failMode": "open"})
+        engine.risk_assessor.assess = lambda *a: 1 / 0
+        verdict = engine.evaluate(ctx_for(engine))
+        assert verdict.action == "allow"
+        assert "open-fail" in verdict.reason
+        engine.stop()
+
+    def test_internal_error_fails_closed(self, workspace):
+        engine = make_engine(workspace, {"failMode": "closed"})
+        engine.risk_assessor.assess = lambda *a: 1 / 0
+        verdict = engine.evaluate(ctx_for(engine))
+        assert verdict.action == "deny"
+        assert "closed-fail" in verdict.reason
+        engine.stop()
+
+    def test_error_verdict_not_counted_in_stats(self, workspace):
+        engine = make_engine(workspace)
+        engine.risk_assessor.assess = lambda *a: 1 / 0
+        engine.evaluate(ctx_for(engine))
+        assert engine.stats.total_evaluations == 0
+        engine.stop()
+
+
+class TestCrossAgent:
+    CHILD = "agent:main:subagent:forge:abc"
+
+    def test_child_inherits_parent_deny_policy(self, workspace):
+        policy = deny_policy(id="main-no-deploy", contains="deploy",
+                             reason="No deploy allowed", scope={"agents": ["main"]})
+        engine = make_engine(workspace, {"policies": [policy]})
+        engine.register_sub_agent("agent:main", self.CHILD)
+        verdict = engine.evaluate(
+            ctx_for(engine, agent="forge", session=self.CHILD,
+                    params={"command": "deploy production"}))
+        assert verdict.action == "deny" and "No deploy" in verdict.reason
+        engine.stop()
+
+    def test_child_trust_capped_at_parent(self, workspace):
+        engine = make_engine(workspace, {
+            "trust": {"enabled": True, "defaults": {"main": 60, "forge": 80, "*": 10}},
+            "sessionTrust": {"enabled": True}})
+        engine.register_sub_agent("agent:main", self.CHILD)
+        verdict = engine.evaluate(
+            ctx_for(engine, agent="forge", session=self.CHILD))
+        assert verdict.trust["score"] <= 60
+        engine.stop()
+
+    def test_unrelated_agent_not_affected_by_parent_policy(self, workspace):
+        policy = deny_policy(id="main-only", contains="", scope={"agents": ["main"]})
+        engine = make_engine(workspace, {"policies": [policy]})
+        verdict = engine.evaluate(
+            ctx_for(engine, agent="viola", session="agent:viola"))
+        assert verdict.action == "allow"
+        engine.stop()
+
+
+class TestAuditIntegration:
+    def test_denials_land_in_audit_trail(self, workspace):
+        engine = make_engine(workspace, {"policies": [deny_policy()]})
+        engine.evaluate(ctx_for(engine, params={"command": "docker rm z"}))
+        engine.audit_trail.flush()
+        recs = engine.audit_trail.query(verdict="deny")
+        assert len(recs) == 1
+        assert recs[0]["context"]["toolParams"]["command"] == "docker rm z"
+        engine.stop()
+
+    def test_audit_disabled_no_records(self, workspace):
+        engine = make_engine(workspace, {"audit": {"enabled": False},
+                                         "policies": [deny_policy()]})
+        engine.evaluate(ctx_for(engine, params={"command": "docker rm z"}))
+        engine.audit_trail.flush()
+        assert engine.audit_trail.query() == []
+        engine.stop()
+
+    def test_stats_track_allow_and_deny_counts(self, workspace):
+        engine = make_engine(workspace, {"policies": [deny_policy()]})
+        engine.evaluate(ctx_for(engine, params={"command": "docker rm a"}))
+        engine.evaluate(ctx_for(engine, params={"command": "ls"}))
+        engine.evaluate(ctx_for(engine, params={"command": "ls"}))
+        st = engine.stats
+        assert (st.total_evaluations, st.deny_count, st.allow_count) == (3, 1, 2)
+        assert st.avg_evaluation_us > 0
+        engine.stop()
+
+    def test_status_shape(self, workspace):
+        engine = make_engine(workspace, {"policies": [deny_policy()]})
+        status = engine.get_status()
+        assert status["enabled"] and status["policyCount"] == 1
+        assert status["failMode"] == "open"
+        assert status["stats"]["totalEvaluations"] == 0
+        engine.stop()
+
+
+class TestPerformanceBudgets:
+    def test_ten_regex_policies_under_5ms(self, workspace):
+        policies = [{
+            "id": f"regex-policy-{i}", "name": f"Regex {i}", "version": "1.0.0",
+            "scope": {}, "rules": [{
+                "id": f"r-{i}",
+                "conditions": [{"type": "tool", "name": "exec",
+                                "params": {"command": {"matches": f"pattern-{i}-[a-z]+"}}}],
+                "effect": {"action": "deny", "reason": f"Pattern {i}"}}],
+        } for i in range(10)]
+        engine = make_engine(workspace, {"policies": policies})
+        ctx = ctx_for(engine, params={"command": "no-match"})
+        engine.evaluate(ctx)  # warm regex cache
+        start = time.perf_counter()
+        verdict = engine.evaluate(ctx)
+        elapsed_ms = (time.perf_counter() - start) * 1e3
+        assert verdict.action == "allow"
+        assert elapsed_ms < 5, f"{elapsed_ms:.2f}ms"
+        engine.stop()
+
+    def test_thousand_frequency_entries_no_degradation(self, workspace):
+        engine = make_engine(workspace)
+        ctx = ctx_for(engine)
+        for _ in range(1000):
+            engine.evaluate(ctx)
+        start = time.perf_counter()
+        engine.evaluate(ctx)
+        elapsed_ms = (time.perf_counter() - start) * 1e3
+        assert elapsed_ms < 10, f"{elapsed_ms:.2f}ms"
+        engine.stop()
+
+
+# ── output validation pipeline (integration.test.ts:441-711) ─────────
+
+
+def make_validator(facts=(), config=None, llm=None):
+    logger = list_logger()
+    registry = FactRegistry([dict(f) for f in facts], logger)
+    cfg = {
+        "enabled": True,
+        "enabledDetectors": ["system_state"],
+        "unverifiedClaimPolicy": "ignore",
+        "selfReferentialPolicy": "ignore",
+        "contradictionThresholds": {"flagAbove": 60, "blockBelow": 40},
+        **(config or {}),
+    }
+    return OutputValidator(cfg, registry, logger, llm)
+
+
+NGINX_STOPPED = {"subject": "nginx", "predicate": "state", "value": "stopped"}
+NGINX_RUNNING = {"subject": "nginx", "predicate": "state", "value": "running"}
+
+
+class TestOutputValidationPipeline:
+    def test_pass_when_disabled(self):
+        validator = make_validator(config={"enabled": False})
+        assert validator.validate("nginx is running", 60).verdict == "pass"
+
+    def test_contradiction_blocks_low_trust(self):
+        validator = make_validator([NGINX_STOPPED])
+        result = validator.validate("nginx is running on port 80", 20)
+        assert result.verdict == "block"
+        assert len(result.contradictions) >= 1
+        assert "Contradiction" in result.reason
+
+    def test_contradiction_passes_high_trust(self):
+        validator = make_validator([NGINX_STOPPED])
+        result = validator.validate("nginx is running on port 80", 80)
+        assert result.verdict == "pass"
+        assert len(result.contradictions) >= 1  # surfaced, not hidden
+
+    def test_contradiction_flags_mid_trust(self):
+        validator = make_validator([NGINX_STOPPED])
+        result = validator.validate("nginx is running on port 80", 50)
+        assert result.verdict == "flag"
+
+    @pytest.mark.parametrize("trust,verdict", [
+        (0, "block"), (39, "block"), (40, "flag"), (59, "flag"),
+        (60, "pass"), (100, "pass")])
+    def test_threshold_boundaries(self, trust, verdict):
+        validator = make_validator([NGINX_STOPPED])
+        assert validator.validate("nginx is running", trust).verdict == verdict
+
+    def test_pass_when_claims_match_facts(self):
+        validator = make_validator([NGINX_RUNNING])
+        result = validator.validate("nginx is running smoothly", 20)
+        assert result.verdict == "pass"
+        assert result.contradictions == []
+
+    def test_unverified_claims_ignored_by_default(self):
+        validator = make_validator()
+        result = validator.validate("nginx is running", 20)
+        assert result.verdict == "pass"
+        assert len(result.claims) > 0
+
+    def test_unverified_flag_policy(self):
+        validator = make_validator(config={"unverifiedClaimPolicy": "flag"})
+        result = validator.validate("nginx is running", 20)
+        assert result.verdict == "flag" and "Unverified" in result.reason
+
+    def test_unverified_block_policy(self):
+        validator = make_validator(config={"unverifiedClaimPolicy": "block"})
+        assert validator.validate("nginx is running", 90).verdict == "block"
+
+    def test_self_referential_policy_split(self):
+        validator = make_validator(config={
+            "enabledDetectors": ["self_referential"],
+            "unverifiedClaimPolicy": "flag",
+            "selfReferentialPolicy": "block"})
+        result = validator.validate("I am the governance engine", 90)
+        assert result.verdict == "block"
+        assert "Self-referential" in result.reason
+
+    def test_no_claims_short_circuits(self):
+        validator = make_validator([NGINX_STOPPED])
+        result = validator.validate("just some prose with no claims", 20)
+        assert result.verdict == "pass" and result.reason == "No claims detected"
+
+    def test_empty_text_passes(self):
+        validator = make_validator([NGINX_STOPPED])
+        assert validator.validate("", 0).verdict == "pass"
+
+    def test_evaluation_us_recorded(self):
+        validator = make_validator([NGINX_STOPPED])
+        assert validator.validate("nginx is running", 50).evaluation_us > 0
+
+
+class TestStage3Llm:
+    FACTS = [{"subject": "nats-events", "predicate": "count", "value": "255908"}]
+
+    def make_llm(self, response, calls=None):
+        def call(prompt):
+            if calls is not None:
+                calls.append(prompt)
+            return response
+        return LlmValidator(call, list_logger(), clock=FakeClock())
+
+    def test_internal_output_skips_stage3(self):
+        calls = []
+        llm = self.make_llm('{"verdict": "block", "reason": "nope"}', calls)
+        validator = make_validator(self.FACTS, {"llmValidator": {"enabled": True}}, llm)
+        result = validator.validate("We process data efficiently.", 60, is_external=False)
+        assert result.verdict == "pass" and calls == []
+
+    def test_external_output_merges_most_restrictive(self):
+        llm = self.make_llm('{"verdict": "block", "reason": "fabricated stat"}')
+        validator = make_validator(self.FACTS, {"llmValidator": {"enabled": True}}, llm)
+        result = validator.validate("We processed 9 trillion events", 60, is_external=True)
+        assert result.verdict == "block"
+        assert "fabricated" in result.reason
+        assert result.llm_result is not None
+
+    def test_external_llm_pass_keeps_stage12_verdict(self):
+        llm = self.make_llm('{"verdict": "pass", "reason": "fine"}')
+        validator = make_validator(
+            [NGINX_STOPPED], {"llmValidator": {"enabled": True}}, llm)
+        result = validator.validate("nginx is running", 20, is_external=True)
+        assert result.verdict == "block"  # stage 1+2 contradiction outranks
+
+    def test_stage3_error_fails_open_to_stage12(self):
+        # A stub whose validate always raises: exercises OutputValidator's
+        # own catch (stage 3 fails open to the stage-1/2 verdict), not
+        # LlmValidator's internal retry/fail-mode handling.
+        class RaisingLlm:
+            def validate(self, *a, **k):
+                raise RuntimeError("llm down")
+
+        validator = make_validator(self.FACTS, {"llmValidator": {"enabled": True}},
+                                   RaisingLlm())
+        result = validator.validate("All good here.", 60, is_external=True)
+        assert result.verdict == "pass"
+
+    def test_external_without_llm_configured_is_sync_pass(self):
+        validator = make_validator(self.FACTS, {"llmValidator": {"enabled": True}}, None)
+        result = validator.validate("We process data efficiently.", 60, is_external=True)
+        assert result.verdict == "pass"
+
+
+class TestOutputValidationPerf:
+    def test_full_pipeline_under_10ms(self):
+        facts = [{"subject": f"service-{i}", "predicate": "state",
+                  "value": "running" if i % 2 == 0 else "stopped"}
+                 for i in range(50)]
+        validator = make_validator(facts, {
+            "enabledDetectors": ["system_state", "entity_name", "existence",
+                                 "operational_status", "self_referential"]})
+        text = ("service-0 is stopped and service-1 is running. "
+                "The server prod-01 exists. CPU is at 90%. "
+                "I am the governance engine.")
+        validator.validate(text, 60)  # warm regex caches
+        start = time.perf_counter()
+        result = validator.validate(text, 60)
+        elapsed_ms = (time.perf_counter() - start) * 1e3
+        assert elapsed_ms < 10, f"{elapsed_ms:.2f}ms"
+        assert result.contradictions  # service-0 claimed stopped, fact says running

@@ -1,0 +1,277 @@
+"""Exhaustive policy-evaluator matrix: per-rule trust gates across every
+tier pair, verdict aggregation across every effect combination, scope
+filtering, specificity ordering, and condition AND/first-match semantics
+(reference: governance/test/policy-evaluator.test.ts, 366 LoC; VERDICT r4 #5
+asked for equivalent-depth evaluator coverage).
+"""
+
+import itertools
+
+import pytest
+
+from vainplex_openclaw_tpu.governance.conditions import create_condition_evaluators
+from vainplex_openclaw_tpu.governance.frequency import FrequencyTracker
+from vainplex_openclaw_tpu.governance.policy_evaluator import (
+    PolicyEvaluator,
+    aggregate_matches,
+    matches_scope,
+    sort_policies,
+)
+from vainplex_openclaw_tpu.governance.types import (
+    ConditionDeps,
+    EvalTrust,
+    EvaluationContext,
+    MatchedPolicy,
+    RiskAssessment,
+    TrustSnapshot,
+)
+from vainplex_openclaw_tpu.governance.util import TRUST_TIERS, TimeContext, score_to_tier
+
+EVALUATOR = PolicyEvaluator()
+
+TIER_SCORE = {"untrusted": 10, "restricted": 30, "standard": 50,
+              "trusted": 70, "elevated": 90}
+
+
+def make_ctx(session_tier="standard", agent_id="forge", tool_name="exec",
+             tool_params=None, channel=None, **kw):
+    score = TIER_SCORE[session_tier]
+    return EvaluationContext(
+        agent_id=agent_id,
+        session_key=f"agent:{agent_id}",
+        hook="before_tool_call",
+        trust=EvalTrust(agent=TrustSnapshot(60, "trusted"),
+                        session=TrustSnapshot(score, score_to_tier(score))),
+        time=TimeContext(hour=12, minute=0, day_of_week=3, date="2026-07-30"),
+        tool_name=tool_name,
+        tool_params=tool_params if tool_params is not None else {"command": "ls"},
+        channel=channel,
+        **kw,
+    )
+
+
+def make_deps():
+    return ConditionDeps(
+        regex_cache={},
+        time_windows={},
+        risk=RiskAssessment(level="medium", score=50, factors=[]),
+        frequency_tracker=FrequencyTracker(),
+        evaluators=create_condition_evaluators(),
+    )
+
+
+def policy(rules, id="p1", priority=0, scope=None, controls=None):
+    return {"id": id, "name": id, "version": "1.0.0", "priority": priority,
+            "scope": scope or {}, "controls": controls or [], "rules": rules}
+
+
+def rule(action="deny", reason="r", id="r1", conditions=None, **kw):
+    return {"id": id, "conditions": conditions or [{"type": "tool", "name": "exec"}],
+            "effect": {"action": action, "reason": reason}, **kw}
+
+
+class TestTrustGateMatrix:
+    """Every (rule gate, session tier) pair — 5×5 each for min and max."""
+
+    @pytest.mark.parametrize("gate,tier", itertools.product(TRUST_TIERS, TRUST_TIERS))
+    def test_min_trust_applies_iff_tier_at_least(self, gate, tier):
+        p = policy([rule(minTrust=gate)])
+        res = EVALUATOR.evaluate(make_ctx(session_tier=tier), [p], make_deps())
+        should_apply = TRUST_TIERS.index(tier) >= TRUST_TIERS.index(gate)
+        assert (res.action == "deny") is should_apply, (gate, tier)
+
+    @pytest.mark.parametrize("gate,tier", itertools.product(TRUST_TIERS, TRUST_TIERS))
+    def test_max_trust_applies_iff_tier_at_most(self, gate, tier):
+        p = policy([rule(maxTrust=gate)])
+        res = EVALUATOR.evaluate(make_ctx(session_tier=tier), [p], make_deps())
+        should_apply = TRUST_TIERS.index(tier) <= TRUST_TIERS.index(gate)
+        assert (res.action == "deny") is should_apply, (gate, tier)
+
+    @pytest.mark.parametrize("tier", TRUST_TIERS)
+    def test_band_gate_standard_to_trusted(self, tier):
+        p = policy([rule(minTrust="standard", maxTrust="trusted")])
+        res = EVALUATOR.evaluate(make_ctx(session_tier=tier), [p], make_deps())
+        assert (res.action == "deny") is (tier in ("standard", "trusted"))
+
+
+ACTIONS = ("allow", "audit", "2fa", "deny")
+
+
+class TestAggregationMatrix:
+    """Every non-empty subset of effect actions aggregates to the most
+    restrictive member under deny > 2fa > audit > allow."""
+
+    @pytest.mark.parametrize("combo", [
+        c for n in range(1, 5) for c in itertools.combinations(ACTIONS, n)])
+    def test_most_restrictive_wins(self, combo):
+        matches = [MatchedPolicy(f"p-{a}", "r", {"action": a, "reason": a})
+                   for a in combo]
+        res = aggregate_matches(matches)
+        if "deny" in combo:
+            assert res.action == "deny" and res.reason == "deny"
+        elif "2fa" in combo:
+            assert res.action == "2fa" and res.reason == "2fa"
+        elif "audit" in combo:
+            assert res.action == "allow" and res.audit_only
+        else:
+            assert res.action == "allow" and not res.audit_only
+
+    def test_empty_reason_falls_back_to_default(self):
+        res = aggregate_matches([MatchedPolicy("p", "r", {"action": "deny"})])
+        assert res.reason == "Denied by governance policy"
+        res2 = aggregate_matches([MatchedPolicy("p", "r", {"action": "2fa"})])
+        assert res2.reason == "Requires 2FA approval"
+
+    def test_audit_effect_reason(self):
+        res = aggregate_matches([MatchedPolicy("p", "r", {"action": "audit"})])
+        assert res.reason == "Allowed with audit logging"
+
+    def test_matches_preserved_in_result(self):
+        matches = [MatchedPolicy("a", "r1", {"action": "allow"}),
+                   MatchedPolicy("b", "r2", {"action": "deny", "reason": "no"})]
+        assert aggregate_matches(matches).matches == matches
+
+
+class TestScopeMatrix:
+    @pytest.mark.parametrize("agent,excluded,applies", [
+        ("forge", ["forge"], False),
+        ("forge", ["main"], True),
+        ("forge", ["main", "forge"], False),
+        ("forge", [], True),
+        ("forge", None, True),
+    ])
+    def test_exclude_agents(self, agent, excluded, applies):
+        scope = {} if excluded is None else {"excludeAgents": excluded}
+        p = policy([rule()], scope=scope)
+        assert matches_scope(p, make_ctx(agent_id=agent)) is applies
+
+    @pytest.mark.parametrize("ctx_channel,scope_channels,applies", [
+        ("matrix", ["matrix"], True),
+        ("matrix", ["telegram"], False),
+        ("matrix", ["telegram", "matrix"], True),
+        (None, ["matrix"], False),
+        ("matrix", None, True),
+        (None, None, True),
+    ])
+    def test_channel_scope(self, ctx_channel, scope_channels, applies):
+        scope = {} if scope_channels is None else {"channels": scope_channels}
+        p = policy([rule()], scope=scope)
+        assert matches_scope(p, make_ctx(channel=ctx_channel)) is applies
+
+    def test_excluded_agent_never_reaches_rules(self):
+        p = policy([rule(reason="should not fire")],
+                   scope={"excludeAgents": ["forge"]})
+        res = EVALUATOR.evaluate(make_ctx(), [p], make_deps())
+        assert res.action == "allow" and res.matches == []
+
+
+class TestOrderingMatrix:
+    def test_priority_descending(self):
+        ps = [policy([rule()], id=f"p{i}", priority=i) for i in (1, 10, 5)]
+        assert [p["id"] for p in sort_policies(ps)] == ["p10", "p5", "p1"]
+
+    def test_specificity_breaks_priority_ties(self):
+        broad = policy([rule()], id="broad", priority=5)
+        agent_scoped = policy([rule()], id="agent", priority=5,
+                              scope={"agents": ["forge"]})
+        chan_scoped = policy([rule()], id="chan", priority=5,
+                             scope={"channels": ["matrix"]})
+        ordered = sort_policies([broad, chan_scoped, agent_scoped])
+        assert [p["id"] for p in ordered] == ["agent", "chan", "broad"]
+
+    def test_deny_wins_regardless_of_priority_order(self):
+        low_deny = policy([rule(action="deny", reason="low deny")],
+                          id="low", priority=1)
+        high_allow = policy([rule(action="allow")], id="high", priority=100)
+        res = EVALUATOR.evaluate(make_ctx(), [high_allow, low_deny], make_deps())
+        assert res.action == "deny"
+
+    def test_missing_priority_treated_as_zero(self):
+        no_pri = {"id": "none", "name": "n", "version": "1", "scope": {},
+                  "rules": [rule()]}
+        with_pri = policy([rule()], id="one", priority=1)
+        assert [p["id"] for p in sort_policies([no_pri, with_pri])] == ["one", "none"]
+
+
+class TestRuleSemantics:
+    def test_conditions_are_anded(self):
+        p = policy([rule(conditions=[
+            {"type": "tool", "name": "exec"},
+            {"type": "agent", "id": "cerberus"},  # ctx agent is forge
+        ])])
+        res = EVALUATOR.evaluate(make_ctx(), [p], make_deps())
+        assert res.action == "allow" and res.matches == []
+
+    def test_all_conditions_passing_fires(self):
+        p = policy([rule(conditions=[
+            {"type": "tool", "name": "exec"},
+            {"type": "agent", "id": "forge"},
+        ])])
+        assert EVALUATOR.evaluate(make_ctx(), [p], make_deps()).action == "deny"
+
+    def test_empty_conditions_always_match(self):
+        p = policy([rule(conditions=[])])
+        assert EVALUATOR.evaluate(make_ctx(), [p], make_deps()).action == "deny"
+
+    def test_first_matching_rule_wins_within_policy(self):
+        p = policy([
+            rule(action="allow", id="r-allow"),
+            rule(action="deny", id="r-deny", reason="Should not reach"),
+        ])
+        res = EVALUATOR.evaluate(make_ctx(), [p], make_deps())
+        assert len(res.matches) == 1 and res.matches[0].rule_id == "r-allow"
+        assert res.action == "allow"
+
+    def test_gated_first_rule_falls_through_to_second(self):
+        p = policy([
+            rule(action="allow", id="r-gated", minTrust="elevated"),
+            rule(action="deny", id="r-open", reason="fallthrough"),
+        ])
+        res = EVALUATOR.evaluate(make_ctx(session_tier="standard"), [p], make_deps())
+        assert res.matches[0].rule_id == "r-open" and res.action == "deny"
+
+    def test_each_policy_contributes_at_most_one_match(self):
+        p1 = policy([rule(id="a"), rule(id="b")], id="p1")
+        p2 = policy([rule(id="c")], id="p2")
+        res = EVALUATOR.evaluate(make_ctx(), [p1, p2], make_deps())
+        assert sorted(m.policy_id for m in res.matches) == ["p1", "p2"]
+
+    def test_rule_without_effect_defaults_to_allow(self):
+        p = policy([{"id": "r1", "conditions": []}])
+        res = EVALUATOR.evaluate(make_ctx(), [p], make_deps())
+        assert res.action == "allow" and res.matches[0].effect == {"action": "allow"}
+
+
+class TestControlsPropagation:
+    @pytest.mark.parametrize("controls", [
+        ["A.8.11", "A.8.4"], ["SOC2-CC6.1", "SOC2-CC7.2"], [], None])
+    def test_controls_carried_into_match(self, controls):
+        p = policy([rule()], controls=controls)
+        if controls is None:
+            p.pop("controls")
+        res = EVALUATOR.evaluate(make_ctx(), [p], make_deps())
+        assert res.matches[0].controls == (controls or [])
+
+    def test_controls_per_policy_not_merged(self):
+        p1 = policy([rule(id="a")], id="p1", controls=["A.1"])
+        p2 = policy([rule(id="b")], id="p2", controls=["B.2"])
+        res = EVALUATOR.evaluate(make_ctx(), [p1, p2], make_deps())
+        by_policy = {m.policy_id: m.controls for m in res.matches}
+        assert by_policy == {"p1": ["A.1"], "p2": ["B.2"]}
+
+
+class TestNoMatchPassthrough:
+    @pytest.mark.parametrize("tool", ["read", "write", "browse", None])
+    def test_non_matching_tools_allowed(self, tool):
+        p = policy([rule()])  # fires on exec only
+        res = EVALUATOR.evaluate(make_ctx(tool_name=tool), [p], make_deps())
+        assert res.action == "allow" and res.reason == "No matching policies"
+
+    def test_empty_policy_list_allows(self):
+        res = EVALUATOR.evaluate(make_ctx(), [], make_deps())
+        assert res.action == "allow" and res.matches == []
+
+    def test_policy_with_no_rules_never_matches(self):
+        p = policy([])
+        res = EVALUATOR.evaluate(make_ctx(), [p], make_deps())
+        assert res.action == "allow" and res.matches == []
