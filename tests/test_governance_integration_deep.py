@@ -10,8 +10,6 @@ these tests construct GovernanceEngine directly against a real filesystem
 workspace, mirroring the reference's engine-level style.
 """
 
-import time
-
 import pytest
 
 from vainplex_openclaw_tpu.core import list_logger
@@ -23,6 +21,7 @@ from vainplex_openclaw_tpu.governance.validation import (
 )
 
 from helpers import FakeClock
+from test_perf_budgets import SLACK, timed_ms
 
 # Anchor clocks at explicit UTC hours: epoch + h*3600 is 1970-01-01 h:00 UTC.
 def day_clock(hour=12):
@@ -250,6 +249,9 @@ class TestAuditIntegration:
 
 
 class TestPerformanceBudgets:
+    """Reference budgets at the engine level, measured with the repo's
+    anti-flake convention (best-of-N + SLACK, test_perf_budgets.py)."""
+
     def test_ten_regex_policies_under_5ms(self, workspace):
         policies = [{
             "id": f"regex-policy-{i}", "name": f"Regex {i}", "version": "1.0.0",
@@ -261,12 +263,9 @@ class TestPerformanceBudgets:
         } for i in range(10)]
         engine = make_engine(workspace, {"policies": policies})
         ctx = ctx_for(engine, params={"command": "no-match"})
-        engine.evaluate(ctx)  # warm regex cache
-        start = time.perf_counter()
-        verdict = engine.evaluate(ctx)
-        elapsed_ms = (time.perf_counter() - start) * 1e3
-        assert verdict.action == "allow"
-        assert elapsed_ms < 5, f"{elapsed_ms:.2f}ms"
+        assert engine.evaluate(ctx).action == "allow"  # warm regex cache
+        ms = timed_ms(lambda: engine.evaluate(ctx))
+        assert ms < 5 * SLACK, f"{ms:.2f}ms"
         engine.stop()
 
     def test_thousand_frequency_entries_no_degradation(self, workspace):
@@ -274,10 +273,8 @@ class TestPerformanceBudgets:
         ctx = ctx_for(engine)
         for _ in range(1000):
             engine.evaluate(ctx)
-        start = time.perf_counter()
-        engine.evaluate(ctx)
-        elapsed_ms = (time.perf_counter() - start) * 1e3
-        assert elapsed_ms < 10, f"{elapsed_ms:.2f}ms"
+        ms = timed_ms(lambda: engine.evaluate(ctx))
+        assert ms < 10 * SLACK, f"{ms:.2f}ms"
         engine.stop()
 
 
@@ -438,9 +435,7 @@ class TestOutputValidationPerf:
         text = ("service-0 is stopped and service-1 is running. "
                 "The server prod-01 exists. CPU is at 90%. "
                 "I am the governance engine.")
-        validator.validate(text, 60)  # warm regex caches
-        start = time.perf_counter()
-        result = validator.validate(text, 60)
-        elapsed_ms = (time.perf_counter() - start) * 1e3
-        assert elapsed_ms < 10, f"{elapsed_ms:.2f}ms"
+        result = validator.validate(text, 60)  # warm regex caches
         assert result.contradictions  # service-0 claimed stopped, fact says running
+        ms = timed_ms(lambda: validator.validate(text, 60))
+        assert ms < 10 * SLACK, f"{ms:.2f}ms"

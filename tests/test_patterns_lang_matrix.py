@@ -1,0 +1,195 @@
+"""Second per-language depth pass over the cortex pattern packs: additional
+decision/close/wait phrasings per language, a topic-capture variant, a
+neutral-text negative control, and blacklist/high-impact spot checks —
+mirroring the breadth of the reference's one-file-per-language suites
+(cortex/test/patterns-lang-{es,fr,it,ja,ko,pt,ru,zh}.test.ts; VERDICT r4 #5).
+
+Complements test_patterns_langs_deep.py (first pass: core phrasings, all
+five moods, priority, noise). No case here repeats a first-pass phrasing.
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.cortex.patterns import MergedPatterns
+from vainplex_openclaw_tpu.cortex.thread_tracker import extract_signals
+
+CASES = {
+    "en": {
+        "decisions": ["decision: ship tomorrow morning",
+                      "approach: use the queue for retries",
+                      "let's do the rewrite in stages"],
+        "closes": ["all done with the migration", "it's fixed upstream", "✅"],
+        "waits": ["waiting on legal review", "need the approval first"],
+        "topic": ("regarding the cache invalidation logic", "cache invalidation"),
+        "neutral": "clouds drift over the hills",
+        "blacklist": ["it", "that", "tomorrow"],
+        "high": ["security", "breaking"],
+    },
+    "de": {
+        "decisions": ["das ist beschlossen", "wir machen den Refactor",
+                      "ansatz: erst die Queue"],
+        "closes": ["schon erledigt", "das ist behoben", "es funktioniert"],
+        "waits": ["blockiert durch die CI", "brauchen das Review erst"],
+        "topic": ("jetzt zu performance tuning", "performance tuning"),
+        "neutral": "die Sonne scheint",
+        "blacklist": ["das", "die", "heute"],
+        "high": ["sicherheit", "kritisch"],
+    },
+    "fr": {
+        "decisions": ["décision prise ce matin", "on va faire la migration",
+                      "approche : cache distribué"],
+        "closes": ["c'est corrigé", "terminé depuis hier", "ça fonctionne"],
+        "waits": ["en attente de validation", "besoin de tests d'abord"],
+        "topic": ("revenons à la configuration réseau", "configuration"),
+        "neutral": "le ciel est bleu ce matin",
+        "blacklist": ["ça", "rien", "tout"],
+        "high": ["critique", "déploiement"],
+    },
+    "es": {
+        "decisions": ["decisión tomada por el equipo", "vamos a hacer el refactor",
+                      "enfoque: colas de mensajes"],
+        "closes": ["está listo", "solucionado por fin", "ya funciona"],
+        "waits": ["bloqueado por la API externa", "necesito el build primero"],
+        "topic": ("volviendo a la autenticación", "autenticación"),
+        "neutral": "hace buen tiempo",
+        "blacklist": ["eso", "nada", "todo"],
+        "high": ["producción", "crítico"],
+    },
+    "pt": {
+        "decisions": ["decisão tomada ontem", "vamos fazer o deploy amanhã",
+                      "abordagem: filas de retry"],
+        "closes": ["está pronto", "já consertado", "isso funciona"],
+        "waits": ["bloqueado por testes", "preciso do build primeiro"],
+        "topic": ("voltando ao pipeline de dados", "pipeline de dados"),
+        "neutral": "o tempo está bom",
+        "blacklist": ["isso", "nada", "tudo"],
+        "high": ["produção", "crítico"],
+    },
+    "it": {
+        "decisions": ["decisione presa insieme", "facciamo il refactor",
+                      "approccio: code di retry"],
+        "closes": ["già risolto", "è completato", "ora funziona"],
+        "waits": ["bloccato da CI", "serve il review prima"],
+        "topic": ("tornando a performance tuning", "performance tuning"),
+        "neutral": "il cielo è azzurro",
+        "blacklist": ["questo", "niente", "tutto"],
+        "high": ["produzione", "critico"],
+    },
+    "zh": {
+        "decisions": ["采用新框架", "就这么定", "拍板了"],
+        "closes": ["修好了", "可以了", "已修复完毕"],
+        "waits": ["卡在审批流程", "依赖于上游服务"],
+        "topic": ("讨论缓存策略", "缓存策略"),
+        "neutral": "今天天气很好",
+        "blacklist": ["这个", "什么", "今天"],
+        "high": ["部署", "重大"],
+    },
+    "ja": {
+        "decisions": ["決めました", "Reactで行きましょう", "プランはこうです"],
+        "closes": ["できました", "終わりました"],
+        "waits": ["承認が必要です", "レビュー待ち"],
+        "topic": ("データベースについて", "データベース"),
+        "neutral": "今日は天気がいいです",
+        "blacklist": ["これ", "何", "今日"],
+        "high": ["本番", "重要"],
+    },
+    "ko": {
+        "decisions": ["합의했습니다", "postgres으로 갑시다", "정했어요"],
+        "closes": ["끝났습니다", "수정했습니다"],
+        "waits": ["승인 기다리는 중", "업스트림에 의존합니다"],
+        "topic": ("데이터베이스에 대해 논의합시다", "데이터베이스"),
+        "neutral": "오늘 날씨가 좋네요",
+        "blacklist": ["이것", "무엇", "오늘"],
+        "high": ["배포", "중요"],
+    },
+    "ru": {
+        "decisions": ["решили мигрировать на pjit", "план таков",
+                      "подход: очереди задач"],
+        "closes": ["уже исправлено", "починил вчера", "теперь работает"],
+        "waits": ["ожидаем релиз", "зависит от инфраструктуры"],
+        "topic": ("насчёт производительности кластера", "производительности"),
+        "neutral": "сегодня хорошая погода",
+        "blacklist": ["это", "ничего", "всё"],
+        "high": ["деплой", "критично"],
+    },
+}
+
+_PACKS = {code: MergedPatterns([code]) for code in CASES}
+
+
+def _flat(kind):
+    out = []
+    for code, table in CASES.items():
+        for item in table[kind]:
+            out.append((code, item))
+    return out
+
+
+class TestExtraDecisionPhrasings:
+    @pytest.mark.parametrize("code,text", _flat("decisions"),
+                             ids=lambda v: str(v)[:30])
+    def test_decision_detected(self, code, text):
+        assert extract_signals(text, _PACKS[code]).decisions, f"{code}: {text}"
+
+
+class TestExtraClosePhrasings:
+    @pytest.mark.parametrize("code,text", _flat("closes"),
+                             ids=lambda v: str(v)[:30])
+    def test_closure_detected(self, code, text):
+        assert extract_signals(text, _PACKS[code]).closures, f"{code}: {text}"
+
+
+class TestExtraWaitPhrasings:
+    @pytest.mark.parametrize("code,text", _flat("waits"),
+                             ids=lambda v: str(v)[:30])
+    def test_wait_detected(self, code, text):
+        assert extract_signals(text, _PACKS[code]).waits, f"{code}: {text}"
+
+
+class TestTopicCaptureVariants:
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_topic_variant_captured(self, code):
+        text, expected = CASES[code]["topic"]
+        topics = extract_signals(text, _PACKS[code]).topics
+        assert topics, f"{code}: no topic in {text!r}"
+        assert any(expected in t for t in topics), f"{code}: {topics}"
+
+
+class TestNeutralTextNegativeControl:
+    """Unrelated small talk in each language must fire NO signal — the
+    reference pins this per language ('does not match unrelated text')."""
+
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_no_signals_on_small_talk(self, code):
+        sig = extract_signals(CASES[code]["neutral"], _PACKS[code])
+        assert not sig.decisions and not sig.closures and not sig.waits, code
+
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_neutral_mood_on_small_talk(self, code):
+        assert _PACKS[code].detect_mood(CASES[code]["neutral"]) == "neutral"
+
+
+class TestBlacklistSpotChecks:
+    @pytest.mark.parametrize("code,word", _flat("blacklist"),
+                             ids=lambda v: str(v)[:20])
+    def test_blacklisted_word_is_noise(self, code, word):
+        assert _PACKS[code].is_noise_topic(word), f"{code}: {word}"
+
+
+class TestHighImpactSpotChecks:
+    @pytest.mark.parametrize("code,word", _flat("high"),
+                             ids=lambda v: str(v)[:20])
+    def test_keyword_escalates_priority(self, code, word):
+        assert _PACKS[code].infer_priority(f"update on {word} work") == "high"
+
+
+class TestUniversalEmojiMoods:
+    """BASE_MOODS are language-independent and merge into every pack."""
+
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_rocket_is_excited_everywhere(self, code):
+        assert _PACKS[code].detect_mood("🚀") == "excited"
+
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_warning_sign_is_tense_everywhere(self, code):
+        assert _PACKS[code].detect_mood("⚠️") == "tense"
