@@ -1,0 +1,363 @@
+"""Knowledge-engine depth: fact-store lifecycle case-by-case, the full
+extraction pattern matrix, canonicalization/merge semantics, and the LLM
+enhancer's batch contract (reference:
+knowledge-engine/test/{fact-store,entity-extractor,patterns,llm-enhancer}
+.test.ts — 48 cases across those files; VERDICT r4 #5 test-depth parity).
+
+Complements test_knowledge.py (plugin wiring, embeddings, Chroma paths).
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.knowledge.entity_extractor import (
+    PATTERNS,
+    Entity,
+    EntityExtractor,
+    canonicalize,
+    initial_importance,
+)
+from vainplex_openclaw_tpu.knowledge.fact_store import Fact, FactStore
+from vainplex_openclaw_tpu.knowledge.llm_enhancer import KnowledgeLlmEnhancer
+from vainplex_openclaw_tpu.storage.atomic import read_json
+
+from helpers import FakeClock
+
+
+def make_store(tmp_path, **config):
+    store = FactStore(tmp_path, config=config, logger=list_logger(),
+                      clock=FakeClock(), wall_timers=False)
+    store.load()
+    return store
+
+
+class TestFactLifecycle:
+    def test_add_returns_fact_with_metadata(self, tmp_path):
+        store = make_store(tmp_path)
+        fact = store.add_fact("alice", "role", "admin", source="extracted-llm")
+        assert fact.relevance == 1.0 and fact.source == "extracted-llm"
+        assert fact.created_at and fact.created_at == fact.last_accessed
+        assert store.count() == 1
+
+    def test_duplicate_add_boosts_not_duplicates(self, tmp_path):
+        store = make_store(tmp_path)
+        f1 = store.add_fact("alice", "role", "admin")
+        f1.relevance = 0.5
+        f2 = store.add_fact("alice", "role", "admin")
+        assert f2.id == f1.id and store.count() == 1
+        assert f2.relevance == pytest.approx(0.7)  # +relevanceBoost 0.2
+
+    def test_boost_caps_at_one(self, tmp_path):
+        store = make_store(tmp_path)
+        store.add_fact("alice", "role", "admin")
+        fact = store.add_fact("alice", "role", "admin")
+        assert fact.relevance == 1.0
+
+    def test_different_object_is_new_fact(self, tmp_path):
+        store = make_store(tmp_path)
+        store.add_fact("alice", "role", "admin")
+        store.add_fact("alice", "role", "operator")
+        assert store.count() == 2
+
+
+class TestFactQuery:
+    def seed(self, store):
+        store.add_fact("alice", "role", "admin")
+        store.add_fact("bob", "role", "viewer")
+        store.add_fact("alice", "team", "infra")
+        store.add_fact("chroma", "state", "running")
+
+    def test_query_by_subject(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        got = store.query(subject="alice")
+        assert {f.predicate for f in got} == {"role", "team"}
+
+    def test_query_by_predicate(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        got = store.query(predicate="role")
+        assert {f.subject for f in got} == {"alice", "bob"}
+
+    def test_query_by_text_spans_all_fields(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        # one needle per field: subject, predicate, object
+        assert {f.subject for f in store.query(text="chroma")} == {"chroma"}
+        assert {f.predicate for f in store.query(text="team")} == {"team"}
+        assert {f.object for f in store.query(text="viewer")} == {"viewer"}
+
+    def test_query_multiple_filters_intersect(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        got = store.query(subject="alice", predicate="team")
+        assert len(got) == 1 and got[0].object == "infra"
+
+    def test_empty_query_returns_all(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        assert len(store.query()) == 4
+
+    def test_query_case_insensitive(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        assert len(store.query(subject="ALICE")) == 2
+
+    def test_results_sorted_by_relevance_desc(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        store.facts[store.query(subject="bob")[0].id].relevance = 0.3
+        rel = [f.relevance for f in store.query()]
+        assert rel == sorted(rel, reverse=True)
+
+    def test_limit_applied_after_sort(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        for i, fact in enumerate(store.facts.values()):
+            fact.relevance = 0.2 + 0.2 * i  # distinct: 0.2, 0.4, 0.6, 0.8
+        top = store.query(limit=2)
+        assert [f.relevance for f in top] == [pytest.approx(0.8),
+                                              pytest.approx(0.6)]
+
+    def test_no_match_empty(self, tmp_path):
+        store = make_store(tmp_path)
+        self.seed(store)
+        assert store.query(subject="nobody") == []
+
+
+class TestFactDecayAndPrune:
+    def test_decay_multiplies_all(self, tmp_path):
+        store = make_store(tmp_path)
+        store.add_fact("a", "p", "o1")
+        store.add_fact("b", "p", "o2")
+        dead = store.decay_facts()
+        assert dead == 0
+        assert all(f.relevance == pytest.approx(0.95) for f in store.facts.values())
+
+    def test_decay_prunes_below_threshold_and_reports(self, tmp_path):
+        store = make_store(tmp_path)
+        f = store.add_fact("a", "p", "o")
+        f.relevance = 0.05  # one tick → 0.0475 < 0.05 threshold
+        keep = store.add_fact("b", "p", "o2")
+        assert store.decay_facts() == 1
+        assert list(store.facts) == [keep.id]
+
+    def test_decay_empty_store_zero(self, tmp_path):
+        assert make_store(tmp_path).decay_facts() == 0
+
+    def test_cap_prunes_least_relevant_first(self, tmp_path):
+        store = make_store(tmp_path, maxFacts=3)
+        facts = [store.add_fact(f"s{i}", "p", f"o{i}") for i in range(3)]
+        facts[1].relevance = 0.2  # weakest
+        store.add_fact("new", "p", "onew")
+        assert store.count() == 3
+        assert facts[1].id not in store.facts
+
+    def test_repeated_decay_monotone(self, tmp_path):
+        store = make_store(tmp_path)
+        fact = store.add_fact("a", "p", "o")
+        seen = []
+        for _ in range(5):
+            store.decay_facts()
+            if fact.id in store.facts:
+                seen.append(fact.relevance)
+        assert seen == sorted(seen, reverse=True)
+
+
+class TestFactPersistence:
+    def test_file_format_version_and_fields(self, tmp_path):
+        store = make_store(tmp_path)
+        store.add_fact("alice", "role", "admin")
+        store.flush()
+        data = read_json(tmp_path / "knowledge" / "facts.json")
+        assert data["version"] == 1 and data["updated"]
+        [rec] = data["facts"]
+        assert rec["subject"] == "alice" and rec["createdAt"]
+
+    def test_reload_roundtrip(self, tmp_path):
+        store = make_store(tmp_path)
+        store.add_fact("alice", "role", "admin")
+        store.flush()
+        fresh = make_store(tmp_path)
+        [fact] = fresh.query(subject="alice")
+        assert fact.object == "admin" and fact.relevance == 1.0
+
+    def test_from_dict_defaults(self):
+        fact = Fact.from_dict({"subject": "x"})
+        assert fact.id and fact.source == "unknown" and fact.relevance == 1.0
+
+    def test_load_is_idempotent(self, tmp_path):
+        store = make_store(tmp_path)
+        store.add_fact("a", "p", "o")
+        store.load()  # second load must not wipe in-memory facts
+        assert store.count() == 1
+
+
+EMAILS_OK = ["user@example.com", "first.last+tag@sub.domain.org",
+             "a_b%c@x-y.de"]
+EMAILS_BAD = ["user@", "@domain.com", "plain text", "a@b"]
+URLS_OK = ["https://example.com/path?q=1", "http://sub.host.io"]
+ISO_OK = ["2026-07-30", "2026-07-30T12:05:00Z", "2026-01-02T03:04:05.678Z"]
+COMMON_OK = ["12/31/2026", "31.12.2026", "1/2/26"]
+DE_DATES = ["12. März 2026", "1. Januar 2025"]
+EN_DATES = ["March 12th, 2026", "July 4, 1976"]
+
+
+class TestExtractionPatterns:
+    @pytest.mark.parametrize("text", EMAILS_OK)
+    def test_email_positives(self, text):
+        assert PATTERNS["email"].search(f"contact {text} today"), text
+
+    @pytest.mark.parametrize("text", EMAILS_BAD)
+    def test_email_negatives(self, text):
+        assert not PATTERNS["email"].search(text), text
+
+    @pytest.mark.parametrize("text", URLS_OK)
+    def test_url_positives(self, text):
+        assert PATTERNS["url"].search(f"see {text} for details"), text
+
+    @pytest.mark.parametrize("text", ISO_OK)
+    def test_iso_date_positives(self, text):
+        assert PATTERNS["iso_date"].search(f"due {text} sharp"), text
+
+    @pytest.mark.parametrize("text", COMMON_OK)
+    def test_common_date_positives(self, text):
+        assert PATTERNS["common_date"].search(f"by {text} latest"), text
+
+    @pytest.mark.parametrize("text", DE_DATES)
+    def test_german_date_positives(self, text):
+        assert PATTERNS["german_date"].search(f"Treffen am {text} geplant"), text
+
+    @pytest.mark.parametrize("text", EN_DATES)
+    def test_english_date_positives(self, text):
+        assert PATTERNS["english_date"].search(f"meeting on {text} confirmed"), text
+
+    @pytest.mark.parametrize("text,expect", [
+        ("Angela Merkel spoke", True),
+        ("visited Berlin yesterday", True),
+        ("NASA launched", True),
+        ("The He She It", False)])
+    def test_proper_noun_with_exclusions(self, text, expect):
+        m = PATTERNS["proper_noun"].search(text)
+        assert bool(m) is expect, (text, m and m.group(0))
+
+    @pytest.mark.parametrize("text", ["openclaw v2.1 shipped", "Mark IV engine",
+                                      "release-v3 is out"])
+    def test_product_like_names(self, text):
+        assert PATTERNS["product_name"].search(text), text
+
+    @pytest.mark.parametrize("text", ["Acme Corp.", "Siemens AG", "Widgets Inc.",
+                                      "Deutsche Bahn GmbH"])
+    def test_organization_suffixes(self, text):
+        assert PATTERNS["organization_suffix"].search(text), text
+
+
+class TestExtractorSemantics:
+    def extract(self, text):
+        return EntityExtractor(logger=list_logger(), clock=FakeClock()).extract(text)
+
+    def test_no_entities_empty_list(self):
+        assert self.extract("nothing here but lowercase words") == []
+
+    def test_multiple_distinct_entities(self):
+        got = self.extract("mail bob@x.io about https://x.io on 2026-07-30")
+        assert {e.type for e in got} >= {"email", "url", "date"}
+
+    def test_repeat_mentions_merge_and_count(self):
+        got = self.extract("ping admin@x.io then admin@x.io again")
+        [email] = [e for e in got if e.type == "email"]
+        assert email.count == 2 and email.mentions == ["admin@x.io"]
+
+    def test_entity_id_is_type_and_slug(self):
+        got = self.extract("Acme Corp. is hiring")
+        [org] = [e for e in got if e.type == "organization"]
+        assert org.id == "organization:acme" and org.value == "Acme"
+
+    def test_org_canonicalization_strips_suffix(self):
+        assert canonicalize("Acme Corp.", "organization") == "Acme"
+        assert canonicalize("Siemens AG", "organization") == "Siemens"
+
+    def test_non_org_canonicalization_strips_punct(self):
+        assert canonicalize("Berlin.", "unknown") == "Berlin"
+        assert canonicalize("v2.1,", "product") == "v2.1"
+
+    def test_importance_by_type(self):
+        assert initial_importance("email", "a@b.co") == pytest.approx(0.8)
+        assert initial_importance("unknown", "Berlin") == pytest.approx(0.4)
+
+    def test_long_value_importance_bonus(self):
+        short = initial_importance("product", "openclaw v2")
+        long_ = initial_importance("product", "openclaw enterprise suite v2")
+        assert long_ == pytest.approx(short + 0.1)
+
+    def test_entity_to_dict_shape(self):
+        e = Entity(id="email:a@b.co", type="email", value="a@b.co",
+                   mentions=["a@b.co"])
+        d = e.to_dict()
+        assert d["lastSeen"] == "" and d["source"] == ["regex"] and d["count"] == 1
+
+
+class TestLlmEnhancerBatch:
+    GOOD = '{"facts": [{"subject": "alice", "predicate": "likes", "object": "jax"}]}'
+
+    def make(self, response, batch_size=3, calls=None):
+        def call(prompt):
+            if calls is not None:
+                calls.append(prompt)
+            if isinstance(response, Exception):
+                raise response
+            return response
+        self.log = list_logger()
+        return KnowledgeLlmEnhancer(call, self.log, batch_size=batch_size)
+
+    def test_below_threshold_no_call(self):
+        calls = []
+        enhancer = self.make(self.GOOD, calls=calls)
+        assert enhancer.add_to_batch("msg one") is None
+        assert enhancer.add_to_batch("msg two") is None
+        assert calls == []
+
+    def test_threshold_triggers_and_drains(self):
+        calls = []
+        enhancer = self.make(self.GOOD, calls=calls)
+        enhancer.add_to_batch("one")
+        enhancer.add_to_batch("two")
+        facts = enhancer.add_to_batch("three")
+        assert facts == [{"subject": "alice", "predicate": "likes", "object": "jax"}]
+        assert len(calls) == 1 and "- one" in calls[0] and "- three" in calls[0]
+        assert enhancer._batch == []
+
+    def test_send_empty_batch_noop(self):
+        assert self.make(self.GOOD).send_batch() is None
+
+    def test_llm_exception_swallowed(self):
+        enhancer = self.make(RuntimeError("down"))
+        for msg in ("a", "b"):
+            enhancer.add_to_batch(msg)
+        assert enhancer.add_to_batch("c") is None
+        # the failure was the except path, not a quiet empty result
+        assert any("knowledge LLM batch failed" in m
+                   for m in self.log.messages("debug"))
+
+    def test_malformed_json_returns_none(self):
+        enhancer = self.make("not json at all")
+        for msg in ("a", "b"):
+            enhancer.add_to_batch(msg)
+        assert enhancer.add_to_batch("c") is None
+
+    def test_partial_fact_records_filtered(self):
+        raw = ('{"facts": [{"subject": "ok", "predicate": "is", "object": "kept"},'
+               ' {"subject": "", "predicate": "x", "object": "y"},'
+               ' {"subject": "no-object", "predicate": "x"}, "junk"]}')
+        enhancer = self.make(raw)
+        for msg in ("a", "b"):
+            enhancer.add_to_batch(msg)
+        assert enhancer.add_to_batch("c") == [
+            {"subject": "ok", "predicate": "is", "object": "kept"}]
+
+    def test_content_truncated_to_2000(self):
+        calls = []
+        enhancer = self.make(self.GOOD, batch_size=1, calls=calls)
+        enhancer.add_to_batch("x" * 5000)
+        assert len(calls) == 1
+        assert "x" * 2000 in calls[0] and "x" * 2001 not in calls[0]
