@@ -313,20 +313,34 @@ def bench_encoder_throughput(batch: int = 256, steps: int = 20,
     return validate_throughput_record(rec)
 
 
-def bench_encoder_mfu(batch: int = 4, steps: int = 3) -> dict:
+# Compute-bound MFU shape ladder (VERDICT r5: "bisect the shape until it
+# completes"). Every level keeps d_model ≥ 512 (≥ 4×4 MXU 128-tiles per
+# matmul) and batch·L ≥ 4096 rows, so each level CAN saturate the MXU —
+# levels differ in compile+run budget, not in utilization capability. The
+# tunnel wedges in minutes; level 0's remote compile has never fit a
+# healthy window in five rounds of captures.
+MFU_SHAPES = (
+    dict(seq_len=2048, d_model=1024, n_heads=16, n_layers=12, d_ff=4096),
+    dict(seq_len=1024, d_model=1024, n_heads=16, n_layers=8, d_ff=4096),
+    dict(seq_len=1024, d_model=512, n_heads=8, n_layers=8, d_ff=2048),
+)
+
+
+def bench_encoder_mfu(batch: int = 4, steps: int = 3, level: int = 0) -> dict:
     """MFU from a COMPUTE-BOUND shape (VERDICT r3 #8): the flagship config
     (d_model 256, L 128) is dispatch-overhead-dominated and cannot express a
-    meaningful MFU. This wider config (d_model 1024, L 2048, 12 layers,
-    bf16, flash attention) keeps the MXU busy; reported alongside — never
-    instead of — the flagship-shape tokens/s. TPU-only: on CPU this shape
-    just burns the child timeout without producing an MFU (no peak table).
+    meaningful MFU. The MFU_SHAPES[level] config keeps the MXU busy;
+    reported alongside — never instead of — the flagship-shape tokens/s.
+    TPU-only: on CPU this shape just burns the child timeout without
+    producing an MFU (no peak table).
 
     Round 4's captures all died in remote XLA compile (12 inlined layers >
-    600 s budget — VERDICT r4 #2), so this config now compiles ONE block
+    600 s budget — VERDICT r4 #2), so this config compiles ONE block
     and ``lax.scan``s it over the stacked layer params (cfg.scan_blocks):
     compile cost no longer grows with depth, arithmetic intensity is
-    unchanged, and steps drops to 3 (the serial scan already defeats
-    caching; more steps only stretch the budget)."""
+    unchanged, and steps is 3 (the serial scan already defeats caching;
+    more steps only stretch the budget). ``level`` walks the MFU_SHAPES
+    bisect ladder when even that cannot fit a healthy tunnel window."""
     import jax
 
     from vainplex_openclaw_tpu.models import EncoderConfig
@@ -335,20 +349,28 @@ def bench_encoder_mfu(batch: int = 4, steps: int = 3) -> dict:
         return {"metric": "encoder_mfu_large", "skipped": True,
                 "reason": f"backend={jax.default_backend()} (compute-bound "
                           "MFU config is TPU-only)"}
-    cfg = EncoderConfig(seq_len=2048, d_model=1024, n_heads=16, n_layers=12,
-                        d_ff=4096, scan_blocks=True)
+    shape = MFU_SHAPES[level]
+    cfg = EncoderConfig(**shape, scan_blocks=True)
     sec_per_step = _timed_encoder_scan(cfg, batch, steps)
     tokens_per_s = batch * cfg.seq_len / sec_per_step
 
     platform, kind, peak = _device_peak()
     achieved_flops = tokens_per_s * encoder_flops_per_token(cfg)
-    return validate_throughput_record(
-        {"metric": "encoder_mfu_large", "value": round(tokens_per_s, 0),
-         "unit": "tokens/s", "vs_baseline": None,
-         "config": "d_model=1024 L=2048 layers=12 bf16 scan_blocks",
-         "device": platform, "device_kind": kind,
-         "achieved_tflops": round(achieved_flops / 1e12, 2),
-         "mfu": round(achieved_flops / peak, 4) if peak else None})
+    rec = {"metric": "encoder_mfu_large", "value": round(tokens_per_s, 0),
+           "unit": "tokens/s", "vs_baseline": None,
+           "config": (f"d_model={shape['d_model']} L={shape['seq_len']} "
+                      f"layers={shape['n_layers']} bf16 scan_blocks"),
+           "bisect_level": level,
+           "device": platform, "device_kind": kind,
+           "achieved_tflops": round(achieved_flops / 1e12, 2),
+           "mfu": round(achieved_flops / peak, 4) if peak else None}
+    if level > 0:
+        rec["bisect_note"] = (
+            "smaller than the level-0 flagship MFU shape because its remote "
+            "compile exceeds every healthy tunnel window; d_model ≥ 512 and "
+            "batch·L ≥ 4096 keep every matmul ≥ 4×4 MXU tiles, so measured "
+            "utilization remains representative of the big shape")
+    return validate_throughput_record(rec)
 
 
 def attention_flops(B: int, H: int, L: int, Dh: int) -> float:
@@ -561,6 +583,33 @@ def _freshest_capture() -> dict | None:
         return None
 
 
+def _freshest_mfu_line(captured: dict | None, src: str | None,
+                       live_error: str | None = None) -> str | None:
+    """JSON line for the best encoder_mfu on record: the newest valid ladder
+    capture (full or mfu-only) from the log, else the passed full capture's
+    own (possibly skipped) record — freshness-stamped either way. When the
+    round's LIVE mfu attempt failed, its error rides along as live_error so
+    a replay can never mask a live regression (mirrors live_probe_error on
+    the encoder replay path)."""
+    try:
+        import os as _os
+
+        import tpu_capture
+
+        src = src or _os.path.basename(tpu_capture.LOG)
+        mfu = tpu_capture.freshest_mfu()
+    except Exception:  # noqa: BLE001
+        mfu = None
+    extra = {"live_error": live_error} if live_error else {}
+    if mfu is not None:
+        return json.dumps({**mfu, **_capture_freshness(mfu.get("ts"), src),
+                           **extra})
+    if captured is not None and captured.get("encoder_mfu"):
+        fresh = _capture_freshness(captured.get("ts"), src)
+        return json.dumps({**captured["encoder_mfu"], **fresh, **extra})
+    return None
+
+
 def _accelerator_benches() -> list[str]:
     """Device-health probe → encoder throughput (retry once) → flash-vs-dense
     sweep. Always returns records — a wedged device yields explicit
@@ -587,8 +636,9 @@ def _accelerator_benches() -> list[str]:
             enc = dict(captured["encoder"])
             enc.update({**fresh, "live_probe_error": reason})
             lines.append(json.dumps(enc))
-            if captured.get("encoder_mfu"):
-                lines.append(json.dumps({**captured["encoder_mfu"], **fresh}))
+            mfu = _freshest_mfu_line(captured, src)
+            if mfu is not None:
+                lines.append(mfu)
             for rec in captured.get("flash_vs_dense") or []:
                 lines.append(json.dumps({**rec, **fresh}))
         else:
@@ -618,8 +668,15 @@ def _accelerator_benches() -> list[str]:
     mfu_code = ("import json, bench; "
                 "print(json.dumps(bench.bench_encoder_mfu()))")
     out, err, _ = _run_child(mfu_code, timeout=420)
-    lines.append(out if err is None else json.dumps(
-        {"metric": "encoder_mfu_large", "skipped": True, "reason": err}))
+    if err is None:
+        lines.append(out)
+    else:
+        # The level-0 compile rarely fits a live window — fall back to the
+        # freshest ladder capture from the round's opportunistic log, with
+        # the live failure preserved on the replayed line.
+        mfu = _freshest_mfu_line(None, None, live_error=err)
+        lines.append(mfu if mfu is not None else json.dumps(
+            {"metric": "encoder_mfu_large", "skipped": True, "reason": err}))
 
     fvd_code = ("import json, bench; "
                 "print(json.dumps(bench.bench_flash_vs_dense()))")
