@@ -318,11 +318,16 @@ def bench_encoder_throughput(batch: int = 256, steps: int = 20,
 # matmul) and batch·L ≥ 4096 rows, so each level CAN saturate the MXU —
 # levels differ in compile+run budget, not in utilization capability. The
 # tunnel wedges in minutes; level 0's remote compile has never fit a
-# healthy window in five rounds of captures.
+# healthy window in five rounds of captures. budget_s is the capture
+# tool's per-level child timeout — kept WITH the shape so the two can
+# never diverge (code-review r5).
 MFU_SHAPES = (
-    dict(seq_len=2048, d_model=1024, n_heads=16, n_layers=12, d_ff=4096),
-    dict(seq_len=1024, d_model=1024, n_heads=16, n_layers=8, d_ff=4096),
-    dict(seq_len=1024, d_model=512, n_heads=8, n_layers=8, d_ff=2048),
+    dict(seq_len=2048, d_model=1024, n_heads=16, n_layers=12, d_ff=4096,
+         budget_s=480),
+    dict(seq_len=1024, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+         budget_s=360),
+    dict(seq_len=1024, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+         budget_s=300),
 )
 
 
@@ -349,7 +354,7 @@ def bench_encoder_mfu(batch: int = 4, steps: int = 3, level: int = 0) -> dict:
         return {"metric": "encoder_mfu_large", "skipped": True,
                 "reason": f"backend={jax.default_backend()} (compute-bound "
                           "MFU config is TPU-only)"}
-    shape = MFU_SHAPES[level]
+    shape = {k: v for k, v in MFU_SHAPES[level].items() if k != "budget_s"}
     cfg = EncoderConfig(**shape, scan_blocks=True)
     sec_per_step = _timed_encoder_scan(cfg, batch, steps)
     tokens_per_s = batch * cfg.seq_len / sec_per_step
@@ -636,7 +641,7 @@ def _accelerator_benches() -> list[str]:
             enc = dict(captured["encoder"])
             enc.update({**fresh, "live_probe_error": reason})
             lines.append(json.dumps(enc))
-            mfu = _freshest_mfu_line(captured, src)
+            mfu = _freshest_mfu_line(captured, src, live_error=reason)
             if mfu is not None:
                 lines.append(mfu)
             for rec in captured.get("flash_vs_dense") or []:

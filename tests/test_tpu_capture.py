@@ -281,8 +281,43 @@ class TestMfuLadder:
     def test_ladder_levels_exist_in_bench(self):
         assert len(bench.MFU_SHAPES) >= 3
         for shape in bench.MFU_SHAPES:
-            # every level stays MXU-utilization-capable
+            # every level stays MXU-utilization-capable, with its budget
+            # attached so ladder and shapes cannot diverge
             assert shape["d_model"] >= 512 and shape["seq_len"] >= 1024
+            assert shape["budget_s"] > 0
+
+    def test_skipped_record_does_not_stop_ladder(self, monkeypatch):
+        """A child that exits 0 but reports skipped (e.g. fell back to CPU
+        mid-wedge) must not terminate the ladder — a fresh connection at the
+        next level may still land."""
+        def fake_child(code, timeout):
+            if "level=0" in code:
+                return (json.dumps({"metric": "encoder_mfu_large",
+                                    "skipped": True,
+                                    "reason": "backend=cpu"}), None, False)
+            return (json.dumps({"metric": "encoder_mfu_large", "mfu": 0.39,
+                                "bisect_level": 1}), None, False)
+
+        monkeypatch.setattr(bench, "_run_child", fake_child)
+        rec = {}
+        tpu_capture._mfu_ladder(rec)
+        assert rec["encoder_mfu"]["mfu"] == 0.39
+        assert rec["encoder_mfu"]["bisect_failures"][0]["error"].startswith(
+            "rejected: backend=cpu")
+
+    def test_invalid_record_does_not_stop_ladder(self, monkeypatch):
+        def fake_child(code, timeout):
+            if "level=0" in code:
+                return (json.dumps({"metric": "encoder_mfu_large", "mfu": 4.4,
+                                    "invalid": True,
+                                    "invalid_reason": "mfu > 1"}), None, False)
+            return (json.dumps({"metric": "encoder_mfu_large", "mfu": 0.41}),
+                    None, False)
+
+        monkeypatch.setattr(bench, "_run_child", fake_child)
+        rec = {}
+        tpu_capture._mfu_ladder(rec)
+        assert rec["encoder_mfu"]["mfu"] == 0.41
 
 
 class TestMfuOnlyMode:
@@ -314,6 +349,22 @@ class TestMfuOnlyMode:
         monkeypatch.setattr(bench, "_run_child", fake_child)
         rec = tpu_capture.attempt_mfu_only(probe_timeout=1)
         assert not rec["ok"] and "L0" in rec["error"]
+        assert not rec.get("deterministic_failure")
+
+    def test_missing_peak_table_is_deterministic_failure(self, monkeypatch):
+        """Valid measurement but no peak-FLOPs entry: retrying cannot help —
+        the hunt loop must be told to stop burning attempts."""
+        def fake_child(code, timeout):
+            if "jax.devices" in code:
+                return ("tpu|TPU weird kind", None, False)
+            return (json.dumps({"metric": "encoder_mfu_large", "value": 9.9e5,
+                                "mfu": None, "device_kind": "TPU weird kind"}),
+                    None, False)
+
+        monkeypatch.setattr(bench, "_run_child", fake_child)
+        rec = tpu_capture.attempt_mfu_only(probe_timeout=1)
+        assert not rec["ok"] and rec["deterministic_failure"]
+        assert "peak-FLOPs" in rec["error"]
 
     def test_mfu_only_never_freshest_success(self, tmp_path):
         log = _write_log(tmp_path, [
@@ -333,6 +384,18 @@ class TestFreshestMfu:
         ])
         mfu = tpu_capture.freshest_mfu(log)
         assert mfu["mfu"] == 0.4 and mfu["ts"] == "t1"
+
+    def test_newest_by_ts_not_file_order(self, tmp_path):
+        """Concurrent writers append out of start order — a slower older
+        capture can land AFTER a newer one in the file."""
+        log = _write_log(tmp_path, [
+            {"ts": "2026-07-30T06:10:00+00:00", "ok": True,
+             "encoder": {"value": 2}, "encoder_mfu": {"mfu": 0.5}},
+            {"ts": "2026-07-30T06:05:00+00:00", "ok": True,
+             "encoder": {"value": 1}, "encoder_mfu": {"mfu": 0.3}},
+        ])
+        assert tpu_capture.freshest_mfu(log)["mfu"] == 0.5
+        assert tpu_capture.freshest_success(log)["encoder"]["value"] == 2
 
     def test_skipped_records_ignored(self, tmp_path):
         log = _write_log(tmp_path, [

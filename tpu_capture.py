@@ -104,26 +104,38 @@ def attempt_capture(probe_timeout: float) -> dict:
     return rec
 
 
-def _mfu_ladder(rec: dict, budgets: tuple = (480, 360, 300)) -> None:
-    """Try bench_encoder_mfu at descending MFU_SHAPES levels; first success
-    wins. Each level runs in a fresh child (fresh tunnel connection) with
-    its own budget; every failed level is recorded so the artifact shows
-    what was attempted, not just the final state (VERDICT r5 bisect)."""
+def _mfu_ladder(rec: dict) -> None:
+    """Try bench_encoder_mfu at descending MFU_SHAPES levels; first VALID
+    success wins. Each level runs in a fresh child (fresh tunnel connection
+    — the codebase's documented wedge remedy) with the budget attached to
+    its shape; every failed level is recorded so the artifact shows what
+    was attempted, not just the final state (VERDICT r5 bisect). A level
+    whose child exits 0 but returns a skipped record (e.g. the child fell
+    back to CPU mid-wedge) or an invalid one (elided work) does NOT stop
+    the ladder — a smaller level on a fresh connection may still land."""
     attempts = []
-    for level, budget in enumerate(budgets):
+    best_reject = None
+    for level, shape in enumerate(bench.MFU_SHAPES):
         code = (f"import json, bench; "
                 f"print(json.dumps(bench.bench_encoder_mfu(level={level})))")
-        out, err, _ = bench._run_child(code, timeout=budget)
-        if err is None:
-            mfu = json.loads(out)
-            if attempts:
-                mfu["bisect_failures"] = attempts
-            rec["encoder_mfu"] = mfu
-            return
-        attempts.append({"level": level, "error": err})
-    rec["encoder_mfu"] = {
+        out, err, _ = bench._run_child(code, timeout=shape["budget_s"])
+        if err is not None:
+            attempts.append({"level": level, "error": err})
+            continue
+        mfu = json.loads(out)
+        if mfu.get("skipped") or mfu.get("invalid"):
+            reason = mfu.get("reason") or mfu.get("invalid_reason") or "?"
+            attempts.append({"level": level, "error": f"rejected: {reason}"})
+            best_reject = mfu
+            continue
+        if attempts:
+            mfu["bisect_failures"] = attempts
+        rec["encoder_mfu"] = mfu
+        return
+    rec["encoder_mfu"] = best_reject if best_reject is not None else {
         "metric": "encoder_mfu_large", "skipped": True,
         "reason": "; ".join(f"L{a['level']}: {a['error']}" for a in attempts)}
+    rec["mfu_attempts"] = attempts
 
 
 def attempt_mfu_only(probe_timeout: float) -> dict:
@@ -136,9 +148,20 @@ def attempt_mfu_only(probe_timeout: float) -> dict:
         return rec
     _mfu_ladder(rec)
     mfu = rec.get("encoder_mfu") or {}
-    rec["ok"] = mfu.get("mfu") is not None and not mfu.get("invalid")
+    rec["ok"] = (mfu.get("mfu") is not None and not mfu.get("invalid")
+                 and not mfu.get("skipped"))
     if not rec["ok"] and not rec.get("error"):
-        rec["error"] = mfu.get("reason") or mfu.get("invalid_reason") or "no mfu"
+        if not mfu.get("skipped") and not mfu.get("invalid") \
+                and mfu.get("value") is not None and mfu.get("mfu") is None:
+            # Valid measurement but no peak-FLOPs table entry for this
+            # device: retrying cannot fix that — tell the loop to stop.
+            rec["error"] = ("mfu unavailable: no peak-FLOPs entry for "
+                            f"device_kind={mfu.get('device_kind')!r} "
+                            "(deterministic — set PALLAS_AXON_TPU_GEN)")
+            rec["deterministic_failure"] = True
+        else:
+            rec["error"] = (mfu.get("reason") or mfu.get("invalid_reason")
+                            or "no mfu")
     return rec
 
 
@@ -162,26 +185,32 @@ def _read_log(log_path: str | None) -> list[dict]:
     return recs
 
 
+def _latest(recs: list[dict]) -> dict | None:
+    """Newest record by ISO-8601 ts (lexicographic = chronological), NOT by
+    file position: concurrent writers append out of start order, so the
+    last line can be an older capture (code-review r5)."""
+    return max(recs, key=lambda r: str(r.get("ts") or "")) if recs else None
+
+
 def freshest_success(log_path: str | None = None) -> dict | None:
-    """Latest ok:true FULL capture (encoder present) from the log, or None."""
-    ok = [r for r in _read_log(log_path)
-          if r.get("ok") and r.get("encoder")
-          and not (r.get("encoder") or {}).get("invalid")]
-    return ok[-1] if ok else None
+    """Newest ok:true FULL capture (encoder present) from the log, or None."""
+    return _latest([r for r in _read_log(log_path)
+                    if r.get("ok") and r.get("encoder")
+                    and not (r.get("encoder") or {}).get("invalid")])
 
 
 def freshest_mfu(log_path: str | None = None) -> dict | None:
-    """Latest valid encoder_mfu record from ANY ok capture (full or
+    """Newest valid encoder_mfu record from ANY ok capture (full or
     mfu-only), stamped with its capture timestamp, or None. Requires the
     capture itself to be ok — a session whose encoder record proved elided
     work (ok:false, VERDICT r3 #1) must not lend out its MFU sub-record."""
-    good = [r for r in _read_log(log_path)
-            if r.get("ok")
-            and (r.get("encoder_mfu") or {}).get("mfu") is not None
-            and not (r.get("encoder_mfu") or {}).get("invalid")]
-    if not good:
+    best = _latest([r for r in _read_log(log_path)
+                    if r.get("ok")
+                    and (r.get("encoder_mfu") or {}).get("mfu") is not None
+                    and not (r.get("encoder_mfu") or {}).get("invalid")])
+    if best is None:
         return None
-    return {**good[-1]["encoder_mfu"], "ts": good[-1]["ts"]}
+    return {**best["encoder_mfu"], "ts": best["ts"]}
 
 
 def main() -> int:
@@ -207,6 +236,9 @@ def main() -> int:
                               "encoder": rec["encoder"],
                               "encoder_mfu": rec.get("encoder_mfu")}))
             return 0
+        if rec.get("deterministic_failure"):
+            print(json.dumps({"captured": False, "aborted": rec["error"]}))
+            return 1
         if i < args.attempts:
             time.sleep(delay)
             if args.sleep is None:
