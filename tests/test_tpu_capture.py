@@ -433,3 +433,23 @@ class TestFreshestMfu:
         line = bench._freshest_mfu_line(None, None)
         rec = json.loads(line)
         assert rec["mfu"] == 0.4 and rec["source"] and "age_hours" in rec
+
+
+class TestDenseSkipAbove:
+    def test_dense_skipped_above_threshold(self, monkeypatch):
+        """Above dense_skip_above, dense is recorded infeasible WITHOUT
+        burning a compile; flash still measures (tiny L on the interpret
+        path keeps this fast)."""
+        import jax
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        out = bench.bench_flash_vs_dense(seq_lens=(128,), steps=2, rounds=2,
+                                         dense_skip_above=100)
+        [rec] = out
+        assert rec["dense_infeasible"] is True
+        assert rec["dense_error_kind"] == "known_infeasible"
+        assert rec["dense_ms"] is None
+        # flash itself cannot lower on the faked backend (real device is
+        # CPU) — the pin here is that dense was never ATTEMPTED, which the
+        # preserved skip note proves (vs. a compile that failed).
+        assert "L=128 > dense_skip_above=100" in rec["dense_infeasible_reason"]

@@ -74,8 +74,12 @@ def attempt_capture(probe_timeout: float) -> dict:
         return rec
     rec["encoder"] = json.loads(out)
 
+    # Capture-time sweep drops L=128: FLASH_SWEEP_r04's own medians show
+    # everything ≤ 1024 sits on the ~6.7 ms dispatch floor (parity, not
+    # signal), and each L costs two remote compiles of a scarce window.
     fvd_code = ("import json, bench; "
-                "print(json.dumps(bench.bench_flash_vs_dense()))")
+                "print(json.dumps(bench.bench_flash_vs_dense("
+                "seq_lens=(2048, 16384))))")
     out, err, timed_out = bench._run_child(fvd_code, timeout=420)
     if timed_out:  # a fresh child gets a fresh tunnel connection — retry once
         out, err, _ = bench._run_child(fvd_code, timeout=420)
