@@ -1,0 +1,331 @@
+"""Boot-context + pre-compaction depth: thread selection/ordering, the
+staleness ladder, every conditional section of BOOTSTRAP.md, char budget,
+hot-snapshot building, and the never-throws pipeline contract (reference:
+cortex/test/{boot-context,pre-compaction}.test.ts — 49 cases; VERDICT r4 #5
+test-depth parity).
+
+Complements test_cortex_trackers.py (happy-path generate/write/staleness).
+"""
+
+import time
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.cortex.boot_context import (
+    BootContextGenerator,
+    get_execution_mode,
+)
+from vainplex_openclaw_tpu.cortex.pre_compaction import (
+    PreCompaction,
+    build_hot_snapshot,
+)
+from vainplex_openclaw_tpu.cortex.storage import reboot_dir
+from vainplex_openclaw_tpu.storage.atomic import write_json_atomic
+
+from helpers import FakeClock
+
+NOW = 1_753_800_000.0  # fixed epoch for all clocks
+
+
+def iso(ts):
+    t = time.gmtime(ts)
+    return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+            f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+
+
+def make_gen(tmp_path, threads=None, integrity="fresh", mood="neutral",
+             decisions=None, config=None, clock=None):
+    clock = clock or FakeClock(NOW)
+    d = reboot_dir(tmp_path)
+    d.mkdir(parents=True, exist_ok=True)
+    data = {"version": 2, "threads": threads or [], "session_mood": mood}
+    if integrity == "fresh":
+        data["integrity"] = {"last_event_timestamp": iso(clock() - 60)}
+    elif isinstance(integrity, (int, float)):  # age in hours
+        data["integrity"] = {
+            "last_event_timestamp": iso(clock() - integrity * 3600)}
+    elif integrity == "garbage":
+        data["integrity"] = {"last_event_timestamp": "not-a-time"}
+    # integrity == "none": omit the block entirely
+    write_json_atomic(d / "threads.json", data)
+    if decisions is not None:
+        write_json_atomic(d / "decisions.json", {"decisions": decisions})
+    return BootContextGenerator(tmp_path, config or {}, list_logger(), clock=clock)
+
+
+def thread(title, priority="medium", status="open", last_activity="", **kw):
+    return {"title": title, "priority": priority, "status": status,
+            "last_activity": last_activity, **kw}
+
+
+class TestExecutionMode:
+    @pytest.mark.parametrize("hour,word", [
+        (6, "Morning"), (11, "Morning"), (12, "Afternoon"), (17, "Afternoon"),
+        (18, "Evening"), (21, "Evening"), (22, "Night"), (2, "Night"),
+        (5, "Night")])
+    def test_mode_by_hour(self, hour, word):
+        assert word in get_execution_mode(hour)
+
+
+class TestThreadSelection:
+    def test_only_open_threads(self, tmp_path):
+        gen = make_gen(tmp_path, threads=[
+            thread("open one"), thread("closed", status="closed"),
+            thread("parked", status="parked")])
+        assert [t["title"] for t in gen.open_threads()] == ["open one"]
+
+    def test_priority_order_high_first(self, tmp_path):
+        gen = make_gen(tmp_path, threads=[
+            thread("low t", priority="low"), thread("high t", priority="high"),
+            thread("med t", priority="medium")])
+        assert [t["title"] for t in gen.open_threads()] == \
+            ["high t", "med t", "low t"]
+
+    def test_recency_breaks_priority_ties(self, tmp_path):
+        gen = make_gen(tmp_path, threads=[
+            thread("older", last_activity="2026-07-28T10:00:00Z"),
+            thread("newer", last_activity="2026-07-29T10:00:00Z")])
+        assert [t["title"] for t in gen.open_threads()] == ["newer", "older"]
+
+    def test_unknown_priority_sorts_last(self, tmp_path):
+        gen = make_gen(tmp_path, threads=[
+            thread("mystery", priority="???"), thread("low t", priority="low")])
+        assert [t["title"] for t in gen.open_threads()] == ["low t", "mystery"]
+
+    def test_max_threads_cap(self, tmp_path):
+        gen = make_gen(tmp_path, config={"maxThreads": 3},
+                       threads=[thread(f"t{i}") for i in range(8)])
+        assert len(gen.open_threads()) == 3
+
+    def test_missing_threads_file(self, tmp_path):
+        d = reboot_dir(tmp_path)
+        d.mkdir(parents=True, exist_ok=True)
+        gen = BootContextGenerator(tmp_path, {}, list_logger(),
+                                   clock=FakeClock(NOW))
+        assert gen.open_threads() == []
+
+    def test_bare_list_threads_file(self, tmp_path):
+        d = reboot_dir(tmp_path)
+        d.mkdir(parents=True, exist_ok=True)
+        write_json_atomic(d / "threads.json", [thread("legacy shape")])
+        gen = BootContextGenerator(tmp_path, {}, list_logger(),
+                                   clock=FakeClock(NOW))
+        assert [t["title"] for t in gen.open_threads()] == ["legacy shape"]
+
+
+class TestStalenessLadder:
+    def test_no_integrity_block_warns(self, tmp_path):
+        gen = make_gen(tmp_path, integrity="none")
+        assert "No integrity data" in gen.integrity_warning()
+
+    def test_fresh_data_no_warning(self, tmp_path):
+        gen = make_gen(tmp_path, integrity="fresh")
+        assert gen.integrity_warning() == ""
+
+    def test_under_two_hours_clean(self, tmp_path):
+        gen = make_gen(tmp_path, integrity=1.5)
+        assert gen.integrity_warning() == ""
+
+    def test_over_two_hours_soft_warning(self, tmp_path):
+        gen = make_gen(tmp_path, integrity=3)
+        w = gen.integrity_warning()
+        assert w.startswith("⚠️") and "3h old" in w
+
+    def test_over_eight_hours_stale_alarm(self, tmp_path):
+        gen = make_gen(tmp_path, integrity=12)
+        w = gen.integrity_warning()
+        assert w.startswith("🚨 STALE DATA") and "12h old" in w
+
+    def test_unparseable_timestamp_warns(self, tmp_path):
+        gen = make_gen(tmp_path, integrity="garbage")
+        assert "Could not parse" in gen.integrity_warning()
+
+
+class TestGenerateSections:
+    def test_header_and_mode_always_present(self, tmp_path):
+        out = make_gen(tmp_path).generate()
+        assert out.startswith("# BOOTSTRAP — session context")
+        assert "**Execution mode:**" in out
+
+    def test_mood_line_with_emoji(self, tmp_path):
+        out = make_gen(tmp_path, mood="frustrated").generate()
+        assert "😤 frustrated" in out
+
+    def test_thread_lines_with_waiting_and_decisions(self, tmp_path):
+        out = make_gen(tmp_path, threads=[
+            thread("db migration", priority="high", waiting_for="review",
+                   decisions=["a", "b"])]).generate()
+        assert "## Open threads" in out
+        assert "🔴 **db migration** — ⏳ waiting: review (2 decisions)" in out
+
+    def test_no_threads_section_when_empty(self, tmp_path):
+        assert "## Open threads" not in make_gen(tmp_path).generate()
+
+    def test_decisions_section_with_why(self, tmp_path):
+        out = make_gen(tmp_path, decisions=[
+            {"what": "use jax", "why": "tpu", "date": iso(NOW)[:10]}]).generate()
+        assert "## Decisions" in out and "- use jax — because tpu" in out
+
+    def test_old_decisions_excluded(self, tmp_path):
+        out = make_gen(tmp_path, decisions=[
+            {"what": "ancient", "date": "2020-01-01"},
+            {"what": "recent", "date": iso(NOW)[:10]}]).generate()
+        assert "recent" in out and "ancient" not in out
+
+    def test_max_decisions_cap_keeps_newest(self, tmp_path):
+        decisions = [{"what": f"d{i}", "date": iso(NOW)[:10]} for i in range(15)]
+        out = make_gen(tmp_path, decisions=decisions,
+                       config={"maxDecisions": 5}).generate()
+        assert "- d14" in out and "- d9" not in out
+
+    def test_hot_snapshot_included_when_fresh(self, tmp_path):
+        gen = make_gen(tmp_path)
+        path = reboot_dir(tmp_path) / "hot-snapshot.md"
+        path.write_text("recent context here")
+        assert "## Hot snapshot" in gen.generate()
+
+    def test_hot_snapshot_excluded_when_old(self, tmp_path):
+        gen = make_gen(tmp_path)
+        path = reboot_dir(tmp_path) / "hot-snapshot.md"
+        path.write_text("old context")
+        import os
+        os.utime(path, (NOW - 7200, NOW - 7200))  # 2h > 1h cutoff
+        assert "## Hot snapshot" not in gen.generate()
+
+    def test_narrative_included_when_fresh(self, tmp_path):
+        gen = make_gen(tmp_path)
+        (reboot_dir(tmp_path) / "narrative.md").write_text("the story so far")
+        out = gen.generate()
+        assert "## Narrative" in out and "the story so far" in out
+
+    def test_narrative_excluded_when_over_36h(self, tmp_path):
+        gen = make_gen(tmp_path)
+        path = reboot_dir(tmp_path) / "narrative.md"
+        path.write_text("stale story")
+        import os
+        os.utime(path, (NOW - 37 * 3600, NOW - 37 * 3600))
+        assert "## Narrative" not in gen.generate()
+
+    def test_char_budget_truncates(self, tmp_path):
+        threads = [thread("t" * 200, last_activity=str(i)) for i in range(10)]
+        out = make_gen(tmp_path, threads=threads,
+                       config={"maxChars": 500}).generate()
+        assert len(out) == 500
+
+    def test_within_budget_not_truncated(self, tmp_path):
+        out = make_gen(tmp_path).generate()
+        assert len(out) < 16_000
+
+    def test_empty_state_still_valid(self, tmp_path):
+        d = reboot_dir(tmp_path)
+        d.mkdir(parents=True, exist_ok=True)
+        gen = BootContextGenerator(tmp_path, {}, list_logger(),
+                                   clock=FakeClock(NOW))
+        out = gen.generate()
+        assert out.startswith("# BOOTSTRAP") and "No integrity data" in out
+
+    def test_write_creates_bootstrap_md(self, tmp_path):
+        gen = make_gen(tmp_path)
+        assert gen.write() is True
+        content = (reboot_dir(tmp_path) / "BOOTSTRAP.md").read_text()
+        assert content.startswith("# BOOTSTRAP")
+
+    def test_write_overwrites_previous(self, tmp_path):
+        gen = make_gen(tmp_path, mood="excited")
+        path = reboot_dir(tmp_path) / "BOOTSTRAP.md"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text("old bootstrap")
+        gen.write()
+        text = path.read_text()
+        assert "old bootstrap" not in text and "🚀 excited" in text
+
+
+class TestHotSnapshot:
+    def test_markdown_from_messages(self):
+        out = build_hot_snapshot([
+            {"role": "user", "content": "fix the bug"},
+            {"role": "assistant", "content": "done"}], 15, FakeClock(NOW))
+        assert out.startswith("# Hot Snapshot")
+        assert "- [user] fix the bug" in out and "- [assistant] done" in out
+
+    def test_empty_messages_placeholder(self):
+        out = build_hot_snapshot([], 15, FakeClock(NOW))
+        assert "(No recent messages captured)" in out
+
+    def test_long_content_truncated_at_200(self):
+        out = build_hot_snapshot([{"role": "user", "content": "x" * 300}],
+                                 15, FakeClock(NOW))
+        assert "x" * 200 + "..." in out and "x" * 201 not in out
+
+    def test_takes_last_n_messages(self):
+        messages = [{"role": "user", "content": f"m{i}"} for i in range(20)]
+        out = build_hot_snapshot(messages, 5, FakeClock(NOW))
+        assert "m19" in out and "m14" not in out
+
+    def test_missing_role_and_content_safe(self):
+        out = build_hot_snapshot([{}], 15, FakeClock(NOW))
+        assert "- [?]" in out
+
+
+class TestPreCompactionPipeline:
+    class FlushTracker:
+        def __init__(self, fail=False):
+            self.fail = fail
+            self.flushed = 0
+
+        def flush(self):
+            if self.fail:
+                raise RuntimeError("flush broke")
+            self.flushed += 1
+
+    def run(self, tmp_path, config=None, messages=None, **trackers):
+        pc = PreCompaction(tmp_path, config or {}, list_logger(),
+                           trackers.get("thread") or self.FlushTracker(),
+                           decision_tracker=trackers.get("decision"),
+                           commitment_tracker=trackers.get("commitment"),
+                           clock=FakeClock(NOW))
+        return pc.run(compacting_messages=messages)
+
+    def test_empty_workspace_no_errors(self, tmp_path):
+        result = self.run(tmp_path)
+        assert result.warnings == [] and result.messages_snapshotted == 0
+
+    def test_creates_all_three_artifacts(self, tmp_path):
+        self.run(tmp_path, messages=[{"role": "user", "content": "hello"}])
+        d = reboot_dir(tmp_path)
+        assert (d / "hot-snapshot.md").exists()
+        assert (d / "narrative.md").exists()
+        assert (d / "BOOTSTRAP.md").exists()
+
+    def test_messages_snapshotted_count_capped(self, tmp_path):
+        messages = [{"role": "user", "content": f"m{i}"} for i in range(30)]
+        result = self.run(tmp_path, messages=messages)
+        assert result.messages_snapshotted == 15  # default cap
+
+    def test_custom_snapshot_cap(self, tmp_path):
+        messages = [{"role": "user", "content": f"m{i}"} for i in range(30)]
+        result = self.run(tmp_path, messages=messages,
+                          config={"preCompaction": {"maxSnapshotMessages": 4}})
+        assert result.messages_snapshotted == 4
+
+    def test_all_trackers_flushed(self, tmp_path):
+        t, d, c = (self.FlushTracker() for _ in range(3))
+        self.run(tmp_path, thread=t, decision=d, commitment=c)
+        assert (t.flushed, d.flushed, c.flushed) == (1, 1, 1)
+
+    def test_failed_flush_is_warning_not_abort(self, tmp_path):
+        bad = self.FlushTracker(fail=True)
+        result = self.run(tmp_path, thread=bad,
+                          messages=[{"role": "user", "content": "x"}])
+        assert any("thread flush failed" in w for w in result.warnings)
+        # pipeline continued: snapshot still written
+        assert (reboot_dir(tmp_path) / "hot-snapshot.md").exists()
+
+    def test_narrative_disabled_skips_file(self, tmp_path):
+        self.run(tmp_path, config={"narrative": {"enabled": False}})
+        assert not (reboot_dir(tmp_path) / "narrative.md").exists()
+
+    def test_boot_context_disabled_skips_file(self, tmp_path):
+        self.run(tmp_path, config={"bootContext": {"enabled": False}})
+        assert not (reboot_dir(tmp_path) / "BOOTSTRAP.md").exists()

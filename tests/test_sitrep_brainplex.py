@@ -168,7 +168,8 @@ class TestBrainplexInit:
                         home=tmp_path / "nohome", out=out)
         assert code == 0
         gov = read_json(root / "plugins" / "governance" / "config.json")
-        assert gov["trust"]["defaults"]["main"] == 30
+        # name-heuristic seeding (configurator.ts:11-18): "main" → 60
+        assert gov["trust"]["defaults"]["main"] == 60
         merged = read_json(root / "openclaw.json")
         assert set(merged["plugins"]) >= {"governance", "cortex", "eventstore",
                                           "knowledge-engine", "sitrep"}

@@ -20,6 +20,39 @@ def detect_timezone() -> str:
         return "UTC"
 
 
+# Name-heuristic trust seeding (reference: brainplex/src/configurator.ts:11-31).
+# Case-insensitive substring match; FIRST matching row wins, so "admin-forge"
+# seeds 70, not 45. Unmatched named agents get 40; the wildcard floor is 10.
+# Security note: the name is chosen by whoever registers the agent, and a 70
+# seed puts a fresh session (seedFactor 0.8 → 56) above the output gate's
+# blockBelow=40 — an operator who does not want name-granted trust should
+# edit the generated defaults after init. Ported as-is for reference parity;
+# these are bootstrap DEFAULTS for a human-reviewed config, not runtime
+# trust, which only ever moves via earned signals (governance/trust.py).
+_TRUST_HEURISTICS = (
+    (("admin", "root"), 70.0),
+    (("main",), 60.0),
+    (("review", "cerberus"), 50.0),
+    (("forge", "build"), 45.0),
+)
+
+
+def compute_trust_score(agent_name: str) -> float:
+    name = agent_name.lower()
+    if name == "*":
+        return 10.0
+    for needles, score in _TRUST_HEURISTICS:
+        if any(n in name for n in needles):
+            return score
+    return 40.0
+
+
+def build_trust_defaults(agents: list[str]) -> dict[str, float]:
+    defaults = {agent: compute_trust_score(agent) for agent in agents}
+    defaults["*"] = 10.0  # always include the wildcard floor
+    return defaults
+
+
 def default_config_for(plugin_id: str, agents: Optional[list[str]] = None) -> dict:
     agents = agents or []
     if plugin_id == "governance":
@@ -30,7 +63,7 @@ def default_config_for(plugin_id: str, agents: Optional[list[str]] = None) -> di
             "builtinPolicies": {"credentialGuard": True, "productionSafeguard": True,
                                 "rateLimiter": {"maxPerMinute": 15}, "nightMode": False},
             "trust": {"enabled": True,
-                      "defaults": {**{a: 30 for a in agents}, "*": 10}},
+                      "defaults": build_trust_defaults(agents)},
             "redaction": {"enabled": True},
         }
     if plugin_id == "cortex":
