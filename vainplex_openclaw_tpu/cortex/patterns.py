@@ -258,27 +258,64 @@ def resolve_language_codes(selection) -> list[str]:
     return [c for c in selection if c in PACKS]
 
 
+def _compile_custom(patterns: object) -> list[re.Pattern]:
+    """Compile custom user regexes: non-strings are filtered, invalid
+    regexes are silently skipped (reference registry.ts — a bad custom
+    pattern must not take down the builtins)."""
+    out = []
+    for p in patterns if isinstance(patterns, (list, tuple)) else []:
+        if not isinstance(p, str):
+            continue
+        try:
+            out.append(re.compile(p, re.IGNORECASE))
+        except re.error:
+            continue
+    return out
+
+
 class MergedPatterns:
-    """Pre-compiled merged view over the selected packs + custom patterns."""
+    """Pre-compiled merged view over the selected packs + custom patterns.
+
+    ``custom`` may carry per-category regex lists (``decision``/``close``/
+    ``wait``/``topic``), extra ``blacklist`` words and ``keywords``, and a
+    ``mode``: ``"extend"`` (default — customs append to the builtins) or
+    ``"override"`` (a category with at least one VALID custom pattern
+    replaces the builtin set for that category; empty or all-invalid custom
+    lists leave the builtins alone). Reference: cortex patterns-custom
+    semantics (patterns-registry.ts / patterns-custom.test.ts)."""
 
     def __init__(self, codes: list[str], custom: Optional[dict] = None):
         self.codes = [c for c in codes if c in PACKS]
         packs = [PACKS[c] for c in self.codes]
         custom = custom or {}
+        override = custom.get("mode") == "override"
 
         def compile_all(attr: str) -> list[re.Pattern]:
+            compiled_custom = _compile_custom(custom.get(attr, []))
+            if override and compiled_custom:
+                return compiled_custom
             out = []
             for pack in packs:
                 out += [re.compile(p, pack.flags) for p in getattr(pack, attr)]
-            out += [re.compile(p, re.IGNORECASE) for p in custom.get(attr, [])]
-            return out
+            return out + compiled_custom
 
         self.decision = compile_all("decision")
         self.close = compile_all("close")
         self.wait = compile_all("wait")
         self.topic = compile_all("topic")
+        def custom_words(key: str) -> list[str]:
+            # a bare string here is a config mistake, not a word list —
+            # iterating it would add single letters (same non-list guard as
+            # _compile_custom)
+            raw = custom.get(key, [])
+            if not isinstance(raw, (list, tuple)):
+                return []
+            return [w.lower() for w in raw if isinstance(w, str)]
+
         self.topic_blacklist = {w.lower() for pack in packs for w in pack.topic_blacklist}
+        self.topic_blacklist |= set(custom_words("blacklist"))
         self.high_impact = [w.lower() for pack in packs for w in pack.high_impact]
+        self.high_impact += custom_words("keywords")
         self.noise_prefixes = {w.lower() for pack in packs for w in pack.noise_prefixes}
         self.moods: dict[str, list[re.Pattern]] = {m: [] for m in MOODS}
         for mood, base in BASE_MOODS.items():
@@ -295,12 +332,16 @@ class MergedPatterns:
 
     def is_noise_topic(self, topic: str) -> bool:
         t = topic.strip().lower()
-        if len(t) < 3:
-            return True
+        if len(t) < 3 or len(t) > 60:
+            return True  # fragments and run-on captures are never topics
+        if "\n" in t:
+            return True  # a capture spanning lines grabbed prose, not a topic
         if t in self.topic_blacklist:
             return True
-        first = t.split()[0] if t.split() else t
-        return first in self.noise_prefixes
+        words = t.split()  # non-empty: len(t) >= 3 on a stripped string
+        if all(w in self.topic_blacklist for w in words):
+            return True  # "that something" — all-blacklisted multi-word
+        return words[0] in self.noise_prefixes
 
     def infer_priority(self, text: str) -> str:
         lower = text.lower()
