@@ -151,6 +151,29 @@ class TestCustomPatternsHygiene:
         assert merged.infer_priority("hello world") == "medium"
         assert not merged.is_noise_topic("ink pot")  # 'i'/'t' not blacklisted
 
+    def test_empty_string_entries_filtered(self):
+        # '' in keywords would match EVERY message; '' as a custom regex
+        # compiles to match-everything and would hijack override mode
+        merged = MergedPatterns(["en"], {"keywords": [""], "blacklist": [""],
+                                         "mode": "override", "decision": [""]})
+        assert merged.infer_priority("hello world") == "medium"
+        assert any(rx.search("we decided to go") for rx in merged.decision)
+
+    def test_invalid_custom_regex_warned(self):
+        log = list_logger()
+        MergedPatterns(["en"], {"decision": ["(unclosed"]}, logger=log)
+        assert any("custom decision pattern" in m and "rejected" in m
+                   for m in log.messages("warn"))
+
+    def test_cjk_two_char_topics_not_noise(self):
+        zh = MergedPatterns(["zh"])
+        ko = MergedPatterns(["ko"])
+        assert not zh.is_noise_topic("安全")   # security
+        assert not zh.is_noise_topic("部署")   # deploy
+        assert not ko.is_noise_topic("보안")   # security
+        assert zh.is_noise_topic("安")         # single char stays noise
+        assert zh.is_noise_topic("这个")       # blacklisted 2-char still noise
+
 
 class TestLanguageResolution:
     @pytest.mark.parametrize("selection,expected", [

@@ -1,0 +1,509 @@
+"""Validation subsystem depth: each claim detector's positive/negative
+matrix, the fact-checker verdict table, the trace-to-facts bridge, the LLM
+validator's cache/retry/fail-mode machinery, and the response gate's three
+validators with fallback templating (reference: governance/test/
+{claim-detector,fact-checker,llm-validator,response-gate,
+trace-to-facts-bridge,unverified-claims}.test.ts — 161 cases; VERDICT r4 #5
+test-depth parity).
+
+Complements test_governance_validation.py (output-validator wiring) and
+test_governance_integration_deep.py (pipeline-level verdicts).
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.governance.validation.claims import (
+    detect_claims,
+    detect_entity_name,
+    detect_existence,
+    detect_operational_status,
+    detect_self_referential,
+    detect_system_state,
+)
+from vainplex_openclaw_tpu.governance.validation.facts import (
+    Fact,
+    FactRegistry,
+    check_claims,
+    extract_facts_from_trace_report,
+)
+from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+    CACHE_TTL_S,
+    LlmValidator,
+    build_prompt,
+    djb2,
+    parse_response,
+)
+from vainplex_openclaw_tpu.governance.validation.response_gate import (
+    DEFAULT_FALLBACK,
+    ResponseGate,
+)
+from vainplex_openclaw_tpu.storage.atomic import write_json_atomic
+
+from helpers import FakeClock
+
+
+class TestSystemStateDetector:
+    @pytest.mark.parametrize("text,subject,value", [
+        ("nginx is running on port 80", "nginx", "running"),
+        ("the postgres-primary is stopped", "postgres-primary", "stopped"),
+        ("api.gateway:8080 is offline", "api.gateway:8080", "offline"),
+        ("redis is healthy after restart", "redis", "healthy"),
+        ("scheduler is paused for maintenance", "scheduler", "paused"),
+        ("worker-3 is down", "worker-3", "down"),
+    ])
+    def test_positives(self, text, subject, value):
+        [c] = detect_system_state(text)
+        assert (c.subject, c.predicate, c.value) == (subject, "state", value)
+
+    @pytest.mark.parametrize("text", [
+        "it is running", "everything is down", "they are stopped",
+        "the system is active", "something is offline"])
+    def test_common_word_subjects_filtered(self, text):
+        assert detect_system_state(text) == []
+
+    def test_no_state_verbs_no_claims(self):
+        assert detect_system_state("nginx serves traffic quickly") == []
+
+    def test_multiple_claims_in_one_text(self):
+        claims = detect_system_state("nginx is running and redis is stopped")
+        assert [(c.subject, c.value) for c in claims] == [
+            ("nginx", "running"), ("redis", "stopped")]
+
+    def test_case_insensitive_value_normalized(self):
+        [c] = detect_system_state("Nginx is RUNNING")
+        assert c.value == "running"
+
+
+class TestEntityNameDetector:
+    @pytest.mark.parametrize("text,subject,etype", [
+        ("the service named billing-api failed", "billing-api", "service"),
+        ("the container called web-1 restarted", "web-1", "container"),
+        ('the agent "forge" spawned', "forge", "agent"),
+        ("the database known as ledger is big", "ledger", "database"),
+    ])
+    def test_positives(self, text, subject, etype):
+        claims = detect_entity_name(text)
+        assert claims and claims[0].subject == subject
+        assert claims[0].value == etype and claims[0].predicate == "entity_type"
+
+    def test_plain_prose_no_entities(self):
+        assert detect_entity_name("we deployed some changes today") == []
+
+
+class TestExistenceDetector:
+    @pytest.mark.parametrize("text,subject,value", [
+        ("prod-01 exists in the fleet", "prod-01", "true"),
+        ("backup-volume is configured", "backup-volume", "true"),
+        ("grafana is installed on the host", "grafana", "true"),
+        ("legacy-queue does not exist", "legacy-queue", "false"),
+        ("the-cache is not configured", "the-cache", "false"),
+    ])
+    def test_positives(self, text, subject, value):
+        claims = detect_existence(text)
+        assert claims and (claims[0].subject, claims[0].value) == (subject, value)
+
+    def test_common_word_subject_filtered(self):
+        assert detect_existence("it exists somewhere") == []
+
+
+class TestOperationalStatusDetector:
+    @pytest.mark.parametrize("text,subject,op", [
+        ("deploy-job completed at noon", "deploy-job", "completed"),
+        ("health-check failed twice", "health-check", "failed"),
+        ("worker-2 crashed overnight", "worker-2", "crashed"),
+        ("gateway timed out", "gateway", "timed out"),
+        ("db-primary rebooted cleanly", "db-primary", "rebooted"),
+    ])
+    def test_positives(self, text, subject, op):
+        claims = detect_operational_status(text)
+        assert claims and claims[0].subject == subject
+        assert claims[0].value.startswith(op)
+
+    def test_common_word_filtered(self):
+        assert detect_operational_status("it failed again") == []
+
+
+class TestSelfReferentialDetector:
+    @pytest.mark.parametrize("text", [
+        "I am the governance engine",
+        "I have already emailed the customer",
+        "I can access production directly",
+        "I will deploy this tonight",
+        "I did run the migration",
+    ])
+    def test_positives(self, text):
+        claims = detect_self_referential(text)
+        assert claims and claims[0].subject == "self"
+        assert claims[0].type == "self_referential"
+
+    def test_plain_first_person_without_capability_verb(self):
+        assert detect_self_referential("I think so") == []
+
+
+class TestDetectClaims:
+    def test_enabled_detectors_filter(self):
+        text = "nginx is running. I am the engine."
+        only_state = detect_claims(text, ["system_state"])
+        assert {c.type for c in only_state} == {"system_state"}
+        both = detect_claims(text, ["system_state", "self_referential"])
+        assert {c.type for c in both} == {"system_state", "self_referential"}
+
+    def test_unknown_detector_id_ignored(self):
+        assert detect_claims("nginx is running", ["bogus"]) == []
+
+    def test_claims_sorted_by_offset(self):
+        claims = detect_claims("I am here. nginx is running.")
+        assert [c.offset for c in claims] == sorted(c.offset for c in claims)
+
+    def test_default_runs_all_detectors(self):
+        text = ("nginx is running. the service named api failed. "
+                "prod-01 exists. deploy-job completed. I am the engine.")
+        types = {c.type for c in detect_claims(text)}
+        assert types == {"system_state", "entity_name", "existence",
+                         "operational_status", "self_referential"}
+
+
+def claim_for(subject="nginx", predicate="state", value="running"):
+    from vainplex_openclaw_tpu.governance.validation.claims import Claim
+
+    return Claim("system_state", subject, predicate, value,
+                 f"{subject} is {value}", 0)
+
+
+class TestFactChecker:
+    def registry(self, *facts):
+        return FactRegistry([dict(f) for f in facts], list_logger())
+
+    def test_verified_when_values_match(self):
+        reg = self.registry({"subject": "nginx", "predicate": "state",
+                             "value": "running"})
+        [res] = check_claims([claim_for()], reg)
+        assert res.status == "verified" and res.fact.value == "running"
+
+    def test_contradicted_when_values_differ(self):
+        reg = self.registry({"subject": "nginx", "predicate": "state",
+                             "value": "stopped"})
+        [res] = check_claims([claim_for()], reg)
+        assert res.status == "contradicted" and res.fact.value == "stopped"
+
+    def test_unverified_when_no_fact(self):
+        [res] = check_claims([claim_for()], self.registry())
+        assert res.status == "unverified" and res.fact is None
+
+    def test_lookup_case_insensitive(self):
+        reg = self.registry({"subject": "NGINX", "predicate": "State",
+                             "value": "running"})
+        [res] = check_claims([claim_for(subject="nginx")], reg)
+        assert res.status == "verified"
+
+    def test_value_comparison_case_insensitive(self):
+        reg = self.registry({"subject": "nginx", "predicate": "state",
+                             "value": "RUNNING"})
+        [res] = check_claims([claim_for(value="running")], reg)
+        assert res.status == "verified"
+
+    def test_numeric_values_stringified(self):
+        reg = self.registry({"subject": "nats-events", "predicate": "count",
+                             "value": 255908})
+        fact = reg.lookup("nats-events", "count")
+        assert fact.value == "255908"
+
+    def test_add_fact_overwrites_same_key(self):
+        reg = self.registry({"subject": "nginx", "predicate": "state",
+                             "value": "running"})
+        reg.add_fact(Fact("nginx", "state", "stopped"))
+        assert reg.lookup("nginx", "state").value == "stopped"
+        assert len(reg.all_facts()) == 1
+
+    def test_mixed_statuses_in_one_batch(self):
+        reg = self.registry({"subject": "nginx", "predicate": "state",
+                             "value": "running"},
+                            {"subject": "redis", "predicate": "state",
+                             "value": "stopped"})
+        claims = [claim_for(), claim_for(subject="redis", value="running"),
+                  claim_for(subject="mystery")]
+        statuses = [r.status for r in check_claims(claims, reg)]
+        assert statuses == ["verified", "contradicted", "unverified"]
+
+
+class TestFactFiles:
+    def test_load_dict_format(self, tmp_path):
+        p = tmp_path / "facts.json"
+        write_json_atomic(p, {"facts": [
+            {"subject": "a", "predicate": "p", "value": "v"},
+            {"subject": "b", "predicate": "p", "value": 2}]})
+        reg = FactRegistry([], list_logger())
+        assert reg.load_facts_from_file(p) == 2
+        assert reg.lookup("b", "p").value == "2"
+
+    def test_load_bare_list_format(self, tmp_path):
+        p = tmp_path / "facts.json"
+        write_json_atomic(p, [{"subject": "a", "predicate": "p", "value": "v"}])
+        reg = FactRegistry([], list_logger())
+        assert reg.load_facts_from_file(p) == 1
+
+    def test_missing_file_warns_returns_zero(self, tmp_path):
+        log = list_logger()
+        reg = FactRegistry([], log)
+        assert reg.load_facts_from_file(tmp_path / "nope.json") == 0
+        assert any("unreadable" in m for m in log.messages("warn"))
+
+    def test_malformed_entries_skipped(self, tmp_path):
+        p = tmp_path / "facts.json"
+        write_json_atomic(p, {"facts": [
+            {"subject": "good", "predicate": "p", "value": "v"},
+            {"subject": "missing-value"}, "not-a-dict"]})
+        reg = FactRegistry([], list_logger())
+        assert reg.load_facts_from_file(p) == 1
+
+    def test_file_source_recorded(self, tmp_path):
+        p = tmp_path / "facts.json"
+        write_json_atomic(p, [{"subject": "a", "predicate": "p", "value": "v"}])
+        reg = FactRegistry([], list_logger())
+        reg.load_facts_from_file(p)
+        assert str(p) in reg.lookup("a", "p").source
+
+
+class TestTraceToFactsBridge:
+    def report(self, tmp_path, findings):
+        p = tmp_path / "report.json"
+        write_json_atomic(p, {"findings": findings})
+        return p
+
+    def test_extracts_fact_corrections(self, tmp_path):
+        p = self.report(tmp_path, [{
+            "signal": "hallucination", "confidence": 0.9,
+            "factCorrection": {"subject": "nginx", "predicate": "state",
+                               "value": "stopped"}}])
+        [fact] = extract_facts_from_trace_report(p)
+        assert fact["subject"] == "nginx" and fact["value"] == "stopped"
+        assert fact["source"] == "trace-analyzer:hallucination"
+        assert fact["confidence"] == 0.9
+
+    def test_snake_case_key_accepted(self, tmp_path):
+        p = self.report(tmp_path, [{
+            "id": "f1",
+            "fact_correction": {"subject": "s", "predicate": "p", "value": 1}}])
+        [fact] = extract_facts_from_trace_report(p)
+        assert fact["value"] == "1" and fact["source"] == "trace-analyzer:f1"
+
+    def test_findings_without_corrections_skipped(self, tmp_path):
+        p = self.report(tmp_path, [
+            {"signal": "doomLoop"}, {"factCorrection": "not-a-dict"},
+            {"factCorrection": {"subject": "s", "predicate": "p"}}])  # no value
+        assert extract_facts_from_trace_report(p) == []
+
+    def test_missing_report_empty(self, tmp_path):
+        assert extract_facts_from_trace_report(tmp_path / "none.json") == []
+
+    def test_default_confidence(self, tmp_path):
+        p = self.report(tmp_path, [{
+            "factCorrection": {"subject": "s", "predicate": "p", "value": "v"}}])
+        [fact] = extract_facts_from_trace_report(p)
+        assert fact["confidence"] == 0.8
+
+    def test_bridge_output_loadable_by_registry(self, tmp_path):
+        p = self.report(tmp_path, [{
+            "signal": "correction",
+            "factCorrection": {"subject": "api", "predicate": "state",
+                               "value": "down"}}])
+        facts = extract_facts_from_trace_report(p)
+        facts_file = tmp_path / "bridged.json"
+        write_json_atomic(facts_file, {"facts": facts})
+        reg = FactRegistry([], list_logger())
+        assert reg.load_facts_from_file(facts_file) == 1
+        assert reg.lookup("api", "state").value == "down"
+
+
+GOOD_LLM = ('{"verdict": "flag", "reason": "overstated", '
+            '"issues": [{"category": "exaggeration", "detail": "billions"}]}')
+
+
+class TestLlmValidatorMachinery:
+    def make(self, responses, fail_mode="open"):
+        calls = []
+
+        def call(prompt):
+            calls.append(prompt)
+            r = responses[min(len(calls) - 1, len(responses) - 1)]
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+        self.calls = calls
+        self.clock = FakeClock()
+        self.log = list_logger()
+        return LlmValidator(call, self.log, fail_mode=fail_mode, clock=self.clock)
+
+    def test_verdict_and_issues_surface(self):
+        v = self.make([GOOD_LLM])
+        result = v.validate("we process billions", [])
+        assert result.verdict == "flag" and result.reason == "overstated"
+        assert result.issues[0]["category"] == "exaggeration"
+        assert not result.from_cache
+
+    def test_cache_hit_within_ttl(self):
+        v = self.make([GOOD_LLM])
+        v.validate("same text", [])
+        result = v.validate("same text", [])
+        assert result.from_cache and len(self.calls) == 1
+
+    def test_cache_expires_after_ttl(self):
+        v = self.make([GOOD_LLM])
+        v.validate("same text", [])
+        self.clock.advance(CACHE_TTL_S + 1)
+        result = v.validate("same text", [])
+        assert not result.from_cache and len(self.calls) == 2
+
+    def test_different_text_different_cache_key(self):
+        v = self.make([GOOD_LLM])
+        v.validate("text one", [])
+        v.validate("text two", [])
+        assert len(self.calls) == 2
+
+    def test_one_retry_on_exception_then_success(self):
+        v = self.make([RuntimeError("flaky"), GOOD_LLM])
+        result = v.validate("text", [])
+        assert result.verdict == "flag" and len(self.calls) == 2
+
+    def test_one_retry_on_unparseable_then_success(self):
+        v = self.make(["garbage output", GOOD_LLM])
+        assert v.validate("text", []).verdict == "flag"
+
+    def test_two_failures_fail_open(self):
+        v = self.make([RuntimeError("down"), RuntimeError("down")])
+        result = v.validate("text", [])
+        assert result.verdict == "pass" and "open-fail" in result.reason
+
+    def test_two_failures_fail_closed(self):
+        v = self.make(["junk", "junk"], fail_mode="closed")
+        result = v.validate("text", [])
+        assert result.verdict == "block" and "closed-fail" in result.reason
+
+    def test_failure_result_cached_too(self):
+        v = self.make([RuntimeError("down"), RuntimeError("down")])
+        v.validate("text", [])
+        result = v.validate("text", [])
+        assert result.from_cache and len(self.calls) == 2
+
+    def test_prompt_carries_facts_and_message(self):
+        v = self.make([GOOD_LLM])
+        v.validate("the message body", [Fact("nats", "count", "255908")])
+        prompt = self.calls[0]
+        assert "- nats count: 255908" in prompt
+        assert "the message body" in prompt
+        assert "Corporate Communications Fact-Checker" in prompt
+
+    def test_prompt_without_facts_placeholder(self):
+        assert "- (none)" in build_prompt("msg", [])
+
+
+class TestLlmResponseParsing:
+    def test_fenced_json_accepted(self):
+        parsed = parse_response('```json\n{"verdict": "pass"}\n```')
+        assert parsed["verdict"] == "pass"
+
+    @pytest.mark.parametrize("raw", [
+        "not json", '{"verdict": "maybe"}', '{"no_verdict": 1}', ""])
+    def test_invalid_rejected(self, raw):
+        assert parse_response(raw) is None
+
+    def test_unknown_issue_categories_filtered(self):
+        parsed = parse_response(
+            '{"verdict": "flag", "issues": ['
+            '{"category": "exaggeration", "detail": "d"}, '
+            '{"category": "made_up_category"}, "junk"]}')
+        assert [i["category"] for i in parsed["issues"]] == ["exaggeration"]
+
+    def test_djb2_stable_and_distinct(self):
+        assert djb2("hello") == djb2("hello")
+        assert djb2("hello") != djb2("world")
+
+
+class TestResponseGate:
+    def gate(self, rules=None, enabled=True, fallback=None):
+        cfg = {"enabled": enabled, "rules": rules or []}
+        if fallback is not None:
+            cfg["fallbackMessage"] = fallback
+        return ResponseGate(cfg)
+
+    def test_disabled_gate_passes_everything(self):
+        gate = self.gate([{"validators": [{"type": "mustMatch",
+                                           "pattern": "impossible"}]}],
+                         enabled=False)
+        assert gate.validate("anything", "main", []).passed
+
+    def test_required_tools_missing_fails(self):
+        gate = self.gate([{"validators": [
+            {"type": "requiredTools", "tools": ["web_search", "read"]}]}])
+        result = gate.validate("answer", "main", [{"tool": "read"}])
+        assert not result.passed
+        assert result.failed_validators == ["requiredTools:web_search,read"]
+        assert "web_search" in result.reasons[0]
+
+    def test_required_tools_all_called_passes(self):
+        gate = self.gate([{"validators": [
+            {"type": "requiredTools", "tools": ["web_search"]}]}])
+        assert gate.validate("answer", "main",
+                             [{"tool": "web_search"}]).passed
+
+    def test_must_match_enforced(self):
+        gate = self.gate([{"validators": [
+            {"type": "mustMatch", "pattern": r"\bsources?:"}]}])
+        assert not gate.validate("no citations here", "main", []).passed
+        assert gate.validate("sources: report.pdf", "main", []).passed
+
+    def test_must_not_match_enforced(self):
+        gate = self.gate([{"validators": [
+            {"type": "mustNotMatch", "pattern": r"(?i)guarantee"}]}])
+        assert not gate.validate("we GUARANTEE uptime", "main", []).passed
+        assert gate.validate("we aim for uptime", "main", []).passed
+
+    def test_invalid_regex_fails_closed(self):
+        for vtype in ("mustMatch", "mustNotMatch"):
+            gate = self.gate([{"validators": [{"type": vtype,
+                                               "pattern": "(unclosed"}]}])
+            result = gate.validate("any", "main", [])
+            assert not result.passed and "fail-closed" in result.reasons[0]
+
+    def test_agent_scoped_rules(self):
+        gate = self.gate([{"agents": ["forge"], "validators": [
+            {"type": "mustMatch", "pattern": "никогда"}]}])
+        assert gate.validate("text", "main", []).passed  # rule not for main
+        assert not gate.validate("text", "forge", []).passed
+
+    def test_unknown_validator_type_passes(self):
+        gate = self.gate([{"validators": [{"type": "mystery"}]}])
+        assert gate.validate("text", "main", []).passed
+
+    def test_default_fallback_templating(self):
+        gate = self.gate([{"validators": [
+            {"type": "mustMatch", "pattern": "x"}]}])
+        result = gate.validate("nope", "main", [])
+        assert result.fallback_message == \
+            DEFAULT_FALLBACK.replace("{agent}", "main").replace(
+                "{validators}", "mustMatch:x")
+
+    def test_custom_fallback_with_reasons(self):
+        gate = self.gate([{"validators": [
+            {"type": "mustMatch", "pattern": "x",
+             "message": "cite your sources"}]}],
+            fallback="blocked for {agent}: {reasons}")
+        result = gate.validate("nope", "viola", [])
+        assert result.fallback_message == "blocked for viola: cite your sources"
+
+    def test_multiple_failures_aggregate(self):
+        gate = self.gate([{"validators": [
+            {"type": "mustMatch", "pattern": "alpha"},
+            {"type": "mustNotMatch", "pattern": "beta"}]}])
+        result = gate.validate("beta text", "main", [])
+        assert len(result.failed_validators) == 2
+        assert len(result.reasons) == 2
+
+    def test_custom_validator_message_used(self):
+        gate = self.gate([{"validators": [
+            {"type": "requiredTools", "tools": ["read"],
+             "message": "read the file first"}]}])
+        result = gate.validate("text", "main", [])
+        assert result.reasons == ["read the file first"]

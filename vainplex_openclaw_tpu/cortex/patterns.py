@@ -258,19 +258,24 @@ def resolve_language_codes(selection) -> list[str]:
     return [c for c in selection if c in PACKS]
 
 
-def _compile_custom(patterns: object) -> list[re.Pattern]:
-    """Compile custom user regexes: non-strings are filtered, invalid
-    regexes are silently skipped (reference registry.ts — a bad custom
-    pattern must not take down the builtins)."""
+def _compile_custom(patterns: object, category: str, logger=None) -> list[re.Pattern]:
+    """Compile custom user regexes: non-strings and empty strings are
+    filtered, invalid regexes are skipped with a warning (reference
+    registry semantics — a bad custom pattern must not take down the
+    builtins, but the user must be able to see why theirs never fires)."""
     out = []
     for p in patterns if isinstance(patterns, (list, tuple)) else []:
-        if not isinstance(p, str):
+        if not isinstance(p, str) or not p:
             continue
         try:
             out.append(re.compile(p, re.IGNORECASE))
-        except re.error:
-            continue
+        except re.error as exc:
+            if logger is not None:
+                logger.warn(f"custom {category} pattern {p!r} rejected: {exc}")
     return out
+
+
+_CJK = re.compile(r"[぀-ヿ㐀-鿿가-힯]")
 
 
 class MergedPatterns:
@@ -284,14 +289,15 @@ class MergedPatterns:
     lists leave the builtins alone). Reference: cortex patterns-custom
     semantics (patterns-registry.ts / patterns-custom.test.ts)."""
 
-    def __init__(self, codes: list[str], custom: Optional[dict] = None):
+    def __init__(self, codes: list[str], custom: Optional[dict] = None,
+                 logger=None):
         self.codes = [c for c in codes if c in PACKS]
         packs = [PACKS[c] for c in self.codes]
         custom = custom or {}
         override = custom.get("mode") == "override"
 
         def compile_all(attr: str) -> list[re.Pattern]:
-            compiled_custom = _compile_custom(custom.get(attr, []))
+            compiled_custom = _compile_custom(custom.get(attr, []), attr, logger)
             if override and compiled_custom:
                 return compiled_custom
             out = []
@@ -310,7 +316,7 @@ class MergedPatterns:
             raw = custom.get(key, [])
             if not isinstance(raw, (list, tuple)):
                 return []
-            return [w.lower() for w in raw if isinstance(w, str)]
+            return [w.lower() for w in raw if isinstance(w, str) and w]
 
         self.topic_blacklist = {w.lower() for pack in packs for w in pack.topic_blacklist}
         self.topic_blacklist |= set(custom_words("blacklist"))
@@ -332,15 +338,17 @@ class MergedPatterns:
 
     def is_noise_topic(self, topic: str) -> bool:
         t = topic.strip().lower()
-        if len(t) < 3 or len(t) > 60:
+        # CJK topics carry word-level meaning per character — the zh/ja/ko
+        # packs deliberately capture 2-char topics (安全, 部署, 보안), so the
+        # fragment floor is 2 there and 3 for alphabetic scripts.
+        min_len = 2 if _CJK.search(t) else 3
+        if len(t) < min_len or len(t) > 60:
             return True  # fragments and run-on captures are never topics
         if "\n" in t:
             return True  # a capture spanning lines grabbed prose, not a topic
-        if t in self.topic_blacklist:
-            return True
-        words = t.split()  # non-empty: len(t) >= 3 on a stripped string
+        words = t.split()  # non-empty: len(t) >= 2 on a stripped string
         if all(w in self.topic_blacklist for w in words):
-            return True  # "that something" — all-blacklisted multi-word
+            return True  # single blacklisted word, or "that something"
         return words[0] in self.noise_prefixes
 
     def infer_priority(self, text: str) -> str:

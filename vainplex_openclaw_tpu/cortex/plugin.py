@@ -143,7 +143,8 @@ class CortexPlugin:
         self._api = api
         self.logger = api.logger
         codes = resolve_language_codes(self.config.get("languages"))
-        self.patterns = MergedPatterns(codes, self.config.get("customPatterns"))
+        self.patterns = MergedPatterns(codes, self.config.get("customPatterns"),
+                                       logger=api.logger)
         api.logger.info(f"patterns loaded: {','.join(codes)}")
 
         api.on("message_received", self._make_ingest("user"), priority=100)
