@@ -1,0 +1,235 @@
+"""Approval2FA depth: batching, the code path's full status table
+(approved/invalid/unauthorized/cooldown/replay/no_pending), session
+auto-approval, timeout/supersede resolution, and TOTP integration
+(reference: governance/test/approval-2fa.test.ts — 17 cases plus the
+reference's scattered hooks coverage; VERDICT r4 #5 test-depth parity).
+
+Uses wall_timers=False with explicit close/timeout calls and a FakeClock,
+so no test sleeps.
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.governance.approval import generate_base32_secret
+from vainplex_openclaw_tpu.governance.approval.approval2fa import (
+    Approval2FA,
+    summarize_params,
+)
+
+from helpers import FakeClock
+
+APPROVER = "@boss:m.org"
+
+
+def make_2fa(clock=None, **overrides):
+    cfg = {"enabled": True, "totpSecret": generate_base32_secret(),
+           "approvers": [APPROVER], "batchWindowMs": 50,
+           "timeoutSeconds": 300, "sessionDurationMinutes": 10,
+           "maxAttempts": 3, "cooldownSeconds": 60, **overrides}
+    return Approval2FA(cfg, list_logger(), clock=clock or FakeClock(),
+                       wall_timers=False)
+
+
+def queue(approval, tool="exec", agent="main", conv="agent:main", params=None):
+    return approval.request(agent, conv, tool, params or {"command": "x"},
+                            wait=False)
+
+
+class TestConstruction:
+    def test_requires_totp_secret(self):
+        with pytest.raises(ValueError, match="totpSecret"):
+            Approval2FA({"enabled": True}, list_logger())
+
+    def test_summarize_params_truncates(self):
+        short = summarize_params({"command": "ls"})
+        assert short == "command='ls'"
+        long = summarize_params({"command": "y" * 500})
+        assert len(long) == 121 and long.endswith("…")
+
+
+class TestBatching:
+    def test_requests_join_one_batch(self):
+        a = make_2fa()
+        r1 = queue(a, tool="exec")
+        r2 = queue(a, tool="write")
+        assert r1["pending"] and r2["pending"]
+        assert r1["batch_id"] == r2["batch_id"]
+        assert a.pending_count() == 2
+
+    def test_notification_lists_all_commands(self):
+        a = make_2fa()
+        sent = []
+        a.set_notify_fn(lambda agent, conv, msg: sent.append(msg))
+        queue(a, tool="exec", params={"command": "deploy"})
+        queue(a, tool="write", params={"file_path": "/etc/x"})
+        batch = a._batches["main"]
+        a.close_batch(batch)
+        [msg] = sent
+        assert "APPROVAL REQUIRED (2 commands)" in msg
+        assert "1. exec" in msg and "2. write" in msg
+        assert "One code approves ALL commands" in msg
+
+    def test_closed_batch_superseded_by_new_request(self):
+        a = make_2fa()
+        r1 = queue(a, tool="exec")
+        old = a._batches["main"]
+        a.close_batch(old)
+        r2 = queue(a, tool="write")
+        assert r2["batch_id"] != r1["batch_id"]
+        # the orphaned command was denied, not left hanging
+        orphan = old.commands[0].future.result(timeout=1)
+        assert orphan["block"] and "superseded" in orphan["block_reason"]
+
+    def test_notify_failure_swallowed(self):
+        a = make_2fa()
+        a.set_notify_fn(lambda *args: 1 / 0)
+        queue(a)
+        a.close_batch(a._batches["main"])  # must not raise
+
+
+class TestCodePath:
+    def test_valid_code_approves_all_and_opens_session(self):
+        clock = FakeClock()
+        a = make_2fa(clock=clock)
+        q1 = queue(a, tool="exec")
+        q2 = queue(a, tool="write")
+        result = a.try_resolve(a.totp.generate(), APPROVER, "agent:main")
+        assert result == {"status": "approved", "count": 2}
+        assert a.pending_count() == 0
+        assert q1["pending"] and q2["pending"]  # both were queued, both freed
+        # session window: next request auto-approves with no batch
+        assert a.request("main", "agent:main", "exec", {}, wait=False) == {}
+
+    def test_unauthorized_sender_rejected(self):
+        a = make_2fa()
+        queue(a)
+        result = a.try_resolve(a.totp.generate(), "@rando:m.org", "agent:main")
+        assert result["status"] == "unauthorized"
+        assert a.pending_count() == 1  # batch untouched
+
+    def test_no_pending_for_unknown_conversation(self):
+        a = make_2fa()
+        queue(a)
+        assert a.try_resolve(a.totp.generate(), APPROVER,
+                             "other:conv")["status"] == "no_pending"
+
+    def test_invalid_code_counts_attempts(self):
+        a = make_2fa()
+        queue(a)
+        r1 = a.try_resolve("000000", APPROVER, "agent:main")
+        assert r1 == {"status": "invalid", "attempts_left": 2}
+        r2 = a.try_resolve("000000", APPROVER, "agent:main")
+        assert r2["attempts_left"] == 1
+
+    def test_max_attempts_denies_and_cooldowns(self):
+        clock = FakeClock()
+        a = make_2fa(clock=clock)
+        r = queue(a)
+        batch = a._batches["main"]
+        for _ in range(3):
+            last = a.try_resolve("000000", APPROVER, "agent:main")
+        assert last["status"] == "denied_cooldown"
+        denied = batch.commands[0].future.result(timeout=1)
+        assert denied["block"] and "too many invalid codes" in denied["block_reason"]
+        # new requests blocked during cooldown
+        blocked = a.request("main", "agent:main", "exec", {}, wait=False)
+        assert blocked["block"] and "cooldown" in blocked["block_reason"]
+
+    def test_cooldown_expires_with_clock(self):
+        clock = FakeClock()
+        a = make_2fa(clock=clock, cooldownSeconds=60)
+        queue(a)
+        for _ in range(3):
+            a.try_resolve("000000", APPROVER, "agent:main")
+        clock.advance(61)
+        assert queue(a)["pending"]
+
+    def test_replay_of_consumed_token_rejected(self):
+        """A consumed (delta, period) token cannot approve a SECOND batch
+        within the same TOTP period — replay protection is global across
+        agents, exactly the one-code-one-approval property."""
+        a = make_2fa()
+        code = a.totp.generate()
+        queue(a, agent="main", conv="agent:main")
+        assert a.try_resolve(code, APPROVER, "agent:main")["status"] == "approved"
+        queue(a, agent="viola", conv="agent:viola")
+        assert a.try_resolve(code, APPROVER, "agent:viola")["status"] == "replay"
+
+    def test_code_during_cooldown_reports_retry_seconds(self):
+        """A code arriving for a cooling-down agent's batch is answered with
+        the remaining wait, not another attempt. The branch is defensive
+        (max-attempts deletes the batch when it starts the cooldown), so the
+        batch is seeded through the internal creator."""
+        clock = FakeClock()
+        a = make_2fa(clock=clock, cooldownSeconds=60)
+        a._cooldowns["main"] = clock() + 60
+        with a._lock:
+            a._get_or_create_batch("main", "agent:main", clock())
+        r = a.try_resolve(a.totp.generate(), APPROVER, "agent:main")
+        assert r["status"] == "cooldown" and r["retry_after_seconds"] >= 1
+
+
+class TestSessionWindow:
+    def test_session_expires_with_clock(self):
+        clock = FakeClock()
+        a = make_2fa(clock=clock, sessionDurationMinutes=10)
+        queue(a)
+        a.try_resolve(a.totp.generate(), APPROVER, "agent:main")
+        assert a.request("main", "agent:main", "exec", {}, wait=False) == {}
+        clock.advance(10 * 60 + 1)
+        again = a.request("main", "agent:main", "exec", {}, wait=False)
+        assert again.get("pending")  # session over → new batch
+
+    def test_session_is_per_agent(self):
+        a = make_2fa()
+        queue(a, agent="main", conv="agent:main")
+        a.try_resolve(a.totp.generate(), APPROVER, "agent:main")
+        other = a.request("viola", "agent:viola", "exec", {}, wait=False)
+        assert other.get("pending")  # viola has no session approval
+
+    def test_cleanup_expired_prunes_both_maps(self):
+        clock = FakeClock()
+        a = make_2fa(clock=clock)
+        a._session_approvals["main"] = clock() + 5
+        a._cooldowns["viola"] = clock() + 5
+        clock.advance(6)
+        a.cleanup_expired()
+        assert a._session_approvals == {} and a._cooldowns == {}
+
+
+class TestTimeouts:
+    def test_timeout_batch_denies_all(self):
+        a = make_2fa()
+        queue(a, tool="exec")
+        queue(a, tool="write")
+        batch = a._batches["main"]
+        a.timeout_batch(batch)
+        for cmd in batch.commands:
+            result = cmd.future.result(timeout=1)
+            assert result["block"] and "timed out" in result["block_reason"]
+        assert a.pending_count() == 0
+
+    def test_timeout_of_stale_batch_is_noop(self):
+        a = make_2fa()
+        queue(a)
+        old = a._batches["main"]
+        a.timeout_batch(old)
+        queue(a)  # fresh batch
+        fresh = a._batches["main"]
+        a.timeout_batch(old)  # stale reference — must not kill the fresh one
+        assert a._batches.get("main") is fresh
+
+
+class TestResolveAny:
+    def test_resolves_whichever_batch_matches(self):
+        a = make_2fa()
+        queue(a, agent="main", conv="agent:main")
+        queue(a, agent="viola", conv="agent:viola")
+        result = a.try_resolve_any(a.totp.generate(), APPROVER)
+        assert result["status"] == "approved"
+        assert a.pending_count() == 1  # the other agent's batch remains
+
+    def test_no_batches_no_pending(self):
+        a = make_2fa()
+        assert a.try_resolve_any("123456", APPROVER) == {"status": "no_pending"}

@@ -96,6 +96,11 @@ class TestCustomPatternsExtend:
         assert merged.is_noise_topic("foo-noise")
         assert not EN.is_noise_topic("foo-noise")
 
+    def test_custom_multiword_blacklist_phrase(self):
+        merged = MergedPatterns(["en"], {"blacklist": ["next steps"]})
+        assert merged.is_noise_topic("next steps")  # exact-phrase entry
+        assert not merged.is_noise_topic("next steps for billing")
+
     def test_custom_keywords_escalate_priority(self):
         merged = MergedPatterns(["en"], {"keywords": ["compliance"]})
         assert merged.infer_priority("compliance review next") == "high"

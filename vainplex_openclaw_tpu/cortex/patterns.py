@@ -346,9 +346,11 @@ class MergedPatterns:
             return True  # fragments and run-on captures are never topics
         if "\n" in t:
             return True  # a capture spanning lines grabbed prose, not a topic
+        if t in self.topic_blacklist:
+            return True  # exact entry — incl. multi-word custom phrases
         words = t.split()  # non-empty: len(t) >= 2 on a stripped string
         if all(w in self.topic_blacklist for w in words):
-            return True  # single blacklisted word, or "that something"
+            return True  # every word blacklisted — "that something"
         return words[0] in self.noise_prefixes
 
     def infer_priority(self, text: str) -> str:
