@@ -1,0 +1,363 @@
+"""Audit trail, audit redactor, risk assessor, frequency tracker, and
+cross-agent manager depth (reference: governance/test/{audit-trail,
+audit-redactor,risk-assessor,frequency-tracker,cross-agent}.test.ts —
+55 cases; VERDICT r4 #5 test-depth parity).
+
+Complements test_governance_trust.py (trust/session/cross-agent basics)
+and test_governance_engine.py (audit via the pipeline).
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.governance.audit import (
+    FLUSH_THRESHOLD,
+    AuditTrail,
+    create_redactor,
+    derive_controls,
+)
+from vainplex_openclaw_tpu.governance.cross_agent import CrossAgentManager
+from vainplex_openclaw_tpu.governance.frequency import FrequencyTracker
+from vainplex_openclaw_tpu.governance.risk import (
+    DEFAULT_TOOL_RISK,
+    UNKNOWN_TOOL_RISK,
+    RiskAssessor,
+    score_to_risk_level,
+)
+from vainplex_openclaw_tpu.governance.trust import TrustManager
+from vainplex_openclaw_tpu.governance.types import (
+    EvalTrust,
+    EvaluationContext,
+    MatchedPolicy,
+    TrustSnapshot,
+)
+from vainplex_openclaw_tpu.governance.util import TimeContext
+
+from helpers import FakeClock
+
+
+def make_ctx(tool_name="exec", tool_params=None, hour=12, session_score=50,
+             message_to=None, agent_id="main", session_key=None):
+    return EvaluationContext(
+        agent_id=agent_id,
+        session_key=session_key or f"agent:{agent_id}",
+        hook="before_tool_call",
+        trust=EvalTrust(agent=TrustSnapshot(60, "trusted"),
+                        session=TrustSnapshot(session_score, "standard")),
+        time=TimeContext(hour=hour, minute=0, day_of_week=3, date="2026-07-30"),
+        tool_name=tool_name,
+        tool_params=tool_params,
+        message_to=message_to,
+    )
+
+
+class TestRiskLevels:
+    @pytest.mark.parametrize("score,level", [
+        (0, "low"), (25, "low"), (26, "medium"), (50, "medium"),
+        (51, "high"), (75, "high"), (76, "critical"), (100, "critical")])
+    def test_level_boundaries(self, score, level):
+        assert score_to_risk_level(score) == level
+
+
+class TestRiskFactors:
+    def assess(self, ctx, tracker=None, overrides=None):
+        return RiskAssessor(overrides).assess(ctx, tracker or FrequencyTracker())
+
+    def factor(self, assessment, name):
+        return next(f for f in assessment.factors if f.name == name)
+
+    def test_five_factors_always_present(self):
+        a = self.assess(make_ctx())
+        assert [f.name for f in a.factors] == [
+            "tool_sensitivity", "time_of_day", "trust_deficit",
+            "frequency", "target_scope"]
+        assert sum(f.weight for f in a.factors) == 100
+
+    @pytest.mark.parametrize("tool,raw", [
+        ("gateway", 95), ("exec", 70), ("read", 10), ("memory_get", 5)])
+    def test_tool_sensitivity_scales_known_tools(self, tool, raw):
+        a = self.assess(make_ctx(tool_name=tool))
+        f = self.factor(a, "tool_sensitivity")
+        assert f.value == pytest.approx((raw / 100) * 30)
+
+    def test_unknown_and_missing_tool_default_risk(self):
+        for tool in ("mystery_tool", None):
+            a = self.assess(make_ctx(tool_name=tool))
+            assert self.factor(a, "tool_sensitivity").value == pytest.approx(
+                (UNKNOWN_TOOL_RISK / 100) * 30)
+
+    def test_overrides_beat_defaults(self):
+        a = self.assess(make_ctx(tool_name="read"), overrides={"read": 90})
+        assert self.factor(a, "tool_sensitivity").value == pytest.approx(27)
+
+    @pytest.mark.parametrize("hour,off", [
+        (7, True), (8, False), (12, False), (22, False), (23, True), (2, True)])
+    def test_off_hours_boundaries(self, hour, off):
+        a = self.assess(make_ctx(hour=hour))
+        assert self.factor(a, "time_of_day").value == (15 if off else 0)
+
+    @pytest.mark.parametrize("score,expected", [(100, 0), (0, 20), (50, 10)])
+    def test_trust_deficit_inverse(self, score, expected):
+        a = self.assess(make_ctx(session_score=score))
+        assert self.factor(a, "trust_deficit").value == pytest.approx(expected)
+
+    def test_frequency_factor_saturates_at_20_calls(self):
+        tracker = FrequencyTracker(clock=FakeClock())
+        for _ in range(40):
+            tracker.record("main", "agent:main", "exec")
+        a = self.assess(make_ctx(), tracker)
+        assert self.factor(a, "frequency").value == 15  # capped
+
+    @pytest.mark.parametrize("ctx_kw,external", [
+        ({"message_to": "@user:matrix.org"}, True),
+        ({"tool_params": {"host": "prod-server"}}, True),
+        ({"tool_params": {"host": "sandbox"}}, False),
+        ({"tool_params": {"elevated": True}}, True),
+        ({"tool_params": {"command": "ls"}}, False),
+        ({"tool_params": None}, False)])
+    def test_external_target_detection(self, ctx_kw, external):
+        a = self.assess(make_ctx(**ctx_kw))
+        assert self.factor(a, "target_scope").value == (20 if external else 0)
+
+    def test_worst_case_is_critical(self):
+        tracker = FrequencyTracker(clock=FakeClock())
+        for _ in range(25):
+            tracker.record("main", "agent:main", "gateway")
+        a = self.assess(make_ctx(tool_name="gateway", hour=3, session_score=0,
+                                 tool_params={"elevated": True}), tracker)
+        assert a.level == "critical" and a.score > 90
+
+    def test_best_case_is_low(self):
+        a = self.assess(make_ctx(tool_name="memory_get", session_score=100))
+        assert a.level == "low"
+
+
+class TestFrequencyTracker:
+    def test_window_counting(self):
+        clock = FakeClock()
+        tracker = FrequencyTracker(clock=clock)
+        for _ in range(3):
+            tracker.record("main", "agent:main", "exec")
+        clock.advance(30)
+        tracker.record("main", "agent:main", "exec")
+        assert tracker.count(60, "agent", "main") == 4
+        assert tracker.count(10, "agent", "main") == 1
+
+    def test_scopes_are_independent(self):
+        tracker = FrequencyTracker(clock=FakeClock())
+        tracker.record("main", "agent:main", "exec")
+        tracker.record("viola", "agent:viola", "exec")
+        assert tracker.count(60, "agent", "main") == 1
+        assert tracker.count(60, "agent", "viola") == 1
+
+    def test_session_scope(self):
+        tracker = FrequencyTracker(clock=FakeClock())
+        tracker.record("main", "agent:main:sub:1", "exec")
+        tracker.record("main", "agent:main:sub:2", "exec")
+        assert tracker.count(60, "agent", "main") == 2
+        assert tracker.count(60, "session", "main", "agent:main:sub:1") == 1
+
+    def test_old_entries_age_out_of_window(self):
+        clock = FakeClock()
+        tracker = FrequencyTracker(clock=clock)
+        tracker.record("main", "agent:main", "exec")
+        clock.advance(120)
+        assert tracker.count(60, "agent", "main") == 0
+
+    def test_clear_resets(self):
+        tracker = FrequencyTracker(clock=FakeClock())
+        tracker.record("main", "agent:main", "exec")
+        tracker.clear()
+        assert tracker.count(60, "agent", "main") == 0
+
+
+class TestAuditControls:
+    def m(self, controls=(), action="deny"):
+        return MatchedPolicy("p", "r", {"action": action}, list(controls))
+
+    def test_deny_always_carries_incident_controls(self):
+        assert derive_controls([], "deny") == ["A.5.24", "A.5.28"]
+
+    def test_allow_carries_only_policy_controls(self):
+        assert derive_controls([self.m(["A.8.11"], "allow")], "allow") == ["A.8.11"]
+
+    def test_union_sorted_deduped(self):
+        got = derive_controls(
+            [self.m(["A.8.11", "A.5.24"]), self.m(["A.8.4"])], "deny")
+        # lexicographic sort ("A.8.11" < "A.8.4"), set-deduped
+        assert got == ["A.5.24", "A.5.28", "A.8.11", "A.8.4"]
+
+
+class TestAuditRedactor:
+    def test_patterns_applied_recursively(self):
+        redact = create_redactor([r"sk-\w+", r"\d{3}-\d{2}-\d{4}"])
+        got = redact({"cmd": "use sk-abc123", "nested": {"ssn": "123-45-6789"},
+                      "list": ["sk-xyz", 42]})
+        assert got == {"cmd": "use [REDACTED]",
+                       "nested": {"ssn": "[REDACTED]"},
+                       "list": ["[REDACTED]", 42]}
+
+    def test_invalid_patterns_skipped(self):
+        redact = create_redactor(["(unclosed", r"secret"])
+        assert redact("my secret plan") == "my [REDACTED] plan"
+
+    def test_non_string_scalars_untouched(self):
+        redact = create_redactor([r"\d+"])
+        assert redact(42) == 42 and redact(None) is None and redact(True) is True
+
+
+class TestAuditTrail:
+    def make(self, tmp_path, config=None, clock=None):
+        trail = AuditTrail(config or {}, tmp_path, list_logger(),
+                           clock=clock or FakeClock())
+        trail.load()
+        return trail
+
+    def rec(self, trail, verdict="deny", agent="main", reason="r"):
+        return trail.record(verdict, reason,
+                            {"agentId": agent, "toolName": "exec"},
+                            {"score": 50, "tier": "standard"},
+                            {"level": "low", "score": 10}, [], 120)
+
+    def test_record_shape(self, tmp_path):
+        trail = self.make(tmp_path)
+        rec = self.rec(trail)
+        assert rec["verdict"] == "deny" and rec["evaluationUs"] == 120
+        assert rec["controls"] == ["A.5.24", "A.5.28"]
+        assert rec["timestampIso"].endswith("Z") and rec["id"]
+
+    def test_buffered_until_threshold(self, tmp_path):
+        trail = self.make(tmp_path)
+        for _ in range(FLUSH_THRESHOLD - 1):
+            self.rec(trail)
+        assert trail.stats()["buffered"] == FLUSH_THRESHOLD - 1
+        assert not list((tmp_path / "governance" / "audit").glob("*.jsonl"))
+        self.rec(trail)  # threshold reached → auto-flush
+        assert trail.stats()["buffered"] == 0
+        assert list((tmp_path / "governance" / "audit").glob("*.jsonl"))
+
+    def test_query_filters(self, tmp_path):
+        trail = self.make(tmp_path)
+        self.rec(trail, verdict="deny", agent="main")
+        self.rec(trail, verdict="allow", agent="main")
+        self.rec(trail, verdict="deny", agent="viola")
+        assert len(trail.query(verdict="deny")) == 2
+        assert len(trail.query(verdict="deny", agent_id="viola")) == 1
+        assert len(trail.query()) == 3
+
+    def test_query_since_and_limit(self, tmp_path):
+        clock = FakeClock()
+        trail = self.make(tmp_path, clock=clock)
+        self.rec(trail)
+        clock.advance(100)
+        cutoff_ms = clock() * 1000
+        clock.advance(100)
+        self.rec(trail)
+        assert len(trail.query(since_ms=cutoff_ms)) == 1
+        assert len(trail.query(limit=1)) == 1
+
+    def test_records_split_to_daily_files(self, tmp_path):
+        clock = FakeClock()
+        trail = self.make(tmp_path, clock=clock)
+        self.rec(trail)
+        clock.advance(86400)  # next day
+        self.rec(trail)
+        trail.flush()
+        files = sorted((tmp_path / "governance" / "audit").glob("*.jsonl"))
+        assert len(files) == 2
+
+    def test_retention_cleanup(self, tmp_path):
+        clock = FakeClock()
+        audit_dir = tmp_path / "governance" / "audit"
+        audit_dir.mkdir(parents=True)
+        (audit_dir / "2020-01-01.jsonl").write_text("{}\n")
+        trail = self.make(tmp_path, config={"retentionDays": 30}, clock=clock)
+        assert not (audit_dir / "2020-01-01.jsonl").exists()
+
+    def test_redact_patterns_applied_before_buffering(self, tmp_path):
+        trail = self.make(tmp_path, config={"redactPatterns": [r"sk-\w+"]})
+        rec = self.rec(trail)
+        assert "[REDACTED]" not in str(rec)  # nothing secret in this one
+        rec2 = trail.record("allow", "r", {"toolParams": {"key": "sk-abc"}},
+                            {}, {}, [], 1)
+        assert rec2["context"]["toolParams"]["key"] == "[REDACTED]"
+
+    def test_scrubber_failure_does_not_kill_record(self, tmp_path):
+        trail = self.make(tmp_path)
+        trail.scrubber = lambda ctx: 1 / 0
+        rec = self.rec(trail)
+        assert rec["verdict"] == "deny"  # recorded despite scrub crash
+
+
+class TestCrossAgent:
+    CHILD = "agent:main:subagent:forge:abc"
+
+    def make(self, tmp_path, defaults=None):
+        clock = FakeClock()
+        tm = TrustManager({"enabled": True,
+                           "defaults": defaults or {"main": 60, "forge": 80, "*": 10}},
+                          tmp_path, list_logger(), clock=clock)
+        tm.load()
+        return CrossAgentManager(tm, list_logger(), clock=clock), tm
+
+    def test_register_and_get_parent(self, tmp_path):
+        mgr, _ = self.make(tmp_path)
+        mgr.register_relationship("agent:main", self.CHILD)
+        rel = mgr.get_parent(self.CHILD)
+        assert rel.parent_agent_id == "main"
+
+    def test_unknown_child_has_no_parent(self, tmp_path):
+        mgr, _ = self.make(tmp_path)
+        assert mgr.get_parent("agent:nobody") is None
+
+    def test_children_listing(self, tmp_path):
+        mgr, _ = self.make(tmp_path)
+        mgr.register_relationship("agent:main", self.CHILD)
+        mgr.register_relationship("agent:main", "agent:main:subagent:scout:x")
+        assert len(mgr.get_children("agent:main")) == 2
+
+    def test_remove_relationship(self, tmp_path):
+        """Explicit removal clears the registration; a subagent-shaped key
+        STILL derives its parent from the key itself (by design — the shape
+        encodes parentage), so removal is only observable on keys whose
+        parentage existed solely by registration."""
+        mgr, _ = self.make(tmp_path)
+        custom_child = "pipeline-worker-7"  # not subagent-shaped
+        mgr.register_relationship("agent:main", custom_child)
+        assert mgr.get_parent(custom_child) is not None
+        mgr.remove_relationship(custom_child)
+        assert mgr.get_parent(custom_child) is None
+        # shape-derived parentage survives explicit removal
+        mgr.register_relationship("agent:main", self.CHILD)
+        mgr.remove_relationship(self.CHILD)
+        derived = mgr.get_parent(self.CHILD)
+        assert derived is not None and derived.parent_agent_id == "main"
+
+    def test_ceiling_tracks_parent_live_score(self, tmp_path):
+        mgr, tm = self.make(tmp_path)
+        mgr.register_relationship("agent:main", self.CHILD)
+        assert mgr.compute_trust_ceiling(self.CHILD) == 60
+        tm.set_score("main", 40)
+        assert mgr.compute_trust_ceiling(self.CHILD) == 40
+
+    def test_ceiling_caps_child_session_trust_in_context(self, tmp_path):
+        mgr, _ = self.make(tmp_path)
+        mgr.register_relationship("agent:main", self.CHILD)
+        ctx = make_ctx(agent_id="forge", session_key=self.CHILD,
+                       session_score=80)
+        enriched = mgr.enrich_context(ctx)
+        # exactly min(child 80, parent ceiling 60) — not merely "not above"
+        assert enriched.trust.session.score == 60
+
+    def test_root_agent_context_unchanged(self, tmp_path):
+        mgr, _ = self.make(tmp_path)
+        ctx = make_ctx(session_score=80)
+        assert mgr.enrich_context(ctx).trust.session.score == 80
+
+    def test_graph_summary(self, tmp_path):
+        mgr, _ = self.make(tmp_path)
+        mgr.register_relationship("agent:main", self.CHILD)
+        summary = mgr.graph_summary()
+        [rel] = summary["relationships"]
+        assert rel["parent_agent_id"] == "main"
+        assert rel["child_session_key"] == self.CHILD
