@@ -1,0 +1,186 @@
+"""AgentProof REST client depth: key loading from file, auth headers,
+single and batch lookups, and the queued feedback path with retry and
+backpressure (reference: governance/test/security/agentproof-rest.test.ts —
+24 cases; VERDICT r4 #5 test-depth parity).
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.governance.security.agentproof import (
+    AgentProofRestClient,
+)
+
+from helpers import FakeClock
+
+
+class FakeHttp:
+    def __init__(self, responses=None, fail_times=0):
+        self.calls = []
+        self.responses = responses or {}
+        self.fail_times = fail_times
+
+    def __call__(self, method, url, headers, body=None, timeout=10.0):
+        self.calls.append({"method": method, "url": url, "headers": headers,
+                           "body": body})
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("network down")
+        for needle, resp in self.responses.items():
+            if needle in url:
+                return resp
+        return {}
+
+
+def make_client(tmp_path, http=None, key="sk-proof-123", base="https://ap.example",
+                **kw):
+    key_path = None
+    if key is not None:
+        key_path = tmp_path / "agentproof.key"
+        key_path.write_text(key + "\n")
+    client = AgentProofRestClient(
+        {"baseUrl": base, "apiKeyPath": str(key_path) if key_path else None},
+        list_logger(), http_request=http or FakeHttp(), clock=FakeClock(), **kw)
+    return client
+
+
+class TestKeyAndHeaders:
+    def test_key_read_from_file_and_stripped(self, tmp_path):
+        client = make_client(tmp_path)
+        assert client._headers() == {"Authorization": "Bearer sk-proof-123"}
+
+    def test_key_cached_after_first_read(self, tmp_path):
+        client = make_client(tmp_path)
+        client._headers()
+        (tmp_path / "agentproof.key").write_text("rotated")
+        assert client._headers()["Authorization"] == "Bearer sk-proof-123"
+
+    def test_missing_key_file_warns_and_disables(self, tmp_path):
+        log = list_logger()
+        client = AgentProofRestClient(
+            {"baseUrl": "https://x", "apiKeyPath": str(tmp_path / "nope.key")},
+            log, http_request=FakeHttp(), clock=FakeClock())
+        assert client._headers() is None
+        assert any("api key unreadable" in m for m in log.messages("warn"))
+
+    def test_no_key_path_configured_disables(self, tmp_path):
+        client = make_client(tmp_path, key=None)
+        assert client.lookup("main") is None
+
+    def test_trailing_slash_stripped_from_base_url(self, tmp_path):
+        http = FakeHttp()
+        client = make_client(tmp_path, http=http, base="https://ap.example/")
+        client.lookup("main")
+        assert http.calls[0]["url"] == \
+            "https://ap.example/v1/agents/main/reputation"
+
+
+class TestLookup:
+    def test_lookup_get_with_bearer(self, tmp_path):
+        http = FakeHttp({"reputation": {"score": 82, "tier": "gold"}})
+        client = make_client(tmp_path, http=http)
+        assert client.lookup("main") == {"score": 82, "tier": "gold"}
+        [call] = http.calls
+        assert call["method"] == "GET" and call["body"] is None
+        assert call["headers"]["Authorization"].startswith("Bearer ")
+
+    def test_lookup_failure_best_effort_none(self, tmp_path):
+        client = make_client(tmp_path, http=FakeHttp(fail_times=1))
+        assert client.lookup("main") is None
+
+    def test_lookup_without_base_url_none(self, tmp_path):
+        client = make_client(tmp_path, base="")
+        assert client.lookup("main") is None
+
+
+class TestBatchLookup:
+    def test_batch_posts_ids_and_maps_results(self, tmp_path):
+        http = FakeHttp({"reputation:batch": {
+            "results": {"main": {"score": 80}, "viola": {"score": 45}}}})
+        client = make_client(tmp_path, http=http)
+        got = client.lookup_batch(["main", "viola", "ghost"])
+        assert got == {"main": {"score": 80}, "viola": {"score": 45},
+                       "ghost": None}
+        [call] = http.calls
+        assert call["method"] == "POST"
+        assert call["body"] == {"agentIds": ["main", "viola", "ghost"]}
+
+    def test_batch_failure_all_none(self, tmp_path):
+        client = make_client(tmp_path, http=FakeHttp(fail_times=1))
+        got = client.lookup_batch(["a", "b"])
+        assert got == {"a": None, "b": None}
+
+    def test_batch_without_credentials_all_none_no_calls(self, tmp_path):
+        http = FakeHttp()
+        client = make_client(tmp_path, http=http, key=None)
+        assert client.lookup_batch(["a"]) == {"a": None}
+        assert http.calls == []
+
+    def test_empty_results_key_tolerated(self, tmp_path):
+        http = FakeHttp({"reputation:batch": {}})
+        client = make_client(tmp_path, http=http)
+        assert client.lookup_batch(["a"]) == {"a": None}
+
+
+class TestFeedbackQueue:
+    def test_queue_and_flush_delivers_in_order(self, tmp_path):
+        http = FakeHttp()
+        client = make_client(tmp_path, http=http)
+        client.queue_feedback("main", "violation", "policy denial")
+        client.queue_feedback("viola", "success")
+        assert client.queued == 2
+        assert client.flush_feedback() == 2
+        assert client.queued == 0
+        bodies = [c["body"] for c in http.calls]
+        assert bodies[0]["agentId"] == "main"
+        assert bodies[0]["signal"] == "violation"
+        assert bodies[0]["detail"] == "policy denial"
+        assert bodies[1]["agentId"] == "viola"
+        assert all("/v1/feedback" in c["url"] for c in http.calls)
+
+    def test_feedback_timestamped_with_clock(self, tmp_path):
+        client = make_client(tmp_path)
+        client.queue_feedback("main", "success")
+        assert client._feedback_queue[0]["ts"] == FakeClock().t
+
+    def test_transient_failure_retried_within_flush(self, tmp_path):
+        http = FakeHttp(fail_times=1)  # first POST fails, retry succeeds
+        client = make_client(tmp_path, http=http)
+        client.queue_feedback("main", "success")
+        assert client.flush_feedback(max_retries=2) == 1
+        assert client.queued == 0
+
+    def test_persistent_failure_keeps_queue_for_next_flush(self, tmp_path):
+        http = FakeHttp(fail_times=99)
+        client = make_client(tmp_path, http=http)
+        client.queue_feedback("main", "success")
+        client.queue_feedback("viola", "success")
+        assert client.flush_feedback(max_retries=2) == 0
+        assert client.queued == 2  # nothing lost
+        http.fail_times = 0
+        assert client.flush_feedback() == 2
+
+    def test_head_of_line_failure_stops_flush(self, tmp_path):
+        """Delivery is strictly ordered: if the head signal cannot be sent,
+        later signals wait (no reordering)."""
+        http = FakeHttp(fail_times=2)  # both tries for the head fail
+        client = make_client(tmp_path, http=http)
+        client.queue_feedback("first", "violation")
+        client.queue_feedback("second", "success")
+        assert client.flush_feedback(max_retries=2) == 0
+        assert [s["agentId"] for s in client._feedback_queue] == \
+            ["first", "second"]
+
+    def test_queue_bounded_drops_oldest(self, tmp_path):
+        client = make_client(tmp_path, max_queue=3)
+        for i in range(5):
+            client.queue_feedback(f"agent-{i}", "success")
+        assert client.queued == 3
+        assert [s["agentId"] for s in client._feedback_queue] == \
+            ["agent-2", "agent-3", "agent-4"]
+
+    def test_flush_without_credentials_noop(self, tmp_path):
+        client = make_client(tmp_path, key=None)
+        client.queue_feedback("main", "success")
+        assert client.flush_feedback() == 0
+        assert client.queued == 1

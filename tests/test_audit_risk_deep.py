@@ -3,15 +3,19 @@ cross-agent manager depth (reference: governance/test/{audit-trail,
 audit-redactor,risk-assessor,frequency-tracker,cross-agent}.test.ts —
 55 cases; VERDICT r4 #5 test-depth parity).
 
-Complements test_governance_trust.py (trust/session/cross-agent basics)
-and test_governance_engine.py (audit via the pipeline).
+Coverage split with test_governance_trust.py: that file owns buffering
+threshold, redact patterns, retention, basic query filters, frequency
+windows/scopes/capacity, cross-agent registration and the agent-level
+ceiling; this file adds the cases absent there (per-factor risk matrix,
+boundary hours, control unions, recursive redactor, daily splitting,
+since/limit queries, scrub-failure tolerance, frequency clear, explicit
+vs shape-derived parentage, the SESSION-level ceiling).
 """
 
 import pytest
 
 from vainplex_openclaw_tpu.core import list_logger
 from vainplex_openclaw_tpu.governance.audit import (
-    FLUSH_THRESHOLD,
     AuditTrail,
     create_redactor,
     derive_controls,
@@ -86,10 +90,6 @@ class TestRiskFactors:
             assert self.factor(a, "tool_sensitivity").value == pytest.approx(
                 (UNKNOWN_TOOL_RISK / 100) * 30)
 
-    def test_overrides_beat_defaults(self):
-        a = self.assess(make_ctx(tool_name="read"), overrides={"read": 90})
-        assert self.factor(a, "tool_sensitivity").value == pytest.approx(27)
-
     @pytest.mark.parametrize("hour,off", [
         (7, True), (8, False), (12, False), (22, False), (23, True), (2, True)])
     def test_off_hours_boundaries(self, hour, off):
@@ -133,36 +133,8 @@ class TestRiskFactors:
 
 
 class TestFrequencyTracker:
-    def test_window_counting(self):
-        clock = FakeClock()
-        tracker = FrequencyTracker(clock=clock)
-        for _ in range(3):
-            tracker.record("main", "agent:main", "exec")
-        clock.advance(30)
-        tracker.record("main", "agent:main", "exec")
-        assert tracker.count(60, "agent", "main") == 4
-        assert tracker.count(10, "agent", "main") == 1
-
-    def test_scopes_are_independent(self):
-        tracker = FrequencyTracker(clock=FakeClock())
-        tracker.record("main", "agent:main", "exec")
-        tracker.record("viola", "agent:viola", "exec")
-        assert tracker.count(60, "agent", "main") == 1
-        assert tracker.count(60, "agent", "viola") == 1
-
-    def test_session_scope(self):
-        tracker = FrequencyTracker(clock=FakeClock())
-        tracker.record("main", "agent:main:sub:1", "exec")
-        tracker.record("main", "agent:main:sub:2", "exec")
-        assert tracker.count(60, "agent", "main") == 2
-        assert tracker.count(60, "session", "main", "agent:main:sub:1") == 1
-
-    def test_old_entries_age_out_of_window(self):
-        clock = FakeClock()
-        tracker = FrequencyTracker(clock=clock)
-        tracker.record("main", "agent:main", "exec")
-        clock.advance(120)
-        assert tracker.count(60, "agent", "main") == 0
+    """Window/scope/capacity behavior lives in test_governance_trust.py;
+    only clear() is uncovered there."""
 
     def test_clear_resets(self):
         tracker = FrequencyTracker(clock=FakeClock())
@@ -226,25 +198,6 @@ class TestAuditTrail:
         assert rec["controls"] == ["A.5.24", "A.5.28"]
         assert rec["timestampIso"].endswith("Z") and rec["id"]
 
-    def test_buffered_until_threshold(self, tmp_path):
-        trail = self.make(tmp_path)
-        for _ in range(FLUSH_THRESHOLD - 1):
-            self.rec(trail)
-        assert trail.stats()["buffered"] == FLUSH_THRESHOLD - 1
-        assert not list((tmp_path / "governance" / "audit").glob("*.jsonl"))
-        self.rec(trail)  # threshold reached → auto-flush
-        assert trail.stats()["buffered"] == 0
-        assert list((tmp_path / "governance" / "audit").glob("*.jsonl"))
-
-    def test_query_filters(self, tmp_path):
-        trail = self.make(tmp_path)
-        self.rec(trail, verdict="deny", agent="main")
-        self.rec(trail, verdict="allow", agent="main")
-        self.rec(trail, verdict="deny", agent="viola")
-        assert len(trail.query(verdict="deny")) == 2
-        assert len(trail.query(verdict="deny", agent_id="viola")) == 1
-        assert len(trail.query()) == 3
-
     def test_query_since_and_limit(self, tmp_path):
         clock = FakeClock()
         trail = self.make(tmp_path, clock=clock)
@@ -266,22 +219,6 @@ class TestAuditTrail:
         files = sorted((tmp_path / "governance" / "audit").glob("*.jsonl"))
         assert len(files) == 2
 
-    def test_retention_cleanup(self, tmp_path):
-        clock = FakeClock()
-        audit_dir = tmp_path / "governance" / "audit"
-        audit_dir.mkdir(parents=True)
-        (audit_dir / "2020-01-01.jsonl").write_text("{}\n")
-        trail = self.make(tmp_path, config={"retentionDays": 30}, clock=clock)
-        assert not (audit_dir / "2020-01-01.jsonl").exists()
-
-    def test_redact_patterns_applied_before_buffering(self, tmp_path):
-        trail = self.make(tmp_path, config={"redactPatterns": [r"sk-\w+"]})
-        rec = self.rec(trail)
-        assert "[REDACTED]" not in str(rec)  # nothing secret in this one
-        rec2 = trail.record("allow", "r", {"toolParams": {"key": "sk-abc"}},
-                            {}, {}, [], 1)
-        assert rec2["context"]["toolParams"]["key"] == "[REDACTED]"
-
     def test_scrubber_failure_does_not_kill_record(self, tmp_path):
         trail = self.make(tmp_path)
         trail.scrubber = lambda ctx: 1 / 0
@@ -299,12 +236,6 @@ class TestCrossAgent:
                           tmp_path, list_logger(), clock=clock)
         tm.load()
         return CrossAgentManager(tm, list_logger(), clock=clock), tm
-
-    def test_register_and_get_parent(self, tmp_path):
-        mgr, _ = self.make(tmp_path)
-        mgr.register_relationship("agent:main", self.CHILD)
-        rel = mgr.get_parent(self.CHILD)
-        assert rel.parent_agent_id == "main"
 
     def test_unknown_child_has_no_parent(self, tmp_path):
         mgr, _ = self.make(tmp_path)
