@@ -1,0 +1,139 @@
+"""Third per-language signal-pack pass: a compiled-pattern matrix with a
+fresh phrasing per category per language, a neutral negative control, and
+merge/isolation semantics (reference: the per-language files under
+cortex/src/trace-analyzer/signals/lang/; VERDICT r4 #5 — the per-language
+signal suites deserve the same per-phrasing depth as the pattern packs).
+
+Complements test_signal_langs.py and test_signal_langs_deep.py, which
+drive full chains through the detectors; no phrasing here repeats theirs.
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signal_patterns import (
+    SIGNAL_PACKS,
+    compile_signal_patterns,
+)
+
+# lang → one FRESH phrasing per category + a neutral that matches nothing
+CASES = {
+    "en": {"correction": "that's incorrect", "short_negative": "nah",
+           "dissatisfaction": "still failing after the patch",
+           "satisfaction": "works now, cheers", "resolution": "let me fix that",
+           "completion": "the service is now ready",
+           "neutral": "the sky is blue"},
+    "de": {"correction": "du irrst dich", "short_negative": "nö",
+           "dissatisfaction": "das bringt nichts",
+           "satisfaction": "läuft jetzt", "resolution": "hier die korrektur",
+           "completion": "ist jetzt fertig",
+           "neutral": "die Sonne scheint heute"},
+    "fr": {"correction": "tu te trompes", "short_negative": "non!",
+           "dissatisfaction": "toujours cassé",
+           "satisfaction": "ça marche", "resolution": "réparé hier soir",
+           "completion": "j'ai fini la tâche",
+           "neutral": "le ciel est bleu"},
+    "es": {"correction": "eso está mal", "short_negative": "no!",
+           "dissatisfaction": "sigue fallando",
+           "satisfaction": "ya funciona", "resolution": "corregido por fin",
+           "completion": "está listo",
+           "neutral": "hace buen tiempo"},
+    "pt": {"correction": "você errou", "short_negative": "não",
+           "dissatisfaction": "continua falhando",
+           "satisfaction": "funciona agora", "resolution": "corrigido ontem",
+           "completion": "eu terminei",
+           "neutral": "o céu está azul"},
+    "it": {"correction": "non è vero", "short_negative": "no!",
+           "dissatisfaction": "ancora rotto",
+           "satisfaction": "ora funziona", "resolution": "ecco la correzione",
+           "completion": "ho finito",
+           "neutral": "il cielo è azzurro"},
+    "zh": {"correction": "你理解错了", "short_negative": "没有",
+           "dissatisfaction": "太烦了",
+           "satisfaction": "解决了", "resolution": "改好了",
+           "completion": "搞定了",
+           "neutral": "今天天气很好"},
+    "ja": {"correction": "そうじゃなくて", "short_negative": "いや",
+           "dissatisfaction": "まだエラーです",
+           "satisfaction": "動きました", "resolution": "訂正します",
+           "completion": "更新済み",
+           "neutral": "今日は天気がいい"},
+    "ko": {"correction": "그게 아니에요", "short_negative": "아뇨",
+           "dissatisfaction": "소용없어요",
+           "satisfaction": "이제 돼요", "resolution": "정정합니다",
+           "completion": "다 됐어요",
+           "neutral": "오늘 날씨가 좋다"},
+    "ru": {"correction": "это не так", "short_negative": "не",
+           "dissatisfaction": "всё ещё падает",
+           "satisfaction": "теперь работает", "resolution": "вот исправление",
+           "completion": "я закончил",
+           "neutral": "сегодня хорошая погода"},
+}
+
+CATEGORY_ATTR = {
+    "correction": "correction",
+    "short_negative": "short_negatives",
+    "dissatisfaction": "dissatisfaction",
+    "satisfaction": "satisfaction_overrides",
+    "resolution": "resolution",
+    "completion": "completion_claims",
+}
+
+_COMPILED = {code: compile_signal_patterns([code]) for code in CASES}
+
+
+def fires(code, attr, text):
+    return any(rx.search(text) for rx in getattr(_COMPILED[code], attr))
+
+
+def _flat():
+    return [(code, cat) for code in CASES for cat in CATEGORY_ATTR]
+
+
+class TestPerLanguagePhrasings:
+    @pytest.mark.parametrize("code,cat", _flat(),
+                             ids=[f"{c}-{k}" for c, k in _flat()])
+    def test_fresh_phrasing_fires(self, code, cat):
+        text = CASES[code][cat]
+        assert fires(code, CATEGORY_ATTR[cat], text), (code, cat, text)
+
+
+class TestNeutralNegativeControls:
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_neutral_matches_no_category(self, code):
+        text = CASES[code]["neutral"]
+        for cat, attr in CATEGORY_ATTR.items():
+            assert not fires(code, attr, text), (code, cat, text)
+
+
+class TestPackRegistry:
+    def test_all_ten_languages_registered(self):
+        assert len(SIGNAL_PACKS) == 10
+        assert set(SIGNAL_PACKS) == set(CASES)
+
+    @pytest.mark.parametrize("code", sorted(CASES))
+    def test_every_pack_has_all_six_categories(self, code):
+        pack = SIGNAL_PACKS[code]
+        for attr in CATEGORY_ATTR.values():
+            assert getattr(pack, attr), (code, attr)
+
+    def test_cjk_packs_case_sensitive(self):
+        # flags=0 for zh/ja/ko: IGNORECASE is meaningless and Unicode
+        # case-folding can only cause surprises
+        for code in ("zh", "ja", "ko"):
+            assert SIGNAL_PACKS[code].flags == 0
+
+
+class TestMergeAndIsolation:
+    def test_merged_packs_fire_on_both_languages(self):
+        merged = compile_signal_patterns(["en", "de"])
+        assert any(rx.search("that's incorrect") for rx in merged.correction)
+        assert any(rx.search("du irrst dich") for rx in merged.correction)
+
+    def test_single_pack_ignores_other_languages(self):
+        assert not fires("en", "correction", "du irrst dich")
+        assert not fires("de", "correction", "tu te trompes")
+        assert not fires("zh", "dissatisfaction", "still failing")
+
+    def test_unknown_codes_skipped_in_compile(self):
+        compiled = compile_signal_patterns(["en", "xx"])
+        assert any(rx.search("that's incorrect") for rx in compiled.correction)

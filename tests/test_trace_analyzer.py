@@ -504,3 +504,109 @@ class TestAnalyzer:
         write_json_atomic(tmp_path / "facts.json", {"facts": facts})
         assert registry.load_facts_from_file(tmp_path / "facts.json") == 1
         assert registry.lookup("backup.timer", "state").value == "disabled"
+
+
+class TestSimilarityBackendSafety:
+    """Unpinned processes must never gamble on default-backend init: the
+    batched kernels fall back to numpy formulations with identical padded
+    semantics (similarity.py _jax_enabled; observed wedge: round-5 bench)."""
+
+    def test_numpy_batch_levenshtein_matches_jax(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        pairs = [("kitten", "sitting"), ("make build", "make build "),
+                 ("", ""), ("abc", ""), ("same", "same"),
+                 ("a" * 200, "a" * 199 + "b"), ("héllo", "hello")] * 6
+        A = sim._tokenize_fixed([p[0] for p in pairs], 128)
+        B = sim._tokenize_fixed([p[1] for p in pairs], 128)
+        la = (A > 0).sum(axis=1).astype(np.int32)
+        lb = (B > 0).sum(axis=1).astype(np.int32)
+        jaxed = np.asarray(sim._batch_levenshtein_jax(A, B, la, lb))
+        nped = sim._batch_levenshtein_numpy(A, B, la, lb)
+        assert np.array_equal(jaxed, nped)
+
+    def test_default_path_avoids_jax_when_unpinned(self, monkeypatch):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        monkeypatch.setattr(sim, "_jax_enabled", lambda: False)
+
+        def boom(*a, **k):
+            raise AssertionError("jax path must not run when unpinned")
+
+        monkeypatch.setattr(sim, "_batch_levenshtein_jax", boom)
+        monkeypatch.setattr(sim, "_jaccard_matrix_jax", boom)
+        pairs = [("make build", "make test")] * 40  # ≥ batch gate
+        ratios = sim.batch_levenshtein_ratio(pairs)
+        assert ratios.shape == (40,)
+        sets = [{"a": i % 3} for i in range(70)]  # ≥ jax gate
+        M = sim.jaccard_matrix(sets)
+        assert M.shape == (70, 70)
+
+    def test_jax_enabled_in_pinned_test_process(self):
+        # conftest pins jax_platforms=cpu, so the jax path IS exercised here
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        assert sim._jax_enabled()
+
+    def test_env_opt_in_forces_enabled(self, monkeypatch):
+        # isolate the env branch: fake an UNPINNED process first, then the
+        # env opt-in must flip the verdict on its own
+        from vainplex_openclaw_tpu.utils import jax_safety
+
+        class FakeConfig:
+            jax_platforms = None
+
+        class FakeJax:
+            config = FakeConfig()
+
+        import sys
+
+        monkeypatch.setitem(sys.modules, "jax", FakeJax())
+        monkeypatch.delenv("OPENCLAW_SIMILARITY_DEVICE", raising=False)
+        monkeypatch.delenv("OPENCLAW_ALLOW_DEFAULT_BACKEND", raising=False)
+        assert not jax_safety.backend_init_safe()
+        monkeypatch.setenv("OPENCLAW_SIMILARITY_DEVICE", "default")
+        assert jax_safety.backend_init_safe()
+        monkeypatch.delenv("OPENCLAW_SIMILARITY_DEVICE")
+        monkeypatch.setenv("OPENCLAW_ALLOW_DEFAULT_BACKEND", "1")
+        assert jax_safety.backend_init_safe()
+
+    def test_unpinned_analyzer_skips_local_triage(self, tmp_path, monkeypatch):
+        """In an unpinned process with the shipped checkpoint present, the
+        analyzer's AUTO triage path must degrade rather than initialize the
+        default backend (the round-5 hang, one stage later)."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer import (
+            MemoryTraceSource, TraceAnalyzer)
+        from vainplex_openclaw_tpu.cortex.trace_analyzer import analyzer as an_mod
+        from vainplex_openclaw_tpu.cortex.trace_analyzer import classifier as cl_mod
+        from vainplex_openclaw_tpu.utils import jax_safety
+        from vainplex_openclaw_tpu.core import list_logger
+        from trace_helpers import EventFactory
+
+        monkeypatch.setattr(jax_safety, "backend_init_safe", lambda: False)
+
+        def boom(*a, **k):
+            raise AssertionError("local triage must not load jax when unpinned")
+
+        monkeypatch.setattr(cl_mod, "local_triage", boom)
+        f = EventFactory(agent="main", session="s1")
+        raws = [f.msg_in("run the deploy"), f.tool_call("exec", {"command": "x"}),
+                f.tool_result("exec", error="boom"),
+                f.tool_call("exec", {"command": "x"}),
+                f.tool_result("exec", error="boom"),
+                f.msg_out("done")]
+        log = list_logger()
+        analyzer = TraceAnalyzer({"languages": ["en"],
+                                  "classify": {"enabled": True}},
+                                 str(tmp_path), log,
+                                 source=MemoryTraceSource(raws))
+        report = analyzer.run()  # must complete without touching triage
+        assert report["runStats"]["signals"] > 0
+        assert any("local triage skipped" in m for m in log.messages("info"))
+
+    def test_explicit_use_jax_false_stays_exact_scalar(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        pairs = [("x" * 600, "x" * 600)] * 40  # beyond the 128 pad length
+        exact = sim.batch_levenshtein_ratio(pairs, use_jax=False)
+        assert np.all(exact == 1.0)

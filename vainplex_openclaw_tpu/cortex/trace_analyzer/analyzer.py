@@ -94,10 +94,22 @@ class TraceAnalyzer:
             if signals and (ccfg.get("enabled") or self.triage_llm or self.deep_llm):
                 chains_by_id = {c.id: c for c in chains}
                 use_local = ccfg.get("useLocalTriage")
-                if use_local is None:  # auto: on iff trained weights shipped
+                if use_local is None:
+                    # auto: on iff trained weights shipped AND this process
+                    # can initialize a jax backend without gambling on a
+                    # wedged remote-accelerator plugin (utils/jax_safety).
+                    # An explicit useLocalTriage: true is the operator's
+                    # deliberate choice and is not gated.
                     from ...models.pretrained import available
+                    from ...utils.jax_safety import backend_init_safe
 
-                    use_local = available()
+                    shipped = available()
+                    use_local = shipped and backend_init_safe()
+                    if shipped and not use_local:
+                        self.logger.info(
+                            "local triage skipped: jax not pinned to local "
+                            "platforms in this process (set jax_platforms="
+                            "'cpu' or OPENCLAW_ALLOW_DEFAULT_BACKEND=1)")
                 classified = classify_findings(
                     signals, chains_by_id, self.triage_llm, self.deep_llm,
                     self.logger, use_local_triage=bool(use_local))

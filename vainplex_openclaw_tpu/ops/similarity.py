@@ -109,9 +109,11 @@ def jaccard_matrix(param_sets: list[dict], dim: int = 1024,
     """
     X = hashed_multi_hot(param_sets, dim)
     if use_jax is None:
-        use_jax = len(param_sets) >= 64
+        use_jax = len(param_sets) >= 64 and _jax_enabled()
     if use_jax:
         return np.asarray(_jaccard_matrix_jax(X))
+    # numpy formulation — identical math, and the safe default in processes
+    # that never pinned a jax platform (see _jax_enabled)
     inter = X @ X.T
     counts = X.sum(axis=1)
     union = counts[:, None] + counts[None, :] - inter
@@ -130,6 +132,22 @@ def _jaccard_matrix_jax_impl(X):
 
 
 _jaccard_jit = None
+
+
+def _jax_enabled() -> bool:
+    """Whether the batched kernels may touch jax AT ALL in this process.
+
+    In an UNPINNED process, any device lookup initializes every registered
+    platform — including a remote-accelerator plugin whose wedged tunnel
+    blocks forever inside device init with no exception to catch (observed
+    live in round 5: the axon client hung the whole bench). The trace
+    analyzer runs on an operational latency budget, so without a pinned
+    platform it degrades to the numpy formulations below instead of
+    gambling on backend init. See utils/jax_safety.py for what counts as
+    safe."""
+    from ..utils.jax_safety import backend_init_safe
+
+    return backend_init_safe()
 
 
 def _jaccard_matrix_jax(X: np.ndarray):
@@ -193,6 +211,31 @@ def _batch_levenshtein_jax(A: np.ndarray, B: np.ndarray, len_a: np.ndarray,
     return _batch_lev_jit(A, B, len_a, len_b)
 
 
+def _batch_levenshtein_numpy(A: np.ndarray, B: np.ndarray, len_a: np.ndarray,
+                             len_b: np.ndarray) -> np.ndarray:
+    """Vectorized numpy batch Levenshtein with the SAME padded semantics as
+    the jax path — the degraded-mode formulation for unpinned processes.
+
+    Row DP over b; the within-row left dependency
+    ``curr[j] = min(tent[j], curr[j-1] + 1)`` is a prefix-min in disguise:
+    ``curr[j] = min_{k≤j}(tent[k] + (j-k)) = cummin(tent - idx) + idx``,
+    so each of the L rows is a handful of whole-batch vector ops instead of
+    an N×L Python loop."""
+    n, L = A.shape
+    idx = np.arange(L + 1, dtype=np.int32)
+    prev = np.broadcast_to(idx, (n, L + 1)).copy()
+    for i in range(1, L + 1):
+        bi = B[:, i - 1][:, None]
+        cost = (A != bi).astype(np.int32)
+        tent = np.empty_like(prev)
+        tent[:, 0] = i
+        tent[:, 1:] = np.minimum(prev[:, 1:] + 1, prev[:, :-1] + cost)
+        curr = np.minimum.accumulate(tent - idx, axis=1) + idx
+        keep = (i <= len_b)[:, None]  # rows past b's length leave the DP alone
+        prev = np.where(keep, curr, prev)
+    return prev[np.arange(n), len_a]
+
+
 def batch_levenshtein_ratio(pairs: list[tuple[str, str]], length: int = 128,
                             use_jax: Optional[bool] = None) -> np.ndarray:
     """Levenshtein ratios for a batch of string pairs.
@@ -201,17 +244,21 @@ def batch_levenshtein_ratio(pairs: list[tuple[str, str]], length: int = 128,
     ``length`` bytes — fine for loop detection on commands); the scalar path
     is exact up to the 500-char cap.
     """
-    if use_jax is None:
-        use_jax = len(pairs) >= 32
-    if not use_jax:
+    batched = len(pairs) >= 32 if use_jax is None else use_jax
+    if not batched:
         return np.array([levenshtein_ratio(a, b) for a, b in pairs], dtype=np.float32)
+    if use_jax is None:
+        use_jax = _jax_enabled()
     a_strs = [p[0] for p in pairs]
     b_strs = [p[1] for p in pairs]
     A = _tokenize_fixed(a_strs, length)
     B = _tokenize_fixed(b_strs, length)
     len_a = (A > 0).sum(axis=1).astype(np.int32)
     len_b = (B > 0).sum(axis=1).astype(np.int32)
-    dist = np.asarray(_batch_levenshtein_jax(A, B, len_a, len_b))
+    if use_jax:
+        dist = np.asarray(_batch_levenshtein_jax(A, B, len_a, len_b))
+    else:
+        dist = _batch_levenshtein_numpy(A, B, len_a, len_b)
     max_len = np.maximum(len_a, len_b)
     with np.errstate(divide="ignore", invalid="ignore"):
         ratio = np.where(max_len > 0, 1.0 - dist / max_len, 1.0)

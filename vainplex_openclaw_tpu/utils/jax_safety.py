@@ -1,0 +1,49 @@
+"""Is it safe to let jax initialize a backend in THIS process?
+
+Initializing the default backend resolves and initializes EVERY registered
+platform plugin. A remote-accelerator plugin (the axon TPU tunnel on this
+image) can block forever inside its client init when the tunnel is wedged —
+no exception fires, the calling thread just stops (observed live in round
+5: the analyzer's clustering stage hung the whole bench budget).
+
+Safe means one of:
+- the process pinned its platform set to LOCAL platforms only — in
+  practice ``jax.config.update("jax_platforms", "cpu")`` before first
+  init (what the test conftest, bench.py, and force-CPU entry points all
+  do). A merely *pinned* set is NOT enough: this image presets
+  ``jax_platforms='axon,cpu'`` at plugin registration, and initializing
+  that set is exactly the hang. Local backends cannot wedge; remote ones
+  can, at init time or any dispatch after.
+- the operator explicitly accepted default/remote-backend init via
+  ``OPENCLAW_ALLOW_DEFAULT_BACKEND=1`` (or the older
+  ``OPENCLAW_SIMILARITY_DEVICE=default``), taking on the hang risk.
+
+AUTO features that would otherwise silently pull jax into a
+latency-sensitive process (analyzer batch kernels, local-triage
+auto-enable) consult this and degrade instead of gambling. Explicitly
+configured jax features (``useLocalTriage: true``, the local embeddings
+backend) are an operator's deliberate choice and are not gated.
+"""
+
+from __future__ import annotations
+
+import os
+
+_LOCAL_PLATFORMS = {"cpu"}
+
+
+def backend_init_safe() -> bool:
+    if os.environ.get("OPENCLAW_ALLOW_DEFAULT_BACKEND") == "1":
+        return True
+    if os.environ.get("OPENCLAW_SIMILARITY_DEVICE") == "default":
+        return True
+    try:
+        import jax
+
+        platforms = jax.config.jax_platforms
+    except Exception:  # noqa: BLE001 — no jax → nothing to initialize
+        return False
+    if not platforms:
+        return False
+    names = {p.strip().lower() for p in str(platforms).split(",") if p.strip()}
+    return bool(names) and names <= _LOCAL_PLATFORMS
