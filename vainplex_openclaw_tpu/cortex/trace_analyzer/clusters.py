@@ -8,10 +8,11 @@ duplicates, so the report can say "these 14 signals across 9 chains are one
 problem" instead of listing them 14 times.
 
 This is the production all-pairs workload: for N signals the pairwise
-Jaccard matrix is one ``X @ X.T`` on the MXU via ``ops.similarity
-.jaccard_matrix`` (hashed multi-hot features), not N²/2 Python set
-intersections. Consecutive-pair similarity inside one window stays scalar/
-batched-DP in signals.py; *this* is where the matmul kernel earns its keep.
+Jaccard matrix is one ``X @ X.T`` via ``ops.similarity.jaccard_matrix``
+(hashed multi-hot features), not N²/2 Python set intersections — the jax
+kernel when the process is backend-safe (utils/jax_safety), the identical
+numpy formulation otherwise. Consecutive-pair similarity inside one window
+stays scalar/batched-DP in signals.py; *this* is the all-pairs matmul.
 
 No reference counterpart: the reference's trace analyzer stops at exact
 signatures (doom-loop.ts / report.ts); clustering is an original extension
