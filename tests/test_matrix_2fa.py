@@ -207,3 +207,122 @@ class TestMatrix2FAEndToEnd:
             assert d.blocked  # times out → deny; rando's code never approves
         finally:
             gw.stop()
+
+
+class TestPollerUnit:
+    """MatrixPoller mechanics without a homeserver (reference:
+    matrix-poller.ts:1-40; complements the e2e flow above)."""
+
+    def make(self, responses, creds=None):
+        calls = []
+
+        def http_get(url, headers, timeout=10.0):
+            calls.append({"url": url, "headers": headers})
+            r = responses[min(len(calls) - 1, len(responses) - 1)]
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+        from vainplex_openclaw_tpu.governance.approval.poller import MatrixPoller
+
+        self.codes = []
+        self.log = list_logger()
+        poller = MatrixPoller(
+            creds or {"homeserver": "https://m.org/", "accessToken": "tok",
+                      "roomId": "!room:m.org"},
+            on_code=lambda code, sender: self.codes.append((code, sender)),
+            logger=self.log, interval_s=0.01, http_get=http_get)
+        self.calls = calls
+        return poller
+
+    def msg(self, body, sender="@boss:m.org", type_="m.room.message"):
+        return {"type": type_, "sender": sender, "content": {"body": body}}
+
+    def test_url_auth_and_code_dispatch(self):
+        poller = self.make([{"chunk": [self.msg("code is 123456 thanks")],
+                             "start": "t1"}])
+        assert poller.poll_once() == 1
+        assert self.codes == [("123456", "@boss:m.org")]
+        [call] = self.calls
+        assert call["url"].startswith(
+            "https://m.org/_matrix/client/v3/rooms/!room:m.org/messages")
+        assert call["headers"]["Authorization"] == "Bearer tok"
+
+    def test_pagination_token_carried_forward(self):
+        poller = self.make([{"chunk": [], "start": "t1"}, {"chunk": []}])
+        poller.poll_once()
+        poller.poll_once()
+        assert "from=t1" in self.calls[1]["url"]
+        poller.poll_once()  # missing start keeps the old token
+        assert "from=t1" in self.calls[2]["url"]
+
+    def test_non_message_events_and_codeless_bodies_skipped(self):
+        poller = self.make([{"chunk": [
+            self.msg("hello no code"),
+            self.msg("987654", type_="m.reaction"),
+            {"type": "m.room.message", "sender": "@x:m.org", "content": {}},
+            self.msg("valid 654321")]}])
+        assert poller.poll_once() == 1
+        assert self.codes == [("654321", "@boss:m.org")]
+
+    def test_six_digit_boundary(self):
+        poller = self.make([{"chunk": [
+            self.msg("12345"), self.msg("1234567"), self.msg("ok 111222 ok")]}])
+        assert poller.poll_once() == 1
+        assert self.codes[0][0] == "111222"
+
+    def test_loop_survives_http_failures(self):
+        import time as _t
+
+        poller = self.make([ConnectionError("down"),
+                            {"chunk": [self.msg("222333")]}])
+        poller.start()
+        deadline = _t.time() + 2
+        while not self.codes and _t.time() < deadline:
+            _t.sleep(0.01)
+        poller.stop()
+        assert self.codes and self.codes[0][0] == "222333"
+        assert any("Matrix poll failed" in m for m in self.log.messages("warn"))
+
+    def test_start_idempotent_stop_joins(self):
+        poller = self.make([{"chunk": []}])
+        poller.start()
+        first = poller._thread
+        poller.start()
+        assert poller._thread is first
+        poller.stop()
+        assert poller._thread is None
+
+
+class TestCredentialLoading:
+    def test_valid_credentials(self, tmp_path):
+        from vainplex_openclaw_tpu.governance.approval.poller import (
+            load_matrix_credentials)
+        from vainplex_openclaw_tpu.storage.atomic import write_json_atomic
+
+        p = tmp_path / "creds.json"
+        write_json_atomic(p, {"homeserver": "https://m.org",
+                              "accessToken": "tok", "roomId": "!r:m.org",
+                              "userId": "@bot:m.org"})
+        creds = load_matrix_credentials(str(p))
+        assert creds["roomId"] == "!r:m.org"
+
+    @pytest.mark.parametrize("payload", [
+        {"homeserver": "https://m.org"},                      # missing fields
+        {"homeserver": "", "accessToken": "t", "roomId": "r"},  # empty value
+        ["not", "a", "dict"],
+    ])
+    def test_invalid_credentials_none(self, tmp_path, payload):
+        from vainplex_openclaw_tpu.governance.approval.poller import (
+            load_matrix_credentials)
+        from vainplex_openclaw_tpu.storage.atomic import write_json_atomic
+
+        p = tmp_path / "creds.json"
+        write_json_atomic(p, payload)
+        assert load_matrix_credentials(str(p)) is None
+
+    def test_missing_file_none(self, tmp_path):
+        from vainplex_openclaw_tpu.governance.approval.poller import (
+            load_matrix_credentials)
+
+        assert load_matrix_credentials(str(tmp_path / "no.json")) is None
