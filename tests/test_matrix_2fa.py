@@ -235,47 +235,78 @@ class TestPollerUnit:
         self.calls = calls
         return poller
 
-    def msg(self, body, sender="@boss:m.org", type_="m.room.message"):
-        return {"type": type_, "sender": sender, "content": {"body": body}}
+    _seq = 0
 
-    def test_url_auth_and_code_dispatch(self):
-        poller = self.make([{"chunk": [self.msg("code is 123456 thanks")],
-                             "start": "t1"}])
+    def msg(self, body, sender="@boss:m.org", type_="m.room.message",
+            event_id=None):
+        TestPollerUnit._seq += 1
+        return {"type": type_, "sender": sender, "content": {"body": body},
+                "event_id": event_id or f"$auto{TestPollerUnit._seq}"}
+
+    def test_init_sync_then_forward_polling(self):
+        """Matrix protocol shape (matrix-poller.ts:91-146): first call is a
+        dir=b limit=1 init-sync grabbing the newest 'end' token; subsequent
+        polls go FORWARD from it — dir=b + start would freeze the window and
+        codes posted after startup would never be seen."""
+        poller = self.make([
+            {"chunk": [self.msg("old history 999999", event_id="$old")],
+             "end": "t1"},
+            {"chunk": [self.msg("code is 123456 thanks", event_id="$new")],
+             "end": "t2"},
+            {"chunk": [], "end": "t3"}])
+        assert poller.poll_once() == 0  # init-sync only: history NOT replayed
+        assert "dir=b&limit=1" in self.calls[0]["url"]
+        # room id percent-encoded like the notifier does
+        assert "rooms/%21room%3Am.org/messages" in self.calls[0]["url"]
+        assert self.calls[0]["headers"]["Authorization"] == "Bearer tok"
         assert poller.poll_once() == 1
+        assert "dir=f" in self.calls[1]["url"] and "from=t1" in self.calls[1]["url"]
         assert self.codes == [("123456", "@boss:m.org")]
-        [call] = self.calls
-        assert call["url"].startswith(
-            "https://m.org/_matrix/client/v3/rooms/!room:m.org/messages")
-        assert call["headers"]["Authorization"] == "Bearer tok"
+        poller.poll_once()
+        assert "from=t2" in self.calls[2]["url"]
 
-    def test_pagination_token_carried_forward(self):
-        poller = self.make([{"chunk": [], "start": "t1"}, {"chunk": []}])
+    def test_missing_end_token_keeps_old_cursor(self):
+        poller = self.make([{"chunk": [], "end": "t1"},
+                            {"chunk": []},  # no end
+                            {"chunk": []}])
+        poller.poll_once()  # init
         poller.poll_once()
         poller.poll_once()
-        assert "from=t1" in self.calls[1]["url"]
-        poller.poll_once()  # missing start keeps the old token
         assert "from=t1" in self.calls[2]["url"]
 
+    def test_event_id_dedupe_across_polls(self):
+        """Window-edge overlap must not re-dispatch: a replayed INVALID code
+        would burn an approval attempt."""
+        page = {"chunk": [self.msg("code 123456", event_id="$e1")], "end": "t2"}
+        poller = self.make([{"chunk": [], "end": "t1"}, page, page])
+        poller.poll_once()  # init
+        assert poller.poll_once() == 1
+        assert poller.poll_once() == 0  # same event id — not re-dispatched
+        assert self.codes == [("123456", "@boss:m.org")]
+
     def test_non_message_events_and_codeless_bodies_skipped(self):
-        poller = self.make([{"chunk": [
+        poller = self.make([{"chunk": [], "end": "t1"}, {"chunk": [
             self.msg("hello no code"),
             self.msg("987654", type_="m.reaction"),
             {"type": "m.room.message", "sender": "@x:m.org", "content": {}},
             self.msg("valid 654321")]}])
+        poller.poll_once()  # init
         assert poller.poll_once() == 1
         assert self.codes == [("654321", "@boss:m.org")]
 
     def test_six_digit_boundary(self):
-        poller = self.make([{"chunk": [
+        poller = self.make([{"chunk": [], "end": "t1"}, {"chunk": [
             self.msg("12345"), self.msg("1234567"), self.msg("ok 111222 ok")]}])
+        poller.poll_once()  # init
         assert poller.poll_once() == 1
         assert self.codes[0][0] == "111222"
 
     def test_loop_survives_http_failures(self):
         import time as _t
 
-        poller = self.make([ConnectionError("down"),
-                            {"chunk": [self.msg("222333")]}])
+        poller = self.make([{"chunk": [], "end": "t1"},
+                            ConnectionError("down"),
+                            {"chunk": [self.msg("222333")], "end": "t2"}])
         poller.start()
         deadline = _t.time() + 2
         while not self.codes and _t.time() < deadline:

@@ -13,11 +13,14 @@ from __future__ import annotations
 import json
 import re
 import threading
+from collections import deque
 from typing import Callable, Optional
+from urllib.parse import quote
 
 from ...storage.atomic import read_json
 
 CODE_RE = re.compile(r"\b(\d{6})\b")
+SEEN_CAP = 200
 
 
 def load_matrix_credentials(path: str) -> Optional[dict]:
@@ -48,6 +51,8 @@ class MatrixPoller:
         self.interval_s = interval_s
         self.http_get = http_get
         self._since: Optional[str] = None
+        self._seen: deque[str] = deque(maxlen=SEEN_CAP)
+        self._seen_set: set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -72,16 +77,55 @@ class MatrixPoller:
             except Exception as exc:  # noqa: BLE001 — keep polling through transient failures
                 self.logger.warn(f"[2fa] Matrix poll failed: {exc}")
 
-    def poll_once(self) -> int:
-        """One fetch of recent room messages; returns # codes dispatched."""
-        room = self.creds["roomId"]
+    def _messages_url(self, query: str) -> str:
         base = self.creds["homeserver"].rstrip("/")
-        url = f"{base}/_matrix/client/v3/rooms/{room}/messages?dir=b&limit=10"
-        if self._since:
-            url += f"&from={self._since}"
-        data = self.http_get(url, {"Authorization": f"Bearer {self.creds['accessToken']}"})
+        room = quote(self.creds["roomId"], safe="")  # '!'/':' are reserved
+        return f"{base}/_matrix/client/v3/rooms/{room}/messages?{query}"
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.creds['accessToken']}"}
+
+    def _remember(self, event_id: str) -> None:
+        if len(self._seen) == self._seen.maxlen:
+            self._seen_set.discard(self._seen[0])
+        self._seen.append(event_id)
+        self._seen_set.add(event_id)
+
+    def _init_sync(self) -> None:
+        """Grab the room's newest pagination token so polling only ever sees
+        NEW messages (reference matrix-poller.ts:91-112 — historical codes
+        must not replay into fresh batches)."""
+        data = self.http_get(self._messages_url("dir=b&limit=1"), self._headers())
+        self._since = data.get("end")
+        for event in data.get("chunk", []):
+            if event.get("event_id"):
+                self._remember(event["event_id"])
+
+    def poll_once(self) -> int:
+        """One forward fetch of new room messages; returns # codes dispatched.
+
+        Protocol per the Matrix spec and the reference (matrix-poller.ts:
+        118-146): paginate FORWARD (``dir=f``) from the last ``end`` token —
+        with ``dir=b`` the ``start`` token only re-requests the same page,
+        freezing the window so codes posted after startup are never seen.
+        Event-id dedupe guards the overlap at window edges (a replayed
+        invalid code would burn an attempt). Deviation kept from the
+        reference: codes are matched at word boundaries inside free text
+        (``handle_2fa_code`` parity), not exact-body-only."""
+        if self._since is None:
+            self._init_sync()
+            return 0
+        url = self._messages_url(f"dir=f&from={quote(self._since, safe='')}&limit=10")
+        data = self.http_get(url, self._headers())
+        if data.get("end"):
+            self._since = data["end"]
         dispatched = 0
         for event in data.get("chunk", []):
+            event_id = event.get("event_id")
+            if event_id:
+                if event_id in self._seen_set:
+                    continue
+                self._remember(event_id)
             if event.get("type") != "m.room.message":
                 continue
             body = (event.get("content") or {}).get("body") or ""
@@ -90,5 +134,4 @@ class MatrixPoller:
             if m:
                 self.on_code(m.group(1), sender)
                 dispatched += 1
-        self._since = data.get("start") or self._since
         return dispatched
