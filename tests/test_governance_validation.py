@@ -326,11 +326,16 @@ class Test2FA:
 class TestMatrixPoller:
     def test_poll_dispatches_codes(self):
         codes = []
-        responses = [{"chunk": [
-            {"type": "m.room.message", "sender": "@boss:m.org",
-             "content": {"body": "approval 123456 please"}},
-            {"type": "m.room.member", "content": {"body": "999999"}},
-        ], "start": "tok1"}]
+        responses = [
+            {"chunk": [], "end": "tok1"},  # init-sync: newest token only
+            {"chunk": [
+                {"type": "m.room.message", "sender": "@boss:m.org",
+                 "content": {"body": "approval 123456 please"},
+                 "event_id": "$c1"},
+                {"type": "m.room.member", "content": {"body": "999999"},
+                 "event_id": "$c2"},
+            ], "end": "tok2"},
+        ]
 
         def fake_get(url, headers, timeout=10.0):
             assert "Bearer tok" in headers["Authorization"]
@@ -340,6 +345,7 @@ class TestMatrixPoller:
                                "roomId": "!r:m.org"},
                               lambda code, sender: codes.append((code, sender)),
                               list_logger(), http_get=fake_get)
+        assert poller.poll_once() == 0  # init-sync
         assert poller.poll_once() == 1
         assert codes == [("123456", "@boss:m.org")]
 
