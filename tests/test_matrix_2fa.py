@@ -302,15 +302,13 @@ class TestPollerUnit:
         assert self.codes[0][0] == "111222"
 
     def test_loop_survives_http_failures(self):
-        import time as _t
-
         poller = self.make([{"chunk": [], "end": "t1"},
                             ConnectionError("down"),
                             {"chunk": [self.msg("222333")], "end": "t2"}])
         poller.start()
-        deadline = _t.time() + 2
-        while not self.codes and _t.time() < deadline:
-            _t.sleep(0.01)
+        deadline = time.time() + 2
+        while not self.codes and time.time() < deadline:
+            time.sleep(0.01)
         poller.stop()
         assert self.codes and self.codes[0][0] == "222333"
         assert any("Matrix poll failed" in m for m in self.log.messages("warn"))
