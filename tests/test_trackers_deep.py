@@ -1,0 +1,282 @@
+"""Commitment + decision tracker depth: the commitment pattern matrix with
+non-committal filtering, the overdue/reopen lifecycle, decision extraction
+windows with why-clauses, impact inference, Jaccard dedupe, caps,
+persistence and corrupt-file tolerance (reference: cortex/test/
+{commitment-tracker,commitment-patterns,decision-tracker}.test.ts —
+59 cases; VERDICT r4 #5 test-depth parity).
+
+Complements test_cortex_trackers.py (happy-path lifecycle).
+"""
+
+import pytest
+
+from vainplex_openclaw_tpu.core import list_logger
+from vainplex_openclaw_tpu.cortex.commitment_tracker import (
+    CommitmentTracker,
+    detect_commitments,
+)
+from vainplex_openclaw_tpu.cortex.decision_tracker import DecisionTracker
+from vainplex_openclaw_tpu.cortex.patterns import MergedPatterns
+from vainplex_openclaw_tpu.cortex.storage import load_json, reboot_dir
+
+from helpers import FakeClock
+
+
+class TestCommitmentPatterns:
+    @pytest.mark.parametrize("text", [
+        "I'll deploy the fix tomorrow morning",
+        "I will update the documentation today",
+        "let me check the logs first",
+        "I am going to rewrite that module",
+        "I can handle the migration work",
+        "ich werde das morgen erledigen",
+        "ich mache das heute abend",
+    ])
+    def test_commitment_phrasings_detected(self, text):
+        assert detect_commitments(text), text
+
+    @pytest.mark.parametrize("text", [
+        "sounds good", "agreed", "that works for me", "ok great",
+        "the deploy finished", "",
+    ])
+    def test_casual_acknowledgements_not_commitments(self, text):
+        assert detect_commitments(text) == []
+
+    @pytest.mark.parametrize("text", [
+        "I'll think about it", "I'll probably look later",
+        "I will maybe try something", "let me see what happens",
+        "I'll check if it matters",
+    ])
+    def test_non_committal_hedges_filtered(self, text):
+        assert detect_commitments(text) == []
+
+    def test_captured_what_is_the_promise_body(self):
+        [what] = detect_commitments("I'll deploy the billing fix tonight.")
+        assert what.startswith("deploy the billing fix")
+        assert not what.endswith(".")
+
+    def test_multiple_commitments_in_one_message(self):
+        found = detect_commitments(
+            "I'll update the docs. Also let me refactor the loader.")
+        assert len(found) == 2
+
+
+class TestCommitmentLifecycle:
+    def make(self, tmp_path, clock=None, **config):
+        clock = clock or FakeClock()
+        tracker = CommitmentTracker(tmp_path, config, list_logger(),
+                                    clock=clock, wall_timers=False)
+        return tracker, clock
+
+    def test_process_records_open_commitment(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("I'll fix the race condition", sender="agent")
+        [c] = tracker.open_commitments()
+        assert c["status"] == "open" and c["sender"] == "agent"
+        assert c["what"].startswith("fix the race")
+
+    def test_same_promise_not_duplicated(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("I'll fix the race condition")
+        tracker.process_message("I'll fix the race condition")
+        assert len(tracker.commitments) == 1
+
+    def test_overdue_after_config_days(self, tmp_path):
+        tracker, clock = self.make(tmp_path, overdueDays=7)
+        tracker.process_message("I'll fix the race condition")
+        clock.advance(6 * 86400)
+        assert tracker.mark_overdue() == 0
+        clock.advance(2 * 86400)
+        assert tracker.mark_overdue() == 1
+        [c] = tracker.open_commitments()  # overdue still counts as open work
+        assert c["status"] == "overdue"
+
+    def test_restating_overdue_promise_reopens_it(self, tmp_path):
+        tracker, clock = self.make(tmp_path, overdueDays=1)
+        tracker.process_message("I'll fix the race condition")
+        clock.advance(3 * 86400)
+        tracker.mark_overdue()
+        tracker.process_message("I'll fix the race condition")
+        [c] = tracker.commitments
+        assert c["status"] == "open"  # reopened, not duplicated
+
+    def test_resolve_marks_and_timestamps(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("I'll fix the race condition")
+        cid = tracker.commitments[0]["id"]
+        assert tracker.resolve(cid) is True
+        assert tracker.commitments[0]["status"] == "resolved"
+        assert tracker.commitments[0]["resolved"]
+        assert tracker.open_commitments() == []
+
+    def test_resolve_unknown_or_resolved_false(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        assert tracker.resolve("nope") is False
+        tracker.process_message("I'll fix the race condition")
+        cid = tracker.commitments[0]["id"]
+        tracker.resolve(cid)
+        assert tracker.resolve(cid) is False  # already resolved
+
+    def test_max_commitments_cap_keeps_newest(self, tmp_path):
+        tracker, _ = self.make(tmp_path, maxCommitments=3)
+        for i in range(5):
+            tracker.process_message(f"I'll handle task number {i} soon")
+        assert len(tracker.commitments) == 3
+        assert "task number 4" in tracker.commitments[-1]["what"]
+
+    def test_flush_persists_and_reloads(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("I'll fix the race condition")
+        tracker.flush()
+        data = load_json(reboot_dir(tmp_path) / "commitments.json")
+        assert data["version"] == 1 and len(data["commitments"]) == 1
+        fresh, _ = self.make(tmp_path)
+        assert len(fresh.commitments) == 1
+
+
+EN = MergedPatterns(["en", "de"])
+
+
+def make_decision_tracker(tmp_path, clock=None, **config):
+    clock = clock or FakeClock()
+    return DecisionTracker(tmp_path, config, EN, list_logger(),
+                           clock=clock), clock
+
+
+class TestDecisionExtraction:
+    make = staticmethod(make_decision_tracker)
+
+    def test_english_decision_with_date_and_id(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("we decided to adopt the event bus")
+        [d] = tracker.decisions
+        assert "adopt the event bus" in d["what"]
+        assert len(d["date"]) == 10 and d["date"].count("-") == 2
+        assert d["timestamp"].endswith("Z") and d["id"]
+
+    def test_german_decision(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("wir haben beschlossen, die Queue zu nutzen")
+        assert tracker.decisions
+
+    def test_why_clause_extracted_and_not_repeated(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message(
+            "we decided to use postgres because the team knows it well")
+        [d] = tracker.decisions
+        assert d["why"].startswith("the team knows it")
+        assert "because" not in d["what"]
+
+    def test_no_why_clause_none(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("we decided to use postgres")
+        assert tracker.decisions[0]["why"] is None
+
+    @pytest.mark.parametrize("text,impact", [
+        ("we decided to redesign the architecture", "high"),
+        ("we decided to tighten security headers", "high"),
+        ("we decided to delete the legacy tables", "high"),
+        ("we decided to rename a helper", "medium"),
+    ])
+    def test_impact_inference(self, tmp_path, text, impact):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message(text)
+        assert tracker.decisions[0]["impact"] == impact
+
+    def test_high_impact_keyword_in_why_counts(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message(
+            "we decided to add a cache because production latency is bad")
+        assert tracker.decisions[0]["impact"] == "high"
+
+    def test_unrelated_and_empty_text_no_decisions(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("the weather is nice today")
+        tracker.process_message("")
+        assert tracker.decisions == []
+
+    def test_multiple_decisions_one_message(self, tmp_path):
+        """Two decision cues far enough apart that their ±(50,100) context
+        windows stay Jaccard-distinct — adjacent cues in a short message
+        share a window and deliberately merge."""
+        tracker, _ = self.make(tmp_path)
+        filler = ("the metrics dashboards kept flapping all through the "
+                  "oncall rotation last week and nobody trusted them, "
+                  "which burned a lot of goodwill with the platform folks. ")
+        tracker.process_message(
+            "we decided to use postgres for billing data. " + filler +
+            "we agreed on weekly release trains going forward")
+        assert len(tracker.decisions) == 2
+
+
+class TestDecisionDedupe:
+    make = staticmethod(make_decision_tracker)
+
+    def test_near_identical_within_window_dropped(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("we decided to use postgres for billing data")
+        tracker.process_message("we decided to use postgres for billing data!")
+        assert len(tracker.decisions) == 1
+
+    def test_distinct_decisions_both_kept(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("we decided to use postgres for billing data")
+        tracker.process_message("we decided to adopt kafka for event streams")
+        assert len(tracker.decisions) == 2
+
+    def test_duplicate_outside_window_kept(self, tmp_path):
+        tracker, clock = self.make(tmp_path, dedupeWindowHours=24)
+        tracker.process_message("we decided to use postgres for billing data")
+        clock.advance(25 * 3600)
+        tracker.process_message("we decided to use postgres for billing data")
+        assert len(tracker.decisions) == 2
+
+    def test_max_decisions_cap_drops_oldest(self, tmp_path):
+        tracker, clock = self.make(tmp_path, maxDecisions=3, dedupeWindowHours=0)
+        for i in range(5):
+            clock.advance(3600)
+            tracker.process_message(
+                f"we decided to ship feature batch {i} to the pilot group")
+        assert len(tracker.decisions) == 3
+        assert "batch 4" in tracker.decisions[-1]["what"]
+
+    def test_llm_decisions_merge_with_dedupe(self, tmp_path):
+        tracker, _ = self.make(tmp_path)
+        tracker.process_message("we decided to use postgres for billing data")
+        tracker.add_llm_decisions([
+            "we decided to use postgres for billing data",  # dup → dropped
+            "migrate the cron jobs to the scheduler", ""])
+        whats = [d["what"] for d in tracker.decisions]
+        assert len(whats) == 2 and "cron jobs" in whats[1]
+        assert tracker.decisions[1]["sender"] == "llm"
+
+
+class TestDecisionPersistence:
+    def test_persist_and_reload(self, tmp_path):
+        tracker, clock = make_decision_tracker(tmp_path)
+        tracker.process_message("we decided to use postgres for billing data")
+        data = load_json(reboot_dir(tmp_path) / "decisions.json")
+        assert data["version"] == 1 and len(data["decisions"]) == 1
+        fresh, _ = make_decision_tracker(tmp_path, clock=clock)
+        assert len(fresh.decisions) == 1
+
+    def test_corrupt_file_tolerated(self, tmp_path):
+        d = reboot_dir(tmp_path)
+        d.mkdir(parents=True)
+        (d / "decisions.json").write_text("{not json")
+        tracker, _ = make_decision_tracker(tmp_path)
+        assert tracker.decisions == []
+        tracker.process_message("we decided to start fresh anyway")
+        assert len(tracker.decisions) == 1
+
+    def test_recent_filters_by_days_and_limit(self, tmp_path):
+        tracker, clock = make_decision_tracker(tmp_path, dedupeWindowHours=0)
+        tracker.process_message("we decided to archive the old cluster")
+        clock.advance(10 * 86400)
+        for i in range(3):
+            clock.advance(3600)
+            tracker.process_message(
+                f"we decided to promote candidate number {i} today")
+        recent = tracker.recent(days=3, limit=10)
+        assert len(recent) == 3  # the 10-day-old one filtered
+        assert len(tracker.recent(days=3, limit=2)) == 2
