@@ -18,7 +18,6 @@ from vainplex_openclaw_tpu.cortex.trace_analyzer.classifier import (
 from vainplex_openclaw_tpu.cortex.trace_analyzer.chains import ConversationChain
 from vainplex_openclaw_tpu.cortex.trace_analyzer.events import NormalizedEvent
 from vainplex_openclaw_tpu.cortex.trace_analyzer.outputs import (
-    GeneratedOutput,
     generate_outputs,
     normalize_action_text,
 )

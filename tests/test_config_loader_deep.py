@@ -1,0 +1,164 @@
+"""Config-loader depth: the full resolution matrix (defaults ⊕ external ⊕
+inline), deep-merge edge semantics, the legacy-inline heuristic's exact
+boundary, enabled-flag precedence from both sides, bootstrap behavior, and
+fail-open file handling (reference: governance/test/{config,config-loader}
+.test.ts — 41 cases, duplicated per package; VERDICT r4 #5 depth parity).
+
+Complements test_storage_config.py (happy paths).
+"""
+
+import json
+
+from vainplex_openclaw_tpu.config.loader import (
+    deep_merge,
+    load_plugin_config,
+    plugins_dir,
+    read_openclaw_config,
+)
+from vainplex_openclaw_tpu.core.api import list_logger
+
+
+def load(tmp_path, inline=None, defaults=None, **kw):
+    log = list_logger()
+    cfg = load_plugin_config("testplug", inline, defaults, home=tmp_path,
+                             logger=log, **kw)
+    return cfg, log
+
+
+class TestDeepMerge:
+    def test_nested_defaults_survive_partial_override(self):
+        defaults = {"a": {"x": 1, "y": 2}, "b": 3}
+        assert deep_merge(defaults, {"a": {"x": 9}}) == \
+            {"a": {"x": 9, "y": 2}, "b": 3}
+
+    def test_override_none_keeps_default(self):
+        assert deep_merge({"a": 1}, {"a": None}) == {"a": 1}
+        assert deep_merge(5, None) == 5
+
+    def test_scalar_replaces_dict_and_vice_versa(self):
+        assert deep_merge({"a": {"x": 1}}, {"a": 7}) == {"a": 7}
+        assert deep_merge({"a": 7}, {"a": {"x": 1}}) == {"a": {"x": 1}}
+
+    def test_new_keys_pass_through(self):
+        assert deep_merge({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+
+    def test_lists_replaced_not_merged(self):
+        assert deep_merge({"a": [1, 2]}, {"a": [3]}) == {"a": [3]}
+
+    def test_three_level_nesting(self):
+        defaults = {"a": {"b": {"c": 1, "d": 2}}}
+        assert deep_merge(defaults, {"a": {"b": {"c": 9}}}) == \
+            {"a": {"b": {"c": 9, "d": 2}}}
+
+
+class TestLegacyInlineBoundary:
+    """An inline dict with ANY key beyond enabled/configPath is the full
+    config (older installs embedded everything inline) — the external file
+    is then never consulted or bootstrapped."""
+
+    def test_pointer_only_keys_not_legacy(self, tmp_path):
+        cfg, _ = load(tmp_path, {"enabled": True}, {"d": 1})
+        assert cfg["d"] == 1  # defaults used, external path consulted
+
+    def test_one_substantive_key_triggers_legacy(self, tmp_path):
+        external = plugins_dir(tmp_path) / "testplug" / "config.json"
+        external.parent.mkdir(parents=True)
+        external.write_text(json.dumps({"d": 99}))
+        cfg, _ = load(tmp_path, {"enabled": True, "languages": "all"}, {"d": 1})
+        assert cfg["languages"] == "all"
+        assert cfg["d"] == 1  # external 99 IGNORED in legacy mode
+
+    def test_legacy_merges_over_defaults(self, tmp_path):
+        cfg, _ = load(tmp_path, {"a": {"x": 9}}, {"a": {"x": 1, "y": 2}})
+        assert cfg["a"] == {"x": 9, "y": 2}
+
+    def test_legacy_does_not_bootstrap(self, tmp_path):
+        load(tmp_path, {"custom": 1}, {"d": 1})
+        assert not (plugins_dir(tmp_path) / "testplug" / "config.json").exists()
+
+    def test_config_path_snake_case_alias_is_pointer(self, tmp_path):
+        p = tmp_path / "elsewhere.json"
+        p.write_text(json.dumps({"d": 42}))
+        cfg, _ = load(tmp_path, {"config_path": str(p)}, {"d": 1})
+        assert cfg["d"] == 42  # treated as pointer, not legacy
+
+
+class TestEnabledPrecedence:
+    def test_inline_disabled_beats_external_enabled(self, tmp_path):
+        external = plugins_dir(tmp_path) / "testplug" / "config.json"
+        external.parent.mkdir(parents=True)
+        external.write_text(json.dumps({"enabled": True, "d": 2}))
+        cfg, _ = load(tmp_path, {"enabled": False}, {"d": 1})
+        assert cfg["enabled"] is False and cfg["d"] == 2
+
+    def test_external_disabled_beats_inline_default(self, tmp_path):
+        external = plugins_dir(tmp_path) / "testplug" / "config.json"
+        external.parent.mkdir(parents=True)
+        external.write_text(json.dumps({"enabled": False}))
+        cfg, _ = load(tmp_path, {}, {"d": 1})
+        assert cfg["enabled"] is False
+
+    def test_both_enabled_stays_enabled(self, tmp_path):
+        cfg, _ = load(tmp_path, {"enabled": True}, {})
+        assert cfg["enabled"] is True
+
+    def test_legacy_inline_enabled_false_kept(self, tmp_path):
+        cfg, _ = load(tmp_path, {"enabled": False, "custom": 1}, {})
+        assert cfg["enabled"] is False
+
+
+class TestBootstrap:
+    def test_bootstrap_writes_defaults_once(self, tmp_path):
+        _, log = load(tmp_path, {}, {"d": 1})
+        path = plugins_dir(tmp_path) / "testplug" / "config.json"
+        assert json.loads(path.read_text()) == {"d": 1}
+        assert any("bootstrapped" in m for m in log.messages("info"))
+
+    def test_bootstrap_disabled_no_write(self, tmp_path):
+        load(tmp_path, {}, {"d": 1}, bootstrap=False)
+        assert not (plugins_dir(tmp_path) / "testplug" / "config.json").exists()
+
+    def test_existing_file_never_overwritten(self, tmp_path):
+        external = plugins_dir(tmp_path) / "testplug" / "config.json"
+        external.parent.mkdir(parents=True)
+        external.write_text(json.dumps({"d": 7}))
+        load(tmp_path, {}, {"d": 1, "extra": True})
+        assert json.loads(external.read_text()) == {"d": 7}
+
+    def test_explicit_config_path_bootstrapped(self, tmp_path):
+        p = tmp_path / "custom" / "cfg.json"
+        cfg, _ = load(tmp_path, {"configPath": str(p)}, {"d": 1})
+        assert cfg["d"] == 1 and json.loads(p.read_text()) == {"d": 1}
+
+
+class TestFailOpen:
+    def test_corrupt_external_warns_uses_defaults(self, tmp_path):
+        external = plugins_dir(tmp_path) / "testplug" / "config.json"
+        external.parent.mkdir(parents=True)
+        external.write_text("{broken json")
+        cfg, log = load(tmp_path, {}, {"d": 1})
+        assert cfg["d"] == 1
+        assert any("failed to read" in m for m in log.messages("warn"))
+
+    def test_non_object_external_warns_uses_defaults(self, tmp_path):
+        external = plugins_dir(tmp_path) / "testplug" / "config.json"
+        external.parent.mkdir(parents=True)
+        external.write_text(json.dumps([1, 2, 3]))
+        cfg, log = load(tmp_path, {}, {"d": 1})
+        assert cfg["d"] == 1
+        assert any("not an object" in m for m in log.messages("warn"))
+
+
+class TestOpenclawConfig:
+    def test_reads_gateway_config(self, tmp_path):
+        (tmp_path / "openclaw.json").write_text(json.dumps({"plugins": {"g": 1}}))
+        assert read_openclaw_config(tmp_path)["plugins"] == {"g": 1}
+
+    def test_missing_file_empty_dict(self, tmp_path):
+        assert read_openclaw_config(tmp_path) == {}
+
+    def test_env_home_honored(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OPENCLAW_HOME", str(tmp_path))
+        (tmp_path / "openclaw.json").write_text(json.dumps({"x": 1}))
+        assert read_openclaw_config()["x"] == 1
+        assert plugins_dir() == tmp_path / "plugins"
