@@ -258,3 +258,35 @@ class TestGatewaySurface:
         assert isinstance(results, list)  # no exception escaped
         d = s.gw.before_tool_call("read", {"path": "ok.txt"}, ctx())
         assert d.allowed
+
+
+class TestFailureClusteringLive:
+    def test_cross_session_failures_cluster_in_report(self, suite):
+        """Round-5 clustering through the LIVE pipeline: the same root cause
+        failing in several sessions must come back as one failureClusters
+        entry spanning those chains. (Here the pinned test platform takes
+        the jax kernel path; an unpinned gateway process would take the
+        equivalent numpy formulation — test_trace_analyzer pins parity.)"""
+        s = suite
+
+        def refused(params):
+            raise RuntimeError("connect ECONNREFUSED 10.0.0.5:5432 (postgres)")
+
+        for sess in ("agent:main:sess-A", "agent:main:sess-B",
+                     "agent:main:sess-C"):
+            c = {"agent_id": AGENT, "session_key": sess}
+            s.gw.session_start(c)
+            for _ in range(2):
+                s.gw.run_tool("exec", {"command": "psql -c 'select 1'"},
+                              refused, c)
+                s.clock.advance(20)
+            s.gw.session_end(c)
+            s.clock.advance(2400)  # separate chains by lifecycle + gap
+
+        report = s.cortex.trace_analyzer.run()
+        clusters = report.get("failureClusters") or []
+        assert clusters, "recurring cross-session failure did not cluster"
+        top = clusters[0]
+        assert top["size"] >= 2 and len(top["chains"]) >= 2
+        assert "exec" in top["tools"]
+        assert report.get("failureClustersTruncated", 0) == 0
