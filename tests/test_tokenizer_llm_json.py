@@ -1,0 +1,96 @@
+"""Direct contracts for the two smallest shared utilities: the hash
+tokenizer every model input flows through (models/tokenizer.py) and the
+LLM-JSON parser every LLM seam shares (utils/llm_json.py). Both were only
+covered transitively before — their invariants (static shapes, determinism,
+PAD/CLS discipline; fence/prose tolerance) deserve direct pins.
+"""
+
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.models.tokenizer import (
+    CLS_ID,
+    PAD_ID,
+    encode_texts,
+)
+from vainplex_openclaw_tpu.utils.llm_json import parse_llm_json
+
+
+class TestHashTokenizer:
+    def test_static_shape_and_dtype(self):
+        out = encode_texts(["short", "a much longer text here"], seq_len=16)
+        assert out.shape == (2, 16) and out.dtype == np.int32
+
+    def test_cls_first_pad_tail(self):
+        out = encode_texts(["two words"], seq_len=8)
+        assert out[0, 0] == CLS_ID
+        assert out[0, 1] != PAD_ID and out[0, 2] != PAD_ID
+        assert (out[0, 3:] == PAD_ID).all()
+
+    def test_deterministic_across_calls(self):
+        a = encode_texts(["we decided to ship v2"], seq_len=32)
+        b = encode_texts(["we decided to ship v2"], seq_len=32)
+        assert np.array_equal(a, b)
+
+    def test_ids_stay_inside_vocab_and_off_reserved(self):
+        text = " ".join(f"word{i}" for i in range(50))  # 50 distinct hashes
+        out = encode_texts([text], seq_len=64, vocab_size=512)
+        body = out[0, 1:][out[0, 1:] != PAD_ID]
+        assert len(body) == 50
+        assert (body >= 2).all() and (body < 512).all()
+
+    def test_case_insensitive(self):
+        assert np.array_equal(encode_texts(["Deploy NOW"], seq_len=8),
+                              encode_texts(["deploy now"], seq_len=8))
+
+    def test_truncation_at_seq_len(self):
+        out = encode_texts(["w " * 100], seq_len=16)
+        assert out.shape == (1, 16) and (out[0] != PAD_ID).all()
+
+    def test_empty_text_is_cls_plus_pad(self):
+        out = encode_texts([""], seq_len=8)
+        assert out[0, 0] == CLS_ID and (out[0, 1:] == PAD_ID).all()
+
+    def test_unicode_and_punctuation_tokenized(self):
+        out = encode_texts(["ошибка: 部署 failed!"], seq_len=16)
+        assert (out[0, 1:] != PAD_ID).sum() >= 4
+
+    def test_distinct_words_rarely_collide(self):
+        texts = [f"word{i}" for i in range(50)]
+        out = encode_texts(texts, seq_len=4, vocab_size=8192)
+        ids = {int(out[i, 1]) for i in range(50)}
+        assert len(ids) >= 48  # FNV over 8k buckets: collisions are rare
+
+    def test_empty_batch(self):
+        out = encode_texts([], seq_len=8)
+        assert out.shape == (0, 8)
+
+
+class TestParseLlmJson:
+    def test_plain_object(self):
+        assert parse_llm_json('{"a": 1}') == {"a": 1}
+
+    @pytest.mark.parametrize("raw", [
+        '```json\n{"a": 1}\n```',
+        '```\n{"a": 1}\n```',
+        '  ```json\n{"a": 1}\n```  ',
+    ])
+    def test_markdown_fences_stripped(self, raw):
+        assert parse_llm_json(raw) == {"a": 1}
+
+    def test_surrounding_prose_tolerated(self):
+        raw = 'Sure! Here is the result: {"verdict": "pass"} Hope that helps.'
+        assert parse_llm_json(raw) == {"verdict": "pass"}
+
+    def test_nested_object_in_prose(self):
+        raw = 'answer {"a": {"b": 2}} done'
+        assert parse_llm_json(raw) == {"a": {"b": 2}}
+
+    @pytest.mark.parametrize("raw", [
+        "no json here", "{broken", "[]", '"just a string"', "42", "", None, 7])
+    def test_non_objects_and_garbage_none(self, raw):
+        assert parse_llm_json(raw) is None
+
+    def test_fenced_prose_then_object(self):
+        raw = '```json\nnote\n{"k": "v"}\n```'
+        assert parse_llm_json(raw) == {"k": "v"}
