@@ -94,3 +94,77 @@ class TestParseLlmJson:
     def test_fenced_prose_then_object(self):
         raw = '```json\nnote\n{"k": "v"}\n```'
         assert parse_llm_json(raw) == {"k": "v"}
+
+
+class TestLocalServePath:
+    """models/serve.py — the call_llm seam served by the local encoder
+    (the TPU-native stage-3 alternative llm_validator's docstring cites)."""
+
+    def make(self):
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        return make_local_call_llm()
+
+    def test_emits_the_strict_json_contract(self):
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt, parse_response)
+
+        call = self.make()
+        raw = call(build_prompt("the deploy finished fine", []))
+        parsed = parse_response(raw)
+        assert parsed is not None
+        assert parsed["verdict"] in ("pass", "flag", "block")
+        for issue in parsed["issues"]:
+            assert issue["category"] == "unverifiable_claim"
+
+    def test_deterministic_per_text(self):
+        call = self.make()
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt)
+
+        p = build_prompt("connection refused talking to 10.0.0.5", [])
+        assert call(p) == call(p)
+
+    def test_drives_llm_validator_end_to_end(self):
+        from vainplex_openclaw_tpu.core import list_logger
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            LlmValidator)
+        from helpers import FakeClock
+
+        validator = LlmValidator(self.make(), list_logger(), clock=FakeClock())
+        result = validator.validate("all systems nominal", [])
+        assert result.verdict in ("pass", "flag", "block")
+
+    def test_unpinned_process_refused_at_construction(self, monkeypatch):
+        from vainplex_openclaw_tpu.models import serve as serve_mod
+
+        monkeypatch.setattr(serve_mod, "backend_init_safe", lambda: False)
+        with pytest.raises(RuntimeError, match="not pinned"):
+            serve_mod.make_local_call_llm()
+        serve_mod.make_local_call_llm(force=True)  # explicit override allowed
+
+    def test_message_section_extracted_from_prompt(self):
+        from vainplex_openclaw_tpu.models.serve import _extract_message
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt)
+
+        prompt = build_prompt("THE BODY LINE", [])
+        assert _extract_message(prompt) == "THE BODY LINE"
+        assert _extract_message("bare text no sections") == \
+            "bare text no sections"
+
+    def test_multiparagraph_message_fully_extracted(self):
+        """A blank line inside the outbound text must not truncate what the
+        encoder sees — that would validate only the first paragraph."""
+        from vainplex_openclaw_tpu.models.serve import _extract_message
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt)
+
+        body = "para one is benign\n\npara two announces a huge outage"
+        assert _extract_message(build_prompt(body, [])) == body
+
+    def test_missing_checkpoint_refused_at_construction(self, tmp_path):
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        with pytest.raises(RuntimeError, match="no trained checkpoint"):
+            make_local_call_llm(checkpoint_dir=str(tmp_path / "nope"))

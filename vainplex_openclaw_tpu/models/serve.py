@@ -1,0 +1,100 @@
+"""Local CortexEncoder serve path: ``call_llm``-compatible callables backed
+by the on-device model instead of an HTTP LLM.
+
+Every LLM seam in the suite is a DI'd ``call_llm: str -> str`` (governance
+stage-3 validator, cortex enhancer, trace-analyzer classifier — reference:
+governance/src/llm-validator.ts posts to an Ollama/OpenAI endpoint). This
+module is the TPU-native alternative those docstrings point at: the shipped
+triage encoder (models/pretrained.py) scores the text and the result is
+rendered into the exact strict-JSON contract the seam's parser expects. No
+HTTP, no external model, fully batched on-device — continuous validation
+that cannot be taken down by an LLM outage.
+
+Honesty note: the shipped checkpoint is trained for trace-finding triage
+(keep/severity over failure text), so the stage-3 verdicts here are a
+CONSERVATIVE severity mapping, not a fact-checker — production installs
+wanting real semantic validation point ``call_llm`` at an actual LLM and
+keep this as the degraded-mode fallback.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional
+
+from ..utils.jax_safety import backend_init_safe
+
+# severity head classes (encoder.py n_severity=4): info|low|medium|high-crit
+_SEVERITY_TO_VERDICT = ("pass", "pass", "flag", "block")
+
+# Markers from llm_validator.build_prompt — the MESSAGE body is embedded
+# VERBATIM between them and may itself contain blank lines, so the section
+# must be cut on the known next header, never on the first blank line
+# (that would validate only the first paragraph: a stage-3 bypass).
+_MESSAGE_START = "MESSAGE:\n"
+_MESSAGE_END = "\n\nIdentify issues"
+
+
+def _extract_message(prompt: str) -> str:
+    if _MESSAGE_START not in prompt:
+        return prompt.strip()
+    body = prompt.split(_MESSAGE_START, 1)[1]
+    if _MESSAGE_END in body:
+        body = body.rsplit(_MESSAGE_END, 1)[0]
+    return body.strip()
+
+
+def make_local_call_llm(checkpoint_dir: Optional[str] = None,
+                        force: bool = False) -> Callable[[str], str]:
+    """Build a ``call_llm`` seam served by the local triage encoder.
+
+    Raises RuntimeError in a process that has not pinned its jax platforms
+    (utils/jax_safety) unless ``force=True`` — a serve path must fail loud
+    at CONSTRUCTION, not hang inside a wedged remote-backend init on the
+    first validation call.
+    """
+    if not force and not backend_init_safe():
+        raise RuntimeError(
+            "local serve path refused: jax platforms are not pinned to "
+            "local backends in this process (set jax_platforms='cpu'/'tpu' "
+            "or OPENCLAW_ALLOW_DEFAULT_BACKEND=1, or pass force=True)")
+    from .pretrained import available
+
+    if not available(checkpoint_dir):
+        # Fail LOUD at construction: a silent per-call "pass" would
+        # override a fail_mode='closed' validator (the parser would accept
+        # the well-formed verdict and the closed-fail branch never runs).
+        raise RuntimeError(
+            "local serve path refused: no trained checkpoint at "
+            f"{checkpoint_dir or 'the shipped default'} — point call_llm "
+            "at a real LLM or ship a checkpoint")
+
+    def call(prompt: str) -> str:
+        import numpy as np
+
+        from . import encode_texts, forward
+        from .pretrained import load_pretrained
+
+        # load_pretrained memoizes per directory — no second cache layer,
+        # so a clear_cache()/re-ship is picked up by live closures too.
+        loaded = load_pretrained(checkpoint_dir)
+        if loaded is None:  # checkpoint vanished after construction
+            raise RuntimeError("local serve: checkpoint no longer loadable")
+        cfg, params = loaded
+        text = _extract_message(prompt)
+        tokens = encode_texts([text], cfg.seq_len, cfg.vocab_size)
+        out = forward(params, tokens, cfg)
+        severity = int(np.asarray(out["severity"]).argmax(axis=-1)[0])
+        verdict = _SEVERITY_TO_VERDICT[min(severity,
+                                           len(_SEVERITY_TO_VERDICT) - 1)]
+        issues = []
+        if verdict != "pass":
+            issues.append({"category": "unverifiable_claim",
+                           "detail": f"local triage severity class {severity}"})
+        return json.dumps({
+            "verdict": verdict,
+            "reason": f"local triage encoder: severity class {severity}",
+            "issues": issues,
+        })
+
+    return call
