@@ -168,3 +168,61 @@ class TestLocalServePath:
 
         with pytest.raises(RuntimeError, match="no trained checkpoint"):
             make_local_call_llm(checkpoint_dir=str(tmp_path / "nope"))
+
+
+class TestLocalServeConfigWiring:
+    """Config-only local stage 3: {'llmValidator': {'enabled', 'local'}}
+    builds the serve path with no DI'd call_llm (governance/plugin.py)."""
+
+    def load(self, workspace, lcfg):
+        from vainplex_openclaw_tpu.core import list_logger
+        from vainplex_openclaw_tpu.governance import GovernancePlugin
+        from helpers import make_gateway
+
+        gw, _ = make_gateway()
+        plugin_logger = list_logger()
+        plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock)
+        gw.load(plugin, plugin_config={
+            "enabled": True, "builtinPolicies": {},
+            "validation": {"enabled": True, "llmValidator": lcfg}},
+            logger=plugin_logger)
+        gw.start()
+        return gw, plugin, plugin_logger
+
+    def test_local_flag_builds_validator(self, workspace, openclaw_home):
+        gw, plugin, logger = self.load(workspace,
+                                       {"enabled": True, "local": True})
+        assert plugin.engine.output_validator.llm_validator is not None
+        assert any("local encoder serve path" in m
+                   for m in logger.messages("info"))
+        # and it actually answers through the gateway's external path
+        d = gw.message_sending("status update text",
+                               {"agent_id": "main",
+                                "session_key": "agent:main",
+                                "channel_id": "twitter"})
+        assert hasattr(d, "blocked")
+
+    def test_local_failure_degrades_with_warning(self, workspace,
+                                                 openclaw_home, tmp_path):
+        gw, plugin, logger = self.load(
+            workspace, {"enabled": True, "local": True,
+                        "checkpointDir": str(tmp_path / "missing")})
+        assert plugin.engine.output_validator.llm_validator is None
+        assert any("local stage-3 unavailable" in m
+                   for m in logger.messages("warn"))
+
+    def test_di_call_llm_still_wins(self, workspace, openclaw_home):
+        from vainplex_openclaw_tpu.governance import GovernancePlugin
+        from helpers import make_gateway
+
+        gw, _ = make_gateway()
+        plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock,
+                                  call_llm=lambda p: '{"verdict": "pass"}')
+        gw.load(plugin, plugin_config={
+            "enabled": True, "builtinPolicies": {},
+            "validation": {"enabled": True,
+                           "llmValidator": {"enabled": True, "local": True}}})
+        gw.start()
+        llm = plugin.engine.output_validator.llm_validator
+        assert llm is not None
+        assert llm.call_llm("x") == '{"verdict": "pass"}'  # the DI'd seam

@@ -190,9 +190,24 @@ class GovernancePlugin:
         for path in vcfg.get("factFiles", []):
             registry.load_facts_from_file(path)
         llm = None
-        if vcfg.get("llmValidator", {}).get("enabled") and self.call_llm is not None:
-            llm = LlmValidator(self.call_llm, api.logger,
-                               fail_mode=vcfg.get("llmValidator", {}).get("failMode", "open"),
+        lcfg = vcfg.get("llmValidator", {})
+        call_llm = self.call_llm
+        if lcfg.get("enabled") and call_llm is None and lcfg.get("local"):
+            # Config-only local stage 3: the on-device triage encoder serves
+            # the verdict contract (models/serve.py). Constructor failures
+            # (unpinned jax platforms, missing checkpoint) degrade to
+            # no-stage-3 with the reason logged — matching the DI'd seam's
+            # absent behavior rather than killing plugin registration.
+            try:
+                from ..models.serve import make_local_call_llm
+
+                call_llm = make_local_call_llm(lcfg.get("checkpointDir"))
+                api.logger.info("stage-3 validator: local encoder serve path")
+            except RuntimeError as exc:
+                api.logger.warn(f"local stage-3 unavailable: {exc}")
+        if lcfg.get("enabled") and call_llm is not None:
+            llm = LlmValidator(call_llm, api.logger,
+                               fail_mode=lcfg.get("failMode", "open"),
                                clock=self.clock)
         self.fact_registry = registry
         self.engine.output_validator = OutputValidator(vcfg, registry, api.logger, llm)
