@@ -1,0 +1,239 @@
+"""IncrementalClusterer ≡ cluster_failure_signals — the equivalence contract.
+
+The incremental path (persisted representatives + union-find + rectangular
+new×all Jaccard blocks) must produce BIT-IDENTICAL report clusters to the
+stateless batch path run over the concatenation of every run's signals
+(ISSUE 1). Exactness is not statistical: {0,1} rows make the similarity
+matmul integer-exact in float32 under any accumulation order, so even
+``meanSimilarity`` must match exactly.
+
+Randomized multi-run sequences cover the branches that matter:
+- severity-upgrade replacement of a representative (ts moves → kept-set
+  reshuffles → the fallback full-rebuild branch);
+- the ``max_signals`` truncation interplay over the CUMULATIVE stream;
+- candidate counts ≥ 64 (the batched-kernel gate in ops/similarity);
+- state reload from disk between runs (fresh instance per run).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+    CLUSTER_STATE_FILE, IncrementalClusterer, cluster_failure_signals)
+from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import FailureSignal
+
+TOOLS = ["exec", "read", "write", "fetch"]
+SEVERITIES = ["info", "low", "medium", "high", "critical"]
+# Small token pools → heavy near-duplicate overlap, the clustering regime.
+ERRORS = [
+    "error deployment exceeded progress deadline",
+    "error deployment exceeded progress deadline on host 17",
+    "permission denied opening /etc/shadow",
+    "permission denied writing /var/log/app.log",
+    "connection refused by upstream gateway",
+    "disk quota exhausted on volume data",
+]
+
+
+def make_signal(rng: random.Random, chain_pool: int = 12) -> FailureSignal:
+    tool = rng.choice(TOOLS + [None])  # None → conversational, no tool_name
+    extra = {"tool_name": tool} if tool else {}
+    evidence = rng.sample(ERRORS, k=rng.randint(1, 2))
+    return FailureSignal(
+        signal=rng.choice(["SIG-TOOL-FAIL", "SIG-DOOM-LOOP", "SIG-REPEAT-FAIL"]),
+        severity=rng.choice(SEVERITIES),
+        chain_id=f"chain{rng.randrange(chain_pool)}",
+        agent="main",
+        session=f"s{rng.randrange(4)}",
+        # small int range on purpose: ts ties stress the stable-sort
+        # equivalence between the two paths
+        ts=float(rng.randrange(50)),
+        summary=f"failure {rng.randrange(1000)}",
+        evidence=evidence,
+        extra=extra,
+    )
+
+
+def assert_equivalent(state_dir, runs: list[list[FailureSignal]],
+                      max_signals: int) -> list[dict]:
+    """Replay ``runs`` through a fresh-from-disk IncrementalClusterer per
+    run; after each run the clusters must equal the batch oracle over the
+    concatenated stream, bit for bit."""
+    seen: list[FailureSignal] = []
+    clusters = []
+    for run_signals in runs:
+        seen = seen + run_signals
+        inc_stats: dict = {}
+        bat_stats: dict = {}
+        clusters = IncrementalClusterer(
+            state_dir, max_signals=max_signals).update(run_signals,
+                                                       stats=inc_stats)
+        oracle = cluster_failure_signals(seen, max_signals=max_signals,
+                                         stats=bat_stats)
+        assert clusters == oracle
+        assert inc_stats == bat_stats
+    return clusters
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_multi_run_sequences(self, tmp_path, seed):
+        rng = random.Random(seed)
+        runs = [[make_signal(rng) for _ in range(rng.randint(0, 25))]
+                for _ in range(rng.randint(2, 6))]
+        assert_equivalent(tmp_path, runs, max_signals=512)
+
+    @pytest.mark.parametrize("seed", range(8, 14))
+    def test_truncation_interplay(self, tmp_path, seed):
+        """max_signals smaller than the cumulative stream: the kept window
+        must truncate over the CONCATENATED stream exactly like batch —
+        including runs where a severity upgrade moves a representative's
+        ts and forces the fallback rebuild."""
+        rng = random.Random(seed)
+        runs = [[make_signal(rng, chain_pool=30) for _ in range(20)]
+                for _ in range(4)]
+        assert_equivalent(tmp_path, runs, max_signals=8)
+
+    def test_large_run_crosses_batch_kernel_gate(self, tmp_path):
+        """≥64 deduped candidates — the size where ops/similarity auto-
+        routing can change kernels; results must not."""
+        rng = random.Random(99)
+        run = [make_signal(rng, chain_pool=200) for _ in range(400)]
+        clusters = assert_equivalent(tmp_path, [run, run[:50]],
+                                     max_signals=512)
+        assert clusters, "corpus is near-duplicate-heavy; clusters expected"
+
+    def test_empty_and_toolless_runs(self, tmp_path):
+        rng = random.Random(5)
+        toolless = [s for s in (make_signal(rng) for _ in range(40))
+                    if not (s.extra or {}).get("tool_name")]
+        assert_equivalent(tmp_path, [[], toolless, []], max_signals=512)
+
+    def test_severity_upgrade_replaces_representative(self, tmp_path):
+        def sig(severity, ts, summary):
+            return FailureSignal(
+                signal="SIG-TOOL-FAIL", severity=severity, chain_id="c1",
+                agent="main", session="s", ts=ts, summary=summary,
+                evidence=[ERRORS[0]], extra={"tool_name": "exec"})
+
+        low = sig("low", 1.0, "first sighting")
+        high = sig("critical", 2.0, "escalated")
+        other = FailureSignal(
+            signal="SIG-TOOL-FAIL", severity="medium", chain_id="c2",
+            agent="main", session="s", ts=3.0, summary="other chain",
+            evidence=[ERRORS[0]], extra={"tool_name": "exec"})
+        clusters = assert_equivalent(tmp_path, [[low, other], [high]],
+                                     max_signals=512)
+        assert clusters and clusters[0]["severities"] == ["critical", "medium"]
+        assert clusters[0]["sample"] == "escalated"
+
+
+class TestFallbackRebuild:
+    def test_out_of_order_arrival_near_cap_falls_back(self, tmp_path):
+        """An out-of-order (older-ts) arrival evicts a previously-kept row
+        from the cap window: prev_kept ⊄ kept, incremental edges can't be
+        trusted, and the one-shot batch-style rebuild must restore exact
+        batch equivalence."""
+        def sig(chain, ts, err):
+            return FailureSignal(
+                signal="SIG-TOOL-FAIL", severity="medium", chain_id=chain,
+                agent="main", session="s", ts=ts, summary=f"{chain}@{ts}",
+                evidence=[err], extra={"tool_name": "exec"})
+
+        run1 = [sig("c1", 10.0, ERRORS[0]), sig("c2", 20.0, ERRORS[0])]
+        run2 = [sig("c3", 5.0, ERRORS[0])]  # older ts → evicts c2's row
+        ic = IncrementalClusterer(tmp_path, max_signals=2)
+        ic.update(run1)
+        assert ic.prev_kept == {0, 1}
+        clusters = IncrementalClusterer(tmp_path, max_signals=2).update(run2)
+        oracle = cluster_failure_signals(run1 + run2, max_signals=2)
+        assert clusters == oracle
+        reloaded = IncrementalClusterer(tmp_path, max_signals=2)
+        assert reloaded.prev_kept == {0, 2}  # c2 (index 1) fell out
+
+
+class TestStateHandling:
+    def test_state_file_round_trips(self, tmp_path):
+        rng = random.Random(3)
+        IncrementalClusterer(tmp_path).update(
+            [make_signal(rng) for _ in range(30)])
+        assert (tmp_path / CLUSTER_STATE_FILE).exists()
+        reloaded = IncrementalClusterer(tmp_path)
+        assert reloaded.entries and reloaded.parents
+        assert reloaded.clusters() == reloaded.clusters()  # pure read
+
+    def test_parameter_change_resets_state(self, tmp_path):
+        rng = random.Random(4)
+        IncrementalClusterer(tmp_path).update(
+            [make_signal(rng) for _ in range(10)])
+        fresh = IncrementalClusterer(tmp_path, max_signals=7)
+        assert fresh.entries == [] and fresh.prev_kept == set()
+
+    def test_max_state_valve_resets_window(self, tmp_path):
+        """Past max_state entries the state resets and clustering restarts
+        from current traffic — the growth/freeze valve. Post-reset output
+        must equal the batch oracle over just the post-reset stream."""
+        rng = random.Random(7)
+        run1 = [make_signal(rng, chain_pool=40) for _ in range(40)]
+        run2 = [make_signal(rng, chain_pool=40) for _ in range(30)]
+        IncrementalClusterer(tmp_path, max_state=10).update(run1)
+        ic = IncrementalClusterer(tmp_path, max_state=10)
+        assert len(ic.entries) > 10  # state grew past the valve on disk
+        stats: dict = {}
+        clusters = ic.update(run2, stats=stats)
+        oracle_stats: dict = {}
+        oracle = cluster_failure_signals(run2, stats=oracle_stats)
+        assert clusters == oracle
+        assert stats == oracle_stats  # candidates count restarted too
+
+    def test_corrupt_state_resets_cleanly(self, tmp_path):
+        (tmp_path / CLUSTER_STATE_FILE).write_text("{not json", "utf-8")
+        ic = IncrementalClusterer(tmp_path)
+        assert ic.entries == []
+        rng = random.Random(6)
+        run = [make_signal(rng) for _ in range(15)]
+        assert ic.update(run) == cluster_failure_signals(run)
+
+
+class TestGroupIndicesFallback:
+    def test_no_scipy_fallback_handles_asymmetric_adjacency(self, monkeypatch):
+        """The incremental path emits DIRECTED edges (member→root, new-row
+        blocks); scipy's connected_components treats them as undirected, so
+        the no-scipy union-find fallback must merge lower-triangle edges
+        too."""
+        import sys
+
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.clusters import (
+            _group_indices)
+
+        adjacency = np.eye(3, dtype=bool)
+        adjacency[2, 0] = True  # lower-triangle-only edge
+        with_scipy = _group_indices(adjacency)
+        for mod in ("scipy", "scipy.sparse", "scipy.sparse.csgraph"):
+            monkeypatch.setitem(sys.modules, mod, None)  # import → ImportError
+        without_scipy = _group_indices(adjacency)
+        expect = [[0, 2], [1]]
+        assert sorted(with_scipy.values()) == expect
+        assert sorted(without_scipy.values()) == expect
+
+
+class TestKernelExactness:
+    def test_numpy_and_jax_blocks_bit_identical(self):
+        """The exactness claim the whole equivalence design leans on: {0,1}
+        rows → integer-exact float32 matmul → numpy, jax, square, and
+        rectangular formulations all agree bit for bit."""
+        from vainplex_openclaw_tpu.ops.similarity import jaccard_from_rows
+
+        rng = np.random.default_rng(0)
+        X = (rng.random((130, 1024)) < 0.04).astype(np.float32)
+        full_np = np.asarray(jaccard_from_rows(X, use_jax=False))
+        full_jax = np.asarray(jaccard_from_rows(X, use_jax=True))
+        assert np.array_equal(full_np, full_jax)
+        block_np = np.asarray(jaccard_from_rows(X[:7], X, use_jax=False))
+        block_jax = np.asarray(jaccard_from_rows(X[:7], X, use_jax=True))
+        assert np.array_equal(block_np, block_jax)
+        assert np.array_equal(block_np, full_np[:7])

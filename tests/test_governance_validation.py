@@ -330,7 +330,8 @@ class TestMatrixPoller:
             {"chunk": [], "end": "tok1"},  # init-sync: newest token only
             {"chunk": [
                 {"type": "m.room.message", "sender": "@boss:m.org",
-                 "content": {"body": "approval 123456 please"},
+                 "content": {"msgtype": "m.text",
+                             "body": "approval 123456 please"},
                  "event_id": "$c1"},
                 {"type": "m.room.member", "content": {"body": "999999"},
                  "event_id": "$c2"},

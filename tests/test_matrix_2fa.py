@@ -173,7 +173,8 @@ class TestMatrix2FAEndToEnd:
 
             homeserver.room_messages.append({
                 "type": "m.room.message", "sender": "@boss:m.org",
-                "content": {"body": plugin.approval_2fa.totp.generate()}})
+                "content": {"msgtype": "m.text",
+                            "body": plugin.approval_2fa.totp.generate()}})
             worker.join(timeout=10)
             assert not worker.is_alive(), "tool call never unblocked"
             assert decisions and decisions[0].allowed
@@ -201,7 +202,8 @@ class TestMatrix2FAEndToEnd:
         try:
             homeserver.room_messages.append({
                 "type": "m.room.message", "sender": "@rando:m.org",
-                "content": {"body": plugin.approval_2fa.totp.generate()}})
+                "content": {"msgtype": "m.text",
+                            "body": plugin.approval_2fa.totp.generate()}})
             d = gw.before_tool_call("exec", {"command": "rm -rf /"},
                                     {"agent_id": "main", "session_key": "agent:main"})
             assert d.blocked  # times out → deny; rando's code never approves
@@ -238,9 +240,10 @@ class TestPollerUnit:
     _seq = 0
 
     def msg(self, body, sender="@boss:m.org", type_="m.room.message",
-            event_id=None):
+            event_id=None, msgtype="m.text"):
         TestPollerUnit._seq += 1
-        return {"type": type_, "sender": sender, "content": {"body": body},
+        return {"type": type_, "sender": sender,
+                "content": {"msgtype": msgtype, "body": body},
                 "event_id": event_id or f"$auto{TestPollerUnit._seq}"}
 
     def test_init_sync_then_forward_polling(self):
@@ -300,6 +303,28 @@ class TestPollerUnit:
         poller.poll_once()  # init
         assert poller.poll_once() == 1
         assert self.codes[0][0] == "111222"
+
+    def test_non_text_msgtypes_ignored(self):
+        """Incidental 6-digit chatter in notices/emotes/captions (bots,
+        bridges, image filenames) must not burn attemptsLeft: only m.text
+        is scanned for codes (ADVICE r5)."""
+        poller = self.make([{"chunk": [], "end": "t1"}, {"chunk": [
+            self.msg("build 123456 failed", msgtype="m.notice"),
+            self.msg("999888", msgtype="m.image"),
+            self.msg("777666", msgtype=None),  # msgtype absent — not text
+            self.msg("444555")]}])
+        poller.poll_once()  # init
+        assert poller.poll_once() == 1
+        assert self.codes == [("444555", "@boss:m.org")]
+
+    def test_bare_code_body_dispatches(self):
+        """A body that is exactly the code (modulo whitespace) dispatches —
+        the common approver reply shape, covered by the word-boundary scan."""
+        poller = self.make([{"chunk": [], "end": "t1"}, {"chunk": [
+            self.msg("  135790  ")]}])
+        poller.poll_once()  # init
+        assert poller.poll_once() == 1
+        assert self.codes == [("135790", "@boss:m.org")]
 
     def test_loop_survives_http_failures(self):
         poller = self.make([{"chunk": [], "end": "t1"},

@@ -618,7 +618,10 @@ class TestFailureClustering:
         def boom(*a, **k):
             raise RuntimeError("cluster bug")
 
+        # Break BOTH clustering paths: the analyzer defaults to the
+        # incremental clusterer and falls back to nothing, not to batch.
         monkeypatch.setattr(an_mod, "cluster_failure_signals", boom)
+        monkeypatch.setattr(an_mod.IncrementalClusterer, "update", boom)
         f = EventFactory()
         raws = []
         for _ in range(3):

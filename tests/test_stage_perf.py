@@ -1,0 +1,171 @@
+"""The trace-analyzer performance layer (ISSUE 1).
+
+Three surfaces, each pinned: the StageTimer itself, the per-stage breakdown
+in analyzer run stats / summary / bench records, and the jit-cache shape
+bucketing in ops/similarity (repeated same-bucket calls must NOT retrace).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo root: bench.py lives next to the package
+
+from vainplex_openclaw_tpu.utils.stage_timer import StageTimer  # noqa: E402
+
+ANALYZER_STAGES = ("normalize", "chains", "signals", "classify", "outputs",
+                   "cluster", "report")
+
+
+class TestStageTimer:
+    def test_accumulates_in_entry_order(self):
+        ticks = iter(range(100))
+        timer = StageTimer(clock=lambda: next(ticks))
+        with timer.stage("a"):
+            pass
+        with timer.stage("b"):
+            pass
+        with timer.stage("a"):  # re-entry accumulates under one name
+            pass
+        out = timer.stages_ms()
+        assert list(out) == ["a", "b"]
+        assert out["a"] == 2000.0 and out["b"] == 1000.0
+        assert timer.total_ms() == 3000.0
+
+    def test_records_time_when_stage_raises(self):
+        ticks = iter(range(100))
+        timer = StageTimer(clock=lambda: next(ticks))
+        try:
+            with timer.stage("boom"):
+                raise ValueError("stage failed")
+        except ValueError:
+            pass
+        assert timer.stages_ms()["boom"] == 1000.0
+
+    def test_stages_ms_returns_fresh_dict(self):
+        timer = StageTimer()
+        with timer.stage("x"):
+            pass
+        first = timer.stages_ms()
+        first["x"] = -1
+        assert timer.stages_ms()["x"] >= 0
+
+
+class TestAnalyzerStageStats:
+    def _run(self, tmp_path):
+        sys.path.insert(0, "tests")
+        from trace_helpers import EventFactory
+
+        from vainplex_openclaw_tpu.core.api import list_logger
+        from vainplex_openclaw_tpu.cortex.trace_analyzer import (
+            MemoryTraceSource, TraceAnalyzer)
+
+        f = EventFactory(agent="main", session="s1")
+        raws = [f.msg_in("fix the deploy")]
+        for _ in range(3):
+            raws += f.failing_call("exec", {"command": "kubectl apply"},
+                                   "error: progress deadline exceeded")
+        raws.append(f.msg_out("I've successfully fixed it."))
+        analyzer = TraceAnalyzer({}, tmp_path, list_logger(),
+                                 source=MemoryTraceSource(raws))
+        return analyzer.run()
+
+    def test_run_stats_carry_stage_breakdown(self, tmp_path):
+        report = self._run(tmp_path)
+        stage_ms = report["runStats"]["stageMs"]
+        assert tuple(stage_ms) == ANALYZER_STAGES
+        assert all(isinstance(v, float) and v >= 0 for v in stage_ms.values())
+        # persistence is folded into the returned report stage — the sum
+        # must roughly cover the run, not leave a large untimed tail
+        assert sum(stage_ms.values()) > 0
+
+    def test_summary_text_includes_stage_line(self, tmp_path):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.analyzer import (
+            _summary_text)
+
+        text = _summary_text(self._run(tmp_path))
+        assert "stages:" in text and "cluster=" in text
+
+    def test_saved_report_parses_with_stages(self, tmp_path):
+        self._run(tmp_path)
+        saved = json.loads(
+            (tmp_path / "trace-analysis-report.json").read_text("utf-8"))
+        assert set(ANALYZER_STAGES) <= set(saved["runStats"]["stageMs"])
+
+
+class TestBenchStageRecords:
+    def test_stage_records_shape(self):
+        import bench
+
+        recs = bench.trace_analyzer_stage_records({"normalize": 1.5,
+                                                   "cluster": 2.0})
+        assert [json.loads(json.dumps(r)) for r in recs] == recs
+        assert all(r["metric"] == "trace_analyzer_stage_ms" for r in recs)
+        assert [r["stage"] for r in recs] == ["normalize", "cluster"]
+        assert bench.trace_analyzer_stage_records({}) == []
+
+    def test_bench_smoke_emits_headline_and_stages(self, capsys):
+        """CI's parse guard: the trace-analyzer section must keep producing
+        a JSON headline plus machine-readable per-stage lines."""
+        import bench
+
+        rec = bench.bench_trace_analyzer(n_chains=6)
+        assert rec["metric"] == "trace_analyzer_throughput"
+        assert rec["value"] > 0
+        assert set(ANALYZER_STAGES) <= set(rec["stage_ms"])
+        json.dumps(rec)  # the stdout line must stay serializable
+        err = capsys.readouterr().err
+        stage_lines = [json.loads(line.split("secondary: ", 1)[1])
+                       for line in err.splitlines()
+                       if "trace_analyzer_stage_ms" in line]
+        assert {r["stage"] for r in stage_lines} >= set(ANALYZER_STAGES)
+
+
+class TestJitCacheBucketing:
+    def test_jaccard_same_bucket_no_retrace(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        rng = np.random.default_rng(1)
+        sets = [{"k": int(v)} for v in rng.integers(0, 50, size=128)]
+        sim.jaccard_matrix(sets[:65], use_jax=True)  # prime bucket 128
+        before = sim.TRACE_COUNTS["jaccard"]
+        for n in (65, 70, 97, 128):  # all land in the 128 bucket
+            out = sim.jaccard_matrix(sets[:n], use_jax=True)
+            assert out.shape == (n, n)
+        assert sim.TRACE_COUNTS["jaccard"] == before, \
+            "same-bucket jaccard calls must hit the jit cache"
+
+    def test_levenshtein_same_bucket_no_retrace(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        pairs = [(f"kubectl rollout status app{i}",
+                  f"kubectl rollout status app{i + 1}") for i in range(64)]
+        sim.batch_levenshtein_ratio(pairs[:33], use_jax=True)  # prime 64
+        before = sim.TRACE_COUNTS["levenshtein"]
+        for n in (33, 40, 64):
+            out = sim.batch_levenshtein_ratio(pairs[:n], use_jax=True)
+            assert out.shape == (n,)
+        assert sim.TRACE_COUNTS["levenshtein"] == before, \
+            "same-bucket levenshtein calls must hit the jit cache"
+
+    def test_bucketed_result_matches_unbucketed_math(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        sets = [{"k": i % 5} for i in range(70)]
+        assert np.array_equal(sim.jaccard_matrix(sets, use_jax=True),
+                              sim.jaccard_matrix(sets, use_jax=False))
+
+    def test_cpu_auto_route_prefers_numpy(self):
+        """In this cpu-pinned process the auto gate must take the numpy
+        path (no dispatch overhead) — pinned so a future edit can't
+        silently put jax-on-cpu back on the analyzer hot path."""
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        assert sim._jax_enabled()
+        assert not sim._backend_is_accelerator()
+        before = sim.TRACE_COUNTS["jaccard"]
+        sim.jaccard_matrix([{"k": i} for i in range(200)])  # auto path
+        assert sim.TRACE_COUNTS["jaccard"] == before  # numpy, no trace

@@ -15,7 +15,7 @@ ops/similarity.py for large windows.
 
 from .analyzer import TraceAnalyzer
 from .chains import ConversationChain, reconstruct_chains
-from .clusters import cluster_failure_signals
+from .clusters import IncrementalClusterer, cluster_failure_signals
 from .events import NormalizedEvent, detect_schema, map_event_type, normalize_event
 from .signals import FailureSignal, detect_all_signals
 from .source import MemoryTraceSource, TransportTraceSource, create_nats_trace_source
@@ -23,6 +23,7 @@ from .source import MemoryTraceSource, TransportTraceSource, create_nats_trace_s
 __all__ = [
     "ConversationChain",
     "FailureSignal",
+    "IncrementalClusterer",
     "MemoryTraceSource",
     "NormalizedEvent",
     "TraceAnalyzer",

@@ -14,9 +14,10 @@ from pathlib import Path
 from typing import Callable, Optional
 
 from ...core.api import PluginCommand, PluginService
+from ...utils.stage_timer import StageTimer
 from .chains import reconstruct_chains
 from .classifier import classify_findings
-from .clusters import cluster_failure_signals
+from .clusters import IncrementalClusterer, cluster_failure_signals
 from .outputs import generate_outputs
 from .report import ProcessingState, assemble_report, rule_effectiveness, save_report
 from .signal_patterns import compile_signal_patterns
@@ -35,6 +36,11 @@ DEFAULT_ANALYZER_CONFIG = {
     # checkpoint is present (models/pretrained.py, VERDICT r3 #2); operators
     # can still pin True/False explicitly.
     "classify": {"enabled": False, "useLocalTriage": None},
+    # incremental: persist cluster features/assignments in the state dir and
+    # compute only the new-rows × all-rows block per run (clusters.py) —
+    # clusters then cover every run since state creation, not just this
+    # one's batch. False restores the stateless per-run batch path.
+    "cluster": {"incremental": True},
     "scheduleMinutes": 0,     # 0 = manual only
     "natsUrl": None,
     "stream": "CLAW_EVENTS",
@@ -78,70 +84,86 @@ class TraceAnalyzer:
             save_report(report, self.state_dir)
             return report
 
+        timer = StageTimer()
         try:
-            events = list(source.fetch(
-                start_seq=state.last_processed_seq,
-                batch_size=self.config["fetchBatchSize"],
-                max_events=self.config["maxEventsPerRun"]))
-            chains = reconstruct_chains(events,
-                                        gap_minutes=self.config["gapMinutes"],
-                                        max_events_per_chain=self.config["maxEventsPerChain"])
-            signals = detect_all_signals(chains, self.patterns,
-                                         self.config.get("signals"), self.logger)
+            with timer.stage("normalize"):
+                events = list(source.fetch(
+                    start_seq=state.last_processed_seq,
+                    batch_size=self.config["fetchBatchSize"],
+                    max_events=self.config["maxEventsPerRun"]))
+            with timer.stage("chains"):
+                chains = reconstruct_chains(events,
+                                            gap_minutes=self.config["gapMinutes"],
+                                            max_events_per_chain=self.config["maxEventsPerChain"])
+            with timer.stage("signals"):
+                signals = detect_all_signals(chains, self.patterns,
+                                             self.config.get("signals"), self.logger)
 
             classified = []
             ccfg = self.config.get("classify", {})
-            if signals and (ccfg.get("enabled") or self.triage_llm or self.deep_llm):
-                chains_by_id = {c.id: c for c in chains}
-                use_local = ccfg.get("useLocalTriage")
-                if use_local is None:
-                    # auto: on iff trained weights shipped AND this process
-                    # can initialize a jax backend without gambling on a
-                    # wedged remote-accelerator plugin (utils/jax_safety).
-                    # An explicit useLocalTriage: true is the operator's
-                    # deliberate choice and is not gated.
-                    from ...models.pretrained import available
-                    from ...utils.jax_safety import backend_init_safe
+            with timer.stage("classify"):
+                if signals and (ccfg.get("enabled") or self.triage_llm or self.deep_llm):
+                    chains_by_id = {c.id: c for c in chains}
+                    use_local = ccfg.get("useLocalTriage")
+                    if use_local is None:
+                        # auto: on iff trained weights shipped AND this process
+                        # can initialize a jax backend without gambling on a
+                        # wedged remote-accelerator plugin (utils/jax_safety).
+                        # An explicit useLocalTriage: true is the operator's
+                        # deliberate choice and is not gated.
+                        from ...models.pretrained import available
+                        from ...utils.jax_safety import backend_init_safe
 
-                    shipped = available()
-                    use_local = shipped and backend_init_safe()
-                    if shipped and not use_local:
-                        self.logger.info(
-                            "local triage skipped: jax not pinned to local "
-                            "platforms in this process (set jax_platforms="
-                            "'cpu' or OPENCLAW_ALLOW_DEFAULT_BACKEND=1)")
-                classified = classify_findings(
-                    signals, chains_by_id, self.triage_llm, self.deep_llm,
-                    self.logger, use_local_triage=bool(use_local))
-            else:
-                from .classifier import ClassifiedFinding
+                        shipped = available()
+                        use_local = shipped and backend_init_safe()
+                        if shipped and not use_local:
+                            self.logger.info(
+                                "local triage skipped: jax not pinned to local "
+                                "platforms in this process (set jax_platforms="
+                                "'cpu' or OPENCLAW_ALLOW_DEFAULT_BACKEND=1)")
+                    classified = classify_findings(
+                        signals, chains_by_id, self.triage_llm, self.deep_llm,
+                        self.logger, use_local_triage=bool(use_local))
+                else:
+                    from .classifier import ClassifiedFinding
 
-                classified = [ClassifiedFinding(s, True, s.severity) for s in signals]
+                    classified = [ClassifiedFinding(s, True, s.severity) for s in signals]
 
-            outputs = generate_outputs(classified)
+            with timer.stage("outputs"):
+                outputs = generate_outputs(classified)
             # Clustering is an optional enrichment stage: like the per-
             # detector try/catch, it must never cost the run its report.
             cluster_stats: dict = {}
-            try:
-                clusters = cluster_failure_signals(signals, logger=self.logger,
-                                                   stats=cluster_stats)
-            except Exception as exc:  # noqa: BLE001
-                self.logger.error(f"failure clustering failed: {exc}")
-                clusters, cluster_stats = [], {}
+            with timer.stage("cluster"):
+                try:
+                    if (self.config.get("cluster") or {}).get("incremental", True):
+                        clusters = IncrementalClusterer(
+                            self.state_dir, logger=self.logger).update(
+                                signals, stats=cluster_stats)
+                    else:
+                        clusters = cluster_failure_signals(
+                            signals, logger=self.logger, stats=cluster_stats)
+                except Exception as exc:  # noqa: BLE001
+                    self.logger.error(f"failure clustering failed: {exc}")
+                    clusters, cluster_stats = [], {}
 
-            signal_counts: dict = {}
-            for s in signals:
-                signal_counts[s.signal] = signal_counts.get(s.signal, 0) + 1
-            effectiveness = rule_effectiveness(state, signal_counts)
+            with timer.stage("report"):
+                signal_counts: dict = {}
+                for s in signals:
+                    signal_counts[s.signal] = signal_counts.get(s.signal, 0) + 1
+                effectiveness = rule_effectiveness(state, signal_counts)
 
             duration_ms = (time.perf_counter() - start) * 1000
             events_per_minute = (len(events) / (duration_ms / 60_000)) if duration_ms > 0 else 0.0
+            stage_ms = timer.stages_ms()
             run_stats = {
                 "events": len(events), "chains": len(chains), "signals": len(signals),
                 "durationMs": round(duration_ms, 2),
                 "eventsPerMinute": round(events_per_minute, 1),
                 "incrementalFromSeq": state.last_processed_seq,
+                "stageMs": stage_ms,
             }
+            t_persist = time.perf_counter()
             report = assemble_report(run_stats, signals, classified, outputs,
                                      effectiveness, self.clock, clusters=clusters,
                                      clusters_truncated=cluster_stats.get("truncated", 0))
@@ -153,6 +175,14 @@ class TraceAnalyzer:
             state.total_events_processed += len(events)
             state.total_runs += 1
             state.save(self.state_dir)
+            # Fold report assembly + persistence into the report stage of the
+            # RETURNED stats (stage_ms is the dict inside the report): the
+            # saved file can't time its own write, so on disk "report" covers
+            # effectiveness only — callers on the return path (bench, the
+            # /trace-analyze summary) see the full cost.
+            stage_ms["report"] = round(
+                stage_ms.get("report", 0.0)
+                + (time.perf_counter() - t_persist) * 1000.0, 2)
             self.logger.info(
                 f"trace analysis: {len(events)} events → {len(chains)} chains → "
                 f"{len(signals)} signals ({run_stats['eventsPerMinute']:.0f} ev/min)")
@@ -193,6 +223,10 @@ def _summary_text(report: dict) -> str:
     lines = [f"🔍 trace analysis: {rs['events']} events → {rs['chains']} chains → "
              f"{rs['signals']} signals in {rs['durationMs']}ms "
              f"({rs['eventsPerMinute']:.0f} ev/min)"]
+    stage_ms = rs.get("stageMs") or {}
+    if stage_ms:
+        lines.append("  stages: " + " ".join(
+            f"{name}={ms:.0f}ms" for name, ms in stage_ms.items()))
     for signal, stats in report["signalStats"].items():
         lines.append(f"  {signal}: {stats['count']}")
     for cluster in report.get("failureClusters", [])[:3]:

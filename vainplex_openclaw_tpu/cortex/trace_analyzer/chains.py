@@ -32,6 +32,13 @@ class ConversationChain:
     # per-run cache of tool.call→tool.result pairing, shared by the three
     # tool-failure detectors (signals._tool_attempts)
     _tool_attempts: Optional[list] = field(default=None, repr=False, compare=False)
+    # per-run cache of completion-claim msg.out indices, shared by the
+    # hallucination and unverified-claim detectors
+    # (signals._completion_claim_indices)
+    _completion_claims: Optional[list] = field(default=None, repr=False, compare=False)
+    # per-run cache of consecutive-attempt similarities
+    # (signals._consecutive_similarities)
+    _pair_sims: Optional[list] = field(default=None, repr=False, compare=False)
 
 
 def compute_chain_id(session: str, agent: str, first_ts: float) -> str:

@@ -30,7 +30,7 @@ _EVENT_TYPE_MAP = {
 }
 
 
-@dataclass
+@dataclass(slots=True)
 class NormalizedEvent:
     id: str
     ts: float  # ms epoch

@@ -38,7 +38,7 @@ def is_question(text: str) -> bool:
     return bool(_QUESTION_RE.search((text or "").strip()))
 
 
-@dataclass
+@dataclass(slots=True)
 class FailureSignal:
     signal: str
     severity: str  # info | low | medium | high | critical
@@ -131,18 +131,34 @@ def _last_tool_result_in_turn(events, msg_out_idx: int) -> int:
     return -1
 
 
+def _completion_claim_indices(chain: ConversationChain,
+                              patterns: CompiledSignalPatterns) -> list[int]:
+    """Indices of msg.out events matching a completion-claim pattern.
+    Cached on the chain like ``_tool_attempts``: two detectors
+    (hallucination, unverified-claim) sweep the same events with the same
+    pattern set, and the duplicated regex pass was a measurable slice of
+    the signals stage. Assumes one pattern set per run (the analyzer's
+    chains are rebuilt every run)."""
+    cached = getattr(chain, "_completion_claims", None)
+    if cached is not None:
+        return cached
+    hits = [i for i, event in enumerate(chain.events)
+            if event.type == "msg.out"
+            and any(rx.search(event.payload.get("content") or "")
+                    for rx in patterns.completion_claims)]
+    chain._completion_claims = hits
+    return hits
+
+
 def detect_hallucinations(chain: ConversationChain,
                           patterns: CompiledSignalPatterns, state=None) -> list[FailureSignal]:
     """Agent claims completion while the last tool result in the same turn
     errored — the claim contradicts its own evidence. Critical."""
     out = []
     events = chain.events
-    for i, event in enumerate(events):
-        if event.type != "msg.out":
-            continue
+    for i in _completion_claim_indices(chain, patterns):
+        event = events[i]
         content = event.payload.get("content") or ""
-        if not any(rx.search(content) for rx in patterns.completion_claims):
-            continue
         tr = _last_tool_result_in_turn(events, i)
         if tr < 0 or not events[tr].payload.get("tool_is_error"):
             continue
@@ -164,12 +180,9 @@ def detect_unverified_claims(chain: ConversationChain,
     work without any evidence trail."""
     out = []
     events = chain.events
-    for i, event in enumerate(events):
-        if event.type != "msg.out":
-            continue
+    for i in _completion_claim_indices(chain, patterns):
+        event = events[i]
         content = event.payload.get("content") or ""
-        if not any(rx.search(content) for rx in patterns.completion_claims):
-            continue
         saw_tool = False
         for j in range(i - 1, -1, -1):
             if events[j].type in ("tool.call", "tool.result"):
@@ -196,12 +209,17 @@ def _tool_attempts(chain: ConversationChain) -> list[dict]:
         return cached
     attempts = []
     events = chain.events
+    n = len(events)
     for i, event in enumerate(events):
         if event.type != "tool.call":
             continue
-        result = next((e for e in events[i + 1:i + 4] if e.type == "tool.result"
-                       and e.payload.get("tool_name") == event.payload.get("tool_name")),
-                      None)
+        result = None
+        for j in range(i + 1, min(i + 4, n)):
+            e = events[j]
+            if (e.type == "tool.result"
+                    and e.payload.get("tool_name") == event.payload.get("tool_name")):
+                result = e
+                break
         attempts.append({
             "ts": event.ts,
             "tool": event.payload.get("tool_name") or "?",
@@ -350,9 +368,22 @@ def detect_doom_loops(chain: ConversationChain,
 # ── SIG-REPEAT-FAIL (cross-chain) ────────────────────────────────────
 
 
+_SIGNATURE_CACHE: dict = {}
+_SIGNATURE_CACHE_CAP = 8192
+
+
 def failure_signature(tool: str, error: str) -> str:
-    normalized = re.sub(r"\d+", "N", (error or "")[:200].lower())
-    return hashlib.sha256(f"{tool}:{normalized}".encode()).hexdigest()[:16]
+    # Memoized: persistent failures repeat the same (tool, error) text by
+    # definition, so the regex + sha256 amortize to one dict hit.
+    key = (tool, (error or "")[:200])
+    hit = _SIGNATURE_CACHE.get(key)
+    if hit is None:
+        normalized = re.sub(r"\d+", "N", key[1].lower())
+        hit = hashlib.sha256(f"{tool}:{normalized}".encode()).hexdigest()[:16]
+        if len(_SIGNATURE_CACHE) >= _SIGNATURE_CACHE_CAP:
+            _SIGNATURE_CACHE.clear()
+        _SIGNATURE_CACHE[key] = hit
+    return hit
 
 
 def detect_repeat_failures(chain: ConversationChain,
@@ -404,18 +435,23 @@ def detect_all_signals(chains: list[ConversationChain],
     config = config or {}
     state: dict = {}
     signals: list[FailureSignal] = []
+    # Resolve enable/override config ONCE, not per (chain, detector): the
+    # registry loop runs chains × detectors times and the dict lookups were
+    # a measurable slice of the signals stage on the bench corpus.
+    active = []
+    for name, detector in DETECTOR_REGISTRY.items():
+        sig_cfg = config.get(name, {})
+        if sig_cfg.get("enabled", True) is False:
+            continue
+        active.append((name, detector, sig_cfg.get("severity")))
     for chain in chains:
-        for name, detector in DETECTOR_REGISTRY.items():
-            sig_cfg = config.get(name, {})
-            if sig_cfg.get("enabled", True) is False:
-                continue
+        for name, detector, override in active:
             try:
                 found = detector(chain, patterns, state)
             except Exception as exc:  # noqa: BLE001 — one bad detector must not kill the run
                 if logger is not None:
                     logger.error(f"detector {name} failed on chain {chain.id}: {exc}")
                 continue
-            override = sig_cfg.get("severity")
             for s in found:
                 if override:
                     s.severity = override

@@ -109,9 +109,14 @@ class MatrixPoller:
         with ``dir=b`` the ``start`` token only re-requests the same page,
         freezing the window so codes posted after startup are never seen.
         Event-id dedupe guards the overlap at window edges (a replayed
-        invalid code would burn an attempt). Deviation kept from the
-        reference: codes are matched at word boundaries inside free text
-        (``handle_2fa_code`` parity), not exact-body-only."""
+        invalid code would burn an attempt). Only ``m.text`` messages are
+        scanned: notices/emotes/captions from bots and bridges are exactly
+        the incidental 6-digit chatter (ticket ids, timestamps) that burns
+        ``attemptsLeft`` for nothing and can lock a pending batch out
+        (ADVICE r5). Codes are matched at word boundaries inside free
+        text (``handle_2fa_code`` parity — deviation kept from the
+        reference's exact-body-only matching), which subsumes exact-body
+        codes: a bare 6-digit body matches at the same span."""
         if self._since is None:
             self._init_sync()
             return 0
@@ -128,7 +133,10 @@ class MatrixPoller:
                 self._remember(event_id)
             if event.get("type") != "m.room.message":
                 continue
-            body = (event.get("content") or {}).get("body") or ""
+            content = event.get("content") or {}
+            if content.get("msgtype") != "m.text":
+                continue
+            body = content.get("body") or ""
             sender = event.get("sender") or ""
             m = CODE_RE.search(body)
             if m:

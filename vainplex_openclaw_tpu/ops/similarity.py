@@ -10,12 +10,16 @@ TPU-friendly formulations for large windows:
 - ``jaccard_matrix``: hash each param-set into a multi-hot vector; the full
   pairwise Jaccard matrix is then one ``X @ X.T`` on the MXU plus
   elementwise math — O(N²·D) as a single fused matmul instead of N² Python
-  loops.
+  loops. Pass ``others`` for the rectangular A×B block (the incremental
+  clusterer's new-rows × all-rows update).
 - ``batch_levenshtein_ratio``: classic DP re-expressed as a ``lax.scan``
   over rows of the (padded, fixed-length) token grid, vmapped over the pair
   batch — static shapes, no data-dependent control flow.
 
-Both JAX paths are jitted once per shape; callers batch to fixed sizes.
+Both JAX paths are jitted once per shape. Batch dimensions are bucketed to
+powers of two INSIDE this module (zero-row padding, result sliced back), so
+the jit cache sees O(log N) distinct shapes instead of one compile per
+exact N. ``TRACE_COUNTS`` counts retraces for the cache-behavior tests.
 """
 
 from __future__ import annotations
@@ -28,6 +32,16 @@ import numpy as np
 
 VOLATILE_KEYS = frozenset({"timeout", "timestamp", "ts"})
 LEVENSHTEIN_CAP = 500
+
+# Retrace counters for the jitted kernels: the impl functions bump these at
+# TRACE time (once per compiled shape), so tests can pin that bucketed
+# repeat calls hit the jit cache instead of recompiling per exact N.
+TRACE_COUNTS = {"jaccard": 0, "levenshtein": 0}
+
+
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of two ≥ n (n ≥ 1)."""
+    return 1 << max(n - 1, 0).bit_length()
 
 
 # ── reference-exact scalar paths ─────────────────────────────────────
@@ -81,57 +95,140 @@ def param_similarity(a: dict, b: dict) -> float:
 # ── batched TPU paths ────────────────────────────────────────────────
 
 
-def hashed_multi_hot(param_sets: list[dict], dim: int = 1024) -> np.ndarray:
-    """Hash each param-set's key=value entries into a {0,1}^dim vector.
+def hash_entries(params: dict, dim: int = 1024) -> tuple[int, ...]:
+    """Sorted unique bit indices of one param-set's key=value entries.
 
     Uses crc32, NOT Python's ``hash()``: the builtin is salted per process
     (PYTHONHASHSEED), so collision behavior — and therefore batched-vs-
-    scalar similarity parity — would vary run to run."""
+    scalar similarity parity — would vary run to run. The tuple form is
+    what the incremental clusterer persists across runs: rebuilding the
+    multi-hot row from indices is exact, so a replayed row hashes
+    identically to a fresh one."""
     import zlib
 
-    X = np.zeros((len(param_sets), dim), dtype=np.float32)
-    for i, params in enumerate(param_sets):
-        for k, v in (params or {}).items():
-            if k in VOLATILE_KEYS:
-                continue
-            entry = f"{k}={json.dumps(v, sort_keys=True, default=str)}"
-            X[i, zlib.crc32(entry.encode("utf-8")) % dim] = 1.0
+    bits = set()
+    for k, v in (params or {}).items():
+        if k in VOLATILE_KEYS:
+            continue
+        entry = f"{k}={json.dumps(v, sort_keys=True, default=str)}"
+        bits.add(zlib.crc32(entry.encode("utf-8")) % dim)
+    return tuple(sorted(bits))
+
+
+def multi_hot_rows(bit_rows: list, dim: int = 1024) -> np.ndarray:
+    """{0,1}^dim float32 matrix from per-row bit-index tuples."""
+    X = np.zeros((len(bit_rows), dim), dtype=np.float32)
+    for i, bits in enumerate(bit_rows):
+        if bits:
+            X[i, list(bits)] = 1.0
     return X
 
 
-def jaccard_matrix(param_sets: list[dict], dim: int = 1024,
-                   use_jax: Optional[bool] = None) -> np.ndarray:
-    """Full pairwise Jaccard matrix over N param sets.
+def hashed_multi_hot(param_sets: list[dict], dim: int = 1024) -> np.ndarray:
+    """Hash each param-set's key=value entries into a {0,1}^dim vector."""
+    return multi_hot_rows([hash_entries(p, dim) for p in param_sets], dim)
+
+
+def jaccard_matrix(param_sets: list[dict], others: Optional[list] = None,
+                   dim: int = 1024, use_jax: Optional[bool] = None) -> np.ndarray:
+    """Pairwise Jaccard over N param sets — full N×N, or the rectangular
+    N×M block against ``others`` (the incremental clusterer's new-rows ×
+    all-rows update; symmetric pairs never need the full matrix twice).
 
     JAX path for large N (one MXU matmul); numpy fallback for tiny inputs
     where dispatch overhead dominates. Hash collisions can slightly inflate
     similarity — acceptable for loop *detection* (threshold 0.8).
+
+    Exactness note: rows are {0,1}, so every partial sum in the matmul is a
+    small integer — exactly representable in float32 under ANY accumulation
+    order. The full-matrix, rectangular-block, numpy, and jax formulations
+    therefore return bit-identical similarities, which is what lets the
+    incremental clusterer be equivalence-tested against this batch path.
     """
-    X = hashed_multi_hot(param_sets, dim)
+    Xa = hashed_multi_hot(param_sets, dim)
+    Xb = None if others is None else hashed_multi_hot(others, dim)
+    return jaccard_from_rows(Xa, Xb, use_jax=use_jax)
+
+
+def jaccard_from_rows(Xa: np.ndarray, Xb: Optional[np.ndarray] = None,
+                      use_jax: Optional[bool] = None) -> np.ndarray:
+    """Jaccard block from prebuilt multi-hot rows (see ``multi_hot_rows``);
+    ``Xb=None`` means the symmetric Xa×Xa matrix. Shared by the batch and
+    incremental clustering paths so both hash — and bucket — identically."""
+    B = Xa if Xb is None else Xb
+    na, nb = len(Xa), len(B)
+    if na == 0 or nb == 0:
+        return np.zeros((na, nb), dtype=np.float32)
     if use_jax is None:
-        use_jax = len(param_sets) >= 64 and _jax_enabled()
+        # Auto-route to jax only when a real accelerator backs it: on the
+        # CPU backend the jitted kernel pays dispatch overhead that BLAS
+        # doesn't (measured 4.9 ms vs 0.5 ms on the incremental clusterer's
+        # 16×512 block), and the two formulations are bit-identical anyway.
+        use_jax = (max(na, nb) >= 64 and _jax_enabled()
+                   and _backend_is_accelerator())
     if use_jax:
-        return np.asarray(_jaccard_matrix_jax(X))
+        # Bucket the batch dims to powers of two: zero-row padding changes
+        # nothing inside the real block (sliced right back out) and caps
+        # the jit cache at O(log N) shapes instead of one compile per N.
+        Xa_p = _pad_rows(Xa, _pow2_bucket(na))
+        Xb_p = Xa_p if Xb is None and _pow2_bucket(na) == _pow2_bucket(nb) \
+            else _pad_rows(B, _pow2_bucket(nb))
+        return np.asarray(_jaccard_matrix_jax(Xa_p, Xb_p))[:na, :nb]
     # numpy formulation — identical math, and the safe default in processes
     # that never pinned a jax platform (see _jax_enabled)
-    inter = X @ X.T
-    counts = X.sum(axis=1)
-    union = counts[:, None] + counts[None, :] - inter
+    inter = Xa @ B.T
+    ca, cb = Xa.sum(axis=1), B.sum(axis=1)
+    union = ca[:, None] + cb[None, :] - inter
     with np.errstate(divide="ignore", invalid="ignore"):
         sim = np.where(union > 0, inter / union, 1.0)
     return sim
 
 
-def _jaccard_matrix_jax_impl(X):
+def _pad_rows(X: np.ndarray, n: int) -> np.ndarray:
+    if len(X) == n:
+        return X
+    out = np.zeros((n, X.shape[1]), dtype=X.dtype)
+    out[:len(X)] = X
+    return out
+
+
+def _pad_vec(v: np.ndarray, n: int) -> np.ndarray:
+    if len(v) == n:
+        return v
+    out = np.zeros(n, dtype=v.dtype)
+    out[:len(v)] = v
+    return out
+
+
+def _jaccard_matrix_jax_impl(Xa, Xb):
     import jax.numpy as jnp
 
-    inter = X @ X.T
-    counts = X.sum(axis=1)
-    union = counts[:, None] + counts[None, :] - inter
+    TRACE_COUNTS["jaccard"] += 1  # runs at trace time: once per shape
+    inter = Xa @ Xb.T
+    ca, cb = Xa.sum(axis=1), Xb.sum(axis=1)
+    union = ca[:, None] + cb[None, :] - inter
     return jnp.where(union > 0, inter / union, 1.0)
 
 
 _jaccard_jit = None
+_backend_kind: "Optional[bool]" = None
+
+
+def _backend_is_accelerator() -> bool:
+    """True when jax dispatch lands on real accelerator hardware. Only
+    called after ``_jax_enabled()`` — i.e. the platform set is pinned local
+    or the operator explicitly accepted default-backend init — so the
+    backend lookup cannot hit the wedged-tunnel hang this module guards
+    against. Cached: the backend cannot change after first init."""
+    global _backend_kind
+    if _backend_kind is None:
+        try:
+            import jax
+
+            _backend_kind = jax.default_backend() != "cpu"
+        except Exception:  # noqa: BLE001 — no usable backend → numpy path
+            _backend_kind = False
+    return _backend_kind
 
 
 def _jax_enabled() -> bool:
@@ -150,13 +247,13 @@ def _jax_enabled() -> bool:
     return backend_init_safe()
 
 
-def _jaccard_matrix_jax(X: np.ndarray):
+def _jaccard_matrix_jax(Xa: np.ndarray, Xb: np.ndarray):
     global _jaccard_jit
     if _jaccard_jit is None:
         import jax
 
         _jaccard_jit = jax.jit(_jaccard_matrix_jax_impl)
-    return _jaccard_jit(X)
+    return _jaccard_jit(Xa, Xb)
 
 
 def _tokenize_fixed(strings: list[str], length: int) -> np.ndarray:
@@ -180,6 +277,7 @@ def _batch_levenshtein_jax(A: np.ndarray, B: np.ndarray, len_a: np.ndarray,
         from jax import lax
 
         def one_pair(a, b, la, lb):
+            TRACE_COUNTS["levenshtein"] += 1  # trace time: once per shape
             L = a.shape[0]
             init_row = jnp.arange(L + 1, dtype=jnp.int32)
 
@@ -241,11 +339,13 @@ def batch_levenshtein_ratio(pairs: list[tuple[str, str]], length: int = 128,
     """Levenshtein ratios for a batch of string pairs.
 
     The JAX path pads/tokenizes to ``length`` (similarity over the first
-    ``length`` bytes — fine for loop detection on commands); the scalar path
-    is exact up to the 500-char cap.
+    ``length`` bytes — fine for loop detection on commands) and buckets the
+    batch dim to a power of two internally (the jitted DP is cached per
+    shape — callers must not see a recompile per exact pair count); the
+    scalar path is exact up to the 500-char cap.
     """
     batched = len(pairs) >= 32 if use_jax is None else use_jax
-    if not batched:
+    if not batched or not pairs:
         return np.array([levenshtein_ratio(a, b) for a, b in pairs], dtype=np.float32)
     if use_jax is None:
         use_jax = _jax_enabled()
@@ -256,7 +356,10 @@ def batch_levenshtein_ratio(pairs: list[tuple[str, str]], length: int = 128,
     len_a = (A > 0).sum(axis=1).astype(np.int32)
     len_b = (B > 0).sum(axis=1).astype(np.int32)
     if use_jax:
-        dist = np.asarray(_batch_levenshtein_jax(A, B, len_a, len_b))
+        bucket = _pow2_bucket(len(pairs))
+        dist = np.asarray(_batch_levenshtein_jax(
+            _pad_rows(A, bucket), _pad_rows(B, bucket),
+            _pad_vec(len_a, bucket), _pad_vec(len_b, bucket)))[:len(pairs)]
     else:
         dist = _batch_levenshtein_numpy(A, B, len_a, len_b)
     max_len = np.maximum(len_a, len_b)

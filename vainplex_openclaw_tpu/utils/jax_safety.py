@@ -28,8 +28,14 @@ backend) are an operator's deliberate choice and are not gated.
 from __future__ import annotations
 
 import os
+from typing import Optional
 
-_LOCAL_PLATFORMS = {"cpu"}
+# 'tpu' is local libtpu — initialized in-process over PCIe, no tunnel to
+# wedge — so a jax_platforms='tpu' pin is as safe as 'cpu'. (ADVICE r5: the
+# serve path's error message advertised jax_platforms='tpu' while this set
+# rejected it, making the advertised remedy a dead end.) The remote 'axon'
+# plugin is exactly what this set exists to exclude.
+_LOCAL_PLATFORMS = {"cpu", "tpu"}
 
 
 def backend_init_safe() -> bool:
@@ -47,3 +53,40 @@ def backend_init_safe() -> bool:
         return False
     names = {p.strip().lower() for p in str(platforms).split(",") if p.strip()}
     return bool(names) and names <= _LOCAL_PLATFORMS
+
+
+def enable_persistent_compilation_cache(cache_dir: Optional[str] = None) -> bool:
+    """Opt-in persistent XLA compilation cache; returns True if enabled.
+
+    No-op unless ``cache_dir`` is passed or $OPENCLAW_XLA_CACHE_DIR is set —
+    writing compiled executables to disk is an operator decision, not a
+    default. Once on, every jit compile is written through to the cache
+    directory and replayed on the next process with the same fingerprint.
+    Two workloads this de-risks:
+
+    - the encoder_mfu ladder (bench.py/tpu_capture.py): the level-0 remote
+      compile has never fit a healthy tunnel window live — with the cache,
+      a compile that finished in ANY previous attempt is a disk read;
+    - repeated CPU bench/CI runs of the similarity kernels, whose
+      power-of-two-bucketed shapes are stable across runs by design.
+
+    The min-compile-time/entry-size floors are dropped to zero so even the
+    small bucketed kernels persist; flags missing from older jax versions
+    are skipped rather than fatal.
+    """
+    cache_dir = cache_dir or os.environ.get("OPENCLAW_XLA_CACHE_DIR")
+    if not cache_dir:
+        return False
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    except Exception:  # noqa: BLE001 — no jax / unsupported: feature stays off
+        return False
+    for flag, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(flag, val)
+        except Exception:  # noqa: BLE001 — flag not in this jax version
+            pass
+    return True
