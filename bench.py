@@ -40,13 +40,19 @@ def synth_events(n_chains: int = 400) -> list[dict]:
     return raws
 
 
+def _stage_records(metric: str, stage_ms: dict) -> list[dict]:
+    """One machine-readable record per pipeline stage, shared by every
+    metric family so their record shape can never diverge."""
+    return [{"metric": metric, "stage": name, "value": ms, "unit": "ms"}
+            for name, ms in (stage_ms or {}).items()]
+
+
 def trace_analyzer_stage_records(stage_ms: dict) -> list[dict]:
-    """One machine-readable record per analyzer pipeline stage. VERDICT r5
-    weak #2: the headline halved between rounds and nothing on record could
-    say WHICH stage ate it — these lines ride alongside the headline so a
-    regression arrives pre-attributed."""
-    return [{"metric": "trace_analyzer_stage_ms", "stage": name,
-             "value": ms, "unit": "ms"} for name, ms in (stage_ms or {}).items()]
+    """Per-stage lines for the analyzer headline. VERDICT r5 weak #2: the
+    headline halved between rounds and nothing on record could say WHICH
+    stage ate it — these lines ride alongside the headline so a regression
+    arrives pre-attributed."""
+    return _stage_records("trace_analyzer_stage_ms", stage_ms)
 
 
 def bench_trace_analyzer(n_chains: int = 400) -> dict:
@@ -88,6 +94,89 @@ def bench_trace_analyzer(n_chains: int = 400) -> dict:
         "vs_baseline": round(events_per_minute / baseline, 1),
         "stage_ms": stage_ms,
     }
+
+
+def knowledge_stage_records(stage_ms: dict) -> list[dict]:
+    """One machine-readable record per knowledge-engine stage (ISSUE 2 —
+    same discipline as trace_analyzer_stage_records): a knowledge ingest or
+    search regression arrives pre-attributed to ingest / sync / search."""
+    return _stage_records("knowledge_stage_ms", stage_ms)
+
+
+# Seed (pre-ISSUE-2) measurements on THIS container, recorded in
+# docs/knowledge-engine-perf.md: the O(n) content-dedupe scan ingested
+# ~7,900 facts/s at the 2000-fact cap; warm local-embeddings search ran
+# ~2.9 ms. vs_baseline > 1 means faster than the seed code on the same
+# hardware.
+KNOWLEDGE_INGEST_BASELINE = 7_900.0   # facts/s
+KNOWLEDGE_SEARCH_BASELINE_MS = 2.9    # ms, warm
+
+
+def bench_knowledge_ingest(n_facts: int = 2000) -> dict:
+    """Fact-store ingest throughput (facts/s) at the maxFacts cap — the
+    regime where the seed's per-add linear dedupe scan was O(n²) to fill
+    the store. Unique facts only: every add exercises the index miss path
+    (insert), the worst case for the O(1) index."""
+    import tempfile
+
+    from vainplex_openclaw_tpu.core.api import list_logger
+    from vainplex_openclaw_tpu.knowledge.fact_store import FactStore
+
+    with tempfile.TemporaryDirectory() as tmp:  # warmup: allocator, iso cache
+        store = FactStore(tmp, {"maxFacts": n_facts}, list_logger(),
+                          wall_timers=False)
+        store.load()
+        for i in range(200):
+            store.add_fact(f"warm{i}", "p", f"o{i}")
+    with tempfile.TemporaryDirectory() as tmp:
+        store = FactStore(tmp, {"maxFacts": n_facts}, list_logger(),
+                          wall_timers=False)
+        store.load()
+        t0 = time.perf_counter()
+        for i in range(n_facts):
+            store.add_fact(f"s{i % 500}", f"p{i % 37}", f"o{i}")
+        dt = time.perf_counter() - t0
+        assert store.count() == n_facts, "every unique fact must land"
+        stage_ms = store.timer.stages_ms()
+    rate = n_facts / dt
+    return {"metric": "knowledge_ingest_throughput", "value": round(rate, 0),
+            "unit": "facts/s",
+            "vs_baseline": round(rate / KNOWLEDGE_INGEST_BASELINE, 1),
+            "stage_ms": stage_ms}
+
+
+def bench_knowledge_search(n_facts: int = 256, n_queries: int = 32,
+                           k: int = 5) -> dict:
+    """Warm local-embeddings search latency (ms/query): model compiled,
+    arena synced, DISTINCT queries so every timed call pays the real
+    embed + score + top-k cost (a repeated query is a cache hit — reported
+    separately as cached_ms, not as the headline value)."""
+    from vainplex_openclaw_tpu.core.api import list_logger
+    from vainplex_openclaw_tpu.knowledge.embeddings import LocalEmbeddings
+    from vainplex_openclaw_tpu.knowledge.fact_store import Fact
+
+    facts = [Fact(id=f"f{i}", subject=f"service{i % 40}", predicate="emits",
+                  object=f"signal {i} about deploys and clusters")
+             for i in range(n_facts)]
+    emb = LocalEmbeddings(list_logger())
+    emb.sync(facts)  # pays model restore + bucket compile once
+    for i in range(4):  # warm the query-bucket (batch-1) compile
+        emb.search(f"warmup question {i}", k=k)
+    queries = [f"which service emits deploy signal {i}" for i in range(n_queries)]
+    t0 = time.perf_counter()
+    for q in queries:
+        results = emb.search(q, k=k)
+    dt_ms = (time.perf_counter() - t0) * 1000.0 / n_queries
+    assert results, "warm index must return results"
+    t0 = time.perf_counter()
+    for q in queries:  # same queries again: LRU hits, no embed
+        emb.search(q, k=k)
+    cached_ms = (time.perf_counter() - t0) * 1000.0 / n_queries
+    return {"metric": "knowledge_search_latency", "value": round(dt_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(KNOWLEDGE_SEARCH_BASELINE_MS / dt_ms, 2),
+            "cached_ms": round(cached_ms, 3), "index_size": emb.count(),
+            "stage_ms": emb.timer.stages_ms()}
 
 
 def bench_event_publish(n: int = 20_000) -> dict:
@@ -768,9 +857,14 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
     except Exception as exc:  # noqa: BLE001 — diagnosable, not fatal
         print(f"force-cpu pin failed: {exc}", file=sys.stderr)
-    for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval):
+    for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval,
+               bench_knowledge_ingest, bench_knowledge_search):
         try:
-            print(f"secondary: {json.dumps(fn())}", file=sys.stderr)
+            rec = fn()
+            print(f"secondary: {json.dumps(rec)}", file=sys.stderr)
+            if rec.get("metric", "").startswith("knowledge_"):
+                for srec in knowledge_stage_records(rec.get("stage_ms")):
+                    print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — secondaries must not kill the headline
             print(f"secondary failed: {exc}", file=sys.stderr)
     headline = bench_trace_analyzer()

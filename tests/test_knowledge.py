@@ -271,6 +271,49 @@ class TestRegressions:
         assert facts and facts[0].object == "CTO"
 
 
+class TestStageAttribution:
+    """ISSUE 2: one shared StageTimer across store/embeddings/maintenance,
+    surfaced through plugin.stats() and the /knowledge status text."""
+
+    def load(self, workspace):
+        gw, _ = make_gateway()
+        plugin = KnowledgeEnginePlugin(workspace=str(workspace), clock=gw.clock,
+                                       wall_timers=False)
+        gw.load(plugin, plugin_config={"enabled": True})
+        gw.start()
+        return gw, plugin
+
+    def test_stats_carry_stage_breakdown(self, workspace, openclaw_home):
+        gw, plugin = self.load(workspace)
+        gw.message_received("Contact anna@example.org at Acme GmbH about the launch",
+                            {"session_key": "s"})
+        plugin.fact_store.query(text="anna")
+        stats = plugin.stats()
+        assert stats["facts"] >= 1
+        assert {"extract", "ingest", "query"} <= set(stats["stageMs"])
+        assert all(v >= 0 for v in stats["stageMs"].values())
+        assert stats["stageCounts"]["ingest"] >= 2  # anna + launch entities
+        assert stats["stageCounts"]["query"] == 1
+        assert stats["queryCache"] == {"hits": 0, "misses": 0}
+
+    def test_status_text_includes_stage_line(self, workspace, openclaw_home):
+        gw, plugin = self.load(workspace)
+        gw.message_received("Reach bob@corp.io today", {"session_key": "s"})
+        assert "stages:" in gw.command("/knowledge")["text"]
+
+    def test_maintenance_ticks_attributed(self, workspace, openclaw_home):
+        gw, plugin = self.load(workspace)
+        gw.message_received("Reach bob@corp.io today", {"session_key": "s"})
+        plugin.maintenance.run_embeddings_sync()
+        plugin.maintenance.run_decay()
+        stage_ms = plugin.timer.stages_ms()
+        assert {"sync", "decay"} <= set(stage_ms)
+        # the same timer instance is shared by every component
+        assert plugin.fact_store.timer is plugin.timer
+        assert plugin.maintenance.timer is plugin.timer
+        assert plugin.embeddings.timer is plugin.timer
+
+
 class TestChromaRemove:
     def test_remove_posts_to_delete_endpoint(self):
         calls = []

@@ -53,6 +53,18 @@ class TestStageTimer:
         first["x"] = -1
         assert timer.stages_ms()["x"] >= 0
 
+    def test_counts_track_stage_entries(self):
+        ticks = iter(range(100))
+        timer = StageTimer(clock=lambda: next(ticks))
+        for _ in range(3):
+            with timer.stage("hot"):
+                pass
+        timer.add("fold", 5.0)
+        assert timer.counts() == {"hot": 3, "fold": 1}
+        stale = timer.counts()
+        stale["hot"] = -1
+        assert timer.counts()["hot"] == 3  # fresh dict per call
+
 
 class TestAnalyzerStageStats:
     def _run(self, tmp_path):

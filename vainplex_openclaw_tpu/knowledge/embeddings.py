@@ -13,9 +13,14 @@ Two backends:
 from __future__ import annotations
 
 import json
+import threading
+from collections import OrderedDict
 from typing import Callable, Optional
 
 import numpy as np
+
+from ..ops.similarity import pad_rows, pow2_bucket
+from ..utils.stage_timer import StageTimer
 
 
 def _default_http_post(url: str, payload: dict, timeout: float = 15.0) -> dict:
@@ -106,103 +111,248 @@ class LocalEmbeddings:
     neighborhoods (failure-ish facts near failure-ish queries) come for free;
     the bag-of-tokens half guarantees lexical grounding. Falls back to
     random init only when no checkpoint is present. Lazy model init (first
-    sync pays compile/restore)."""
+    sync pays compile/restore).
+
+    Serve-scale layout (ISSUE 2):
+
+    - ``_embed`` runs a jitted forward whose batch dim is bucketed to powers
+      of two (``ops/similarity.pow2_bucket`` — the PR 1 shape policy), so
+      sync batches and the single-query path share O(log N) compiled shapes
+      instead of one XLA compile per distinct batch size. ``trace_count``
+      bumps at trace time so tests can pin the cache behavior. The
+      bag-of-tokens half is one vectorized flat scatter-add instead of a
+      per-row Python loop.
+    - Vectors live in a capacity-doubling float32 arena; ``sync`` overwrites
+      re-synced ids in place and appends new ids, ``remove`` compacts by
+      swapping the last row in (no tombstones). The pre-arena full
+      ``np.concatenate`` rebuild stays the equivalence oracle in
+      tests/test_knowledge_perf_equiv.py: per-id stored vectors are pinned
+      BITWISE; scores agree to BLAS layout rounding (sgemv is row-position
+      sensitive at 1 ulp — true of the pre-arena layout too).
+    - ``search`` selects top-k via ``np.argpartition`` (O(n) instead of a
+      full sort) and orders ties deterministically by (-score, id) — which
+      also makes results independent of internal arena row order.
+    - Query embeddings go through an LRU cache; entries are embeddings only
+      (never result lists), so a cached query always scores against the
+      CURRENT arena — a sync never serves stale search results.
+    """
 
     def __init__(self, logger, seed: int = 11, learned_weight: float = 0.5,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 timer: Optional[StageTimer] = None,
+                 query_cache_size: int = 256):
         self.logger = logger
         self.seed = seed
         self.learned_weight = learned_weight
         self.checkpoint_dir = checkpoint_dir
+        self.timer = timer if timer is not None else StageTimer()
         self._model = None
-        self._ids: list[str] = []
-        self._vectors: Optional[np.ndarray] = None
+        self._forward_jit = None
+        self.trace_count = 0  # bumped at jit-trace time: once per bucket shape
+        # Maintenance syncs/removes run on a daemon thread while the serve
+        # thread searches; in-place arena mutation (row overwrite, swap
+        # compaction) would tear a concurrent matmul's view, so arena and
+        # query-cache access is serialized. Embedding compute (the slow
+        # part) stays outside the lock.
+        self._lock = threading.Lock()
+        # Separate init lock: first sync (maintenance thread) and first
+        # search (serve thread) race the lazy model restore + jit wrapper
+        # creation; double restore would double startup latency and break
+        # the trace_count "once per compiled shape" invariant.
+        self._init_lock = threading.Lock()
         self._docs: dict[str, str] = {}
+        # Arena: rows [0, _size) of _arena are live; _ids[row] ↔ _pos[id].
+        self._arena: Optional[np.ndarray] = None
+        self._size = 0
+        self._ids: list[str] = []
+        self._pos: dict[str, int] = {}
+        self._query_cache: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._query_cache_size = query_cache_size
+        self.query_cache_hits = 0
+        self.query_cache_misses = 0
 
     def enabled(self) -> bool:
         return True
 
+    # ── embedding ────────────────────────────────────────────────────
+
+    def _ensure_model(self):
+        with self._init_lock:
+            if self._model is None:
+                from ..models.pretrained import load_pretrained
+
+                self._model = load_pretrained(self.checkpoint_dir)
+            if self._model is None:  # no shipped checkpoint anywhere
+                import jax
+
+                from ..models import EncoderConfig, cast_params, init_params
+
+                cfg = EncoderConfig()
+                self._model = (cfg,
+                               cast_params(init_params(jax.random.PRNGKey(self.seed), cfg),
+                                           cfg.dtype))
+            if self._forward_jit is None:
+                import jax
+
+                from ..models import forward
+
+                cfg = self._model[0]
+
+                def run(params, tokens):
+                    self.trace_count += 1  # trace time: once per compiled shape
+                    return forward(params, tokens, cfg)["embedding"]
+
+                self._forward_jit = jax.jit(run)
+            return self._model
+
     def _embed(self, texts: list[str]) -> np.ndarray:
-        if self._model is None:
-            from ..models.pretrained import load_pretrained
+        cfg, params = self._ensure_model()
+        from ..models import encode_texts
 
-            self._model = load_pretrained(self.checkpoint_dir)
-        if self._model is None:  # no shipped checkpoint anywhere
-            import jax
-
-            from ..models import EncoderConfig, cast_params, init_params
-
-            cfg = EncoderConfig()
-            self._model = (cfg, cast_params(init_params(jax.random.PRNGKey(self.seed), cfg),
-                                            cfg.dtype))
-        cfg, params = self._model
-        from ..models import encode_texts, forward
-
+        n = len(texts)
         tokens = encode_texts(texts, cfg.seq_len, cfg.vocab_size)
-        out = forward(params, tokens, cfg)
-        learned = np.asarray(out["embedding"], dtype=np.float32)  # already L2-normed
+        # Bucket the batch dim to a power of two: zero-token padding rows are
+        # batch-independent in the encoder (masked pooling clamps the
+        # denominator) and are sliced back out, so the jit cache holds
+        # O(log N) shapes instead of one compile per distinct batch size.
+        padded = pad_rows(tokens, pow2_bucket(n))
+        learned = np.asarray(self._forward_jit(params, padded),
+                             dtype=np.float32)[:n]  # already L2-normed
 
-        bow = np.zeros((len(texts), cfg.vocab_size), dtype=np.float32)
-        for i, row in enumerate(tokens):
-            ids = row[row > 1]  # drop PAD/CLS
-            np.add.at(bow[i], ids, 1.0)
+        # Vectorized bag-of-tokens: one flat scatter-add over (row, token)
+        # pair indices instead of a per-row Python loop — and not bincount,
+        # whose int64 output would triple transient memory on a full-store
+        # sync (the flat float32 buffer IS the bow matrix).
+        mask = tokens > 1  # drop PAD/CLS
+        rows = np.nonzero(mask)[0]
+        ids = tokens[mask].astype(np.int64)
+        flat = np.zeros(n * cfg.vocab_size, dtype=np.float32)
+        np.add.at(flat, rows * cfg.vocab_size + ids, 1.0)
+        bow = flat.reshape(n, cfg.vocab_size)
         norms = np.linalg.norm(bow, axis=1, keepdims=True)
         bow = np.where(norms > 0, bow / np.maximum(norms, 1e-9), bow)
 
-        w = self.learned_weight
-        return np.concatenate([learned * np.sqrt(w), bow * np.sqrt(1.0 - w)], axis=1)
+        # float32 weights: np.sqrt(python float) is a float64 scalar, which
+        # under NumPy-2 promotion silently upcast the whole index to float64
+        # (2x arena bytes for noise-level precision the scores never used).
+        w = np.float32(self.learned_weight)
+        return np.concatenate([learned * np.sqrt(w),
+                               bow * np.sqrt(np.float32(1.0) - w)], axis=1)
+
+    def _embed_query(self, query: str) -> np.ndarray:
+        with self._lock:
+            cached = self._query_cache.get(query)
+            if cached is not None:
+                self._query_cache.move_to_end(query)
+                self.query_cache_hits += 1
+                return cached
+            self.query_cache_misses += 1
+        vec = self._embed([query])[0]  # slow: outside the lock
+        with self._lock:
+            self._query_cache[query] = vec
+            while len(self._query_cache) > self._query_cache_size:
+                self._query_cache.popitem(last=False)
+        return vec
+
+    # ── arena index ──────────────────────────────────────────────────
+
+    def _reserve(self, extra: int, dim: int) -> None:
+        need = self._size + extra
+        if self._arena is None:
+            self._arena = np.zeros((max(pow2_bucket(max(need, 1)), 64), dim),
+                                   dtype=np.float32)
+            return
+        if need <= len(self._arena):
+            return
+        cap = len(self._arena)
+        while cap < need:
+            cap *= 2
+        grown = np.zeros((cap, dim), dtype=np.float32)
+        grown[:self._size] = self._arena[:self._size]
+        self._arena = grown
 
     def sync(self, facts: list) -> int:
         if not facts:
             return 0
-        docs = [fact_document(f) for f in facts]
-        vectors = self._embed(docs)
-        for fact, doc in zip(facts, docs):
-            self._docs[fact.id] = doc
-        new_ids = [f.id for f in facts]
-        if self._vectors is None:
-            self._ids, self._vectors = new_ids, vectors
-        else:
-            keep = [i for i, fid in enumerate(self._ids) if fid not in set(new_ids)]
-            self._ids = [self._ids[i] for i in keep] + new_ids
-            self._vectors = np.concatenate([self._vectors[keep], vectors]) \
-                if keep else vectors
+        with self.timer.stage("sync"):
+            docs = [fact_document(f) for f in facts]
+            vectors = self._embed(docs)  # slow: outside the lock
+            with self._lock:
+                # Reserve rows only for ids not already resident: a full-store
+                # re-sync consumes zero new rows and must not trigger a
+                # capacity doubling.
+                fresh = sum(1 for f in facts if f.id not in self._pos)
+                self._reserve(fresh, vectors.shape[1])
+                for fact, doc, vec in zip(facts, docs, vectors):
+                    self._docs[fact.id] = doc
+                    row = self._pos.get(fact.id)
+                    if row is not None:  # re-sync: overwrite in place
+                        self._arena[row] = vec
+                        continue
+                    self._arena[self._size] = vec
+                    self._pos[fact.id] = self._size
+                    self._ids.append(fact.id)
+                    self._size += 1
         return len(facts)
 
     def search(self, query: str, k: int = 5) -> list[dict]:
-        if self._vectors is None or not self._ids:
+        if self._size == 0:
             return []
-        q = self._embed([query])[0]
-        scores = self._vectors @ q
-        order = np.argsort(-scores)[:k]
-        return [{"id": self._ids[i], "document": self._docs.get(self._ids[i], ""),
-                 "score": float(scores[i])} for i in order]
+        with self.timer.stage("search"):
+            q = self._embed_query(query)
+            with self._lock:
+                size = self._size
+                if size == 0:  # raced with a remove draining the arena
+                    return []
+                scores = self._arena[:size] @ q
+                if 0 < k < size:
+                    # argpartition gives the kth-largest score in O(n); keep
+                    # every index at or above it so boundary ties are broken
+                    # by the same deterministic (-score, id) order as a full
+                    # sort would.
+                    kth = scores[np.argpartition(-scores, k - 1)[:k]].min()
+                    cand = np.nonzero(scores >= kth)[0]
+                else:
+                    cand = np.arange(size)
+                order = sorted(cand, key=lambda i: (-scores[i], self._ids[i]))[:k]
+                return [{"id": self._ids[i],
+                         "document": self._docs.get(self._ids[i], ""),
+                         "score": float(scores[i])} for i in order]
 
     def remove(self, ids) -> int:
         """Drop pruned facts from the index so search never returns them.
-        Ids already absent count as settled (the desired state holds)."""
+        Ids already absent count as settled (the desired state holds).
+        Compaction is tombstone-free: the last live row swaps into the hole."""
         dead = set(ids)
         if not dead:
             return 0
-        if self._vectors is None:
-            return len(dead)
-        keep = [i for i, fid in enumerate(self._ids) if fid not in dead]
-        if len(keep) < len(self._ids):
-            self._ids = [self._ids[i] for i in keep]
-            self._vectors = self._vectors[keep] if keep else None
-        for fid in dead:
-            self._docs.pop(fid, None)
+        with self._lock:
+            for fid in dead:
+                self._docs.pop(fid, None)
+                row = self._pos.pop(fid, None)
+                if row is None:
+                    continue
+                last = self._size - 1
+                if row != last:
+                    self._arena[row] = self._arena[last]
+                    moved = self._ids[last]
+                    self._ids[row] = moved
+                    self._pos[moved] = row
+                self._ids.pop()
+                self._size -= 1
         return len(dead)
 
     def count(self) -> int:
-        return len(self._ids)
+        return self._size
 
 
-def create_embeddings(config: dict, logger, http_post: Callable = _default_http_post):
+def create_embeddings(config: dict, logger, http_post: Callable = _default_http_post,
+                      timer: Optional[StageTimer] = None):
     backend = (config or {}).get("backend", "local")
     if backend == "chroma":
         return ChromaEmbeddings(config, logger, http_post)
     if backend == "local":
         return LocalEmbeddings(logger,
-                               checkpoint_dir=(config or {}).get("checkpointDir"))
+                               checkpoint_dir=(config or {}).get("checkpointDir"),
+                               timer=timer)
     return None

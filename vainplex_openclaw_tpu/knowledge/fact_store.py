@@ -4,10 +4,21 @@
 Content-dedupe boosts relevance on re-add; relevance decays on a
 maintenance schedule; pruning drops the least relevant facts above the cap;
 persistence is a debounced atomic write of ``facts.json``.
+
+Serve-scale ingest (ISSUE 2): ``add_fact`` dedupes through a
+``(subject, predicate, object)`` index kept in lockstep with ``self.facts``
+— O(1) per add instead of a linear scan over the whole store, which at the
+2000-fact cap made every insert an O(n) pass (O(n²) to fill the store).
+The scan survives as ``find_by_content_scan``, the equivalence oracle the
+property tests replay against the index. ``query`` reads a cached lowercase
+haystack per fact instead of re-lowercasing three fields per fact per call.
 """
 
 from __future__ import annotations
 
+import os
+import random
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -15,6 +26,7 @@ from pathlib import Path
 from typing import Callable, Optional
 
 from ..storage.atomic import AtomicStorage
+from ..utils.stage_timer import StageTimer
 
 DEFAULT_STORE_CONFIG = {
     "maxFacts": 2000,
@@ -23,6 +35,23 @@ DEFAULT_STORE_CONFIG = {
     "decayFactor": 0.95,
     "pruneBelowRelevance": 0.05,
 }
+
+# uuid4() pays a urandom syscall per call — half the ingest budget at the
+# 2000-fact cap once dedupe is O(1). Fact ids are storage keys, not security
+# tokens, so a process-seeded PRNG with the same 122 random bits (and the
+# same RFC-4122 text shape) keeps the collision math while staying in
+# userspace. Seeded from urandom so parallel processes diverge; reseeded
+# after fork, since children would otherwise inherit the parent's PRNG
+# state and emit colliding id sequences (uuid4 was immune to this).
+_ID_RNG = random.Random(int.from_bytes(os.urandom(16), "big"))
+
+if hasattr(os, "register_at_fork"):  # POSIX only
+    os.register_at_fork(
+        after_in_child=lambda: _ID_RNG.seed(int.from_bytes(os.urandom(16), "big")))
+
+
+def _new_fact_id() -> str:
+    return str(uuid.UUID(int=_ID_RNG.getrandbits(128), version=4))
 
 
 @dataclass
@@ -51,32 +80,56 @@ class Fact:
                    last_accessed=d.get("lastAccessed", ""),
                    relevance=float(d.get("relevance", 1.0)))
 
+    def content_key(self) -> tuple[str, str, str]:
+        return (self.subject, self.predicate, self.object)
+
 
 class FactStore:
     def __init__(self, workspace: str | Path, config: Optional[dict] = None,
                  logger=None, clock: Callable[[], float] = time.time,
-                 wall_timers: bool = True):
+                 wall_timers: bool = True, timer: Optional[StageTimer] = None):
         self.config = {**DEFAULT_STORE_CONFIG, **(config or {})}
         self.logger = logger
         self.clock = clock
+        self.timer = timer if timer is not None else StageTimer()
         self.storage = AtomicStorage(Path(workspace) / "knowledge", wall=wall_timers)
+        # Maintenance decay runs on a daemon thread while the gateway thread
+        # ingests: iteration over self.facts and the index bookkeeping must
+        # not interleave (RLock: add_fact's prune path re-enters).
+        self._facts_lock = threading.RLock()
         self.facts: dict[str, Fact] = {}
+        # (subject, predicate, object) → fact id, in lockstep with self.facts.
+        # Dedupe semantics are exact-match on the raw fields, same as the scan.
+        self._content_index: dict[tuple[str, str, str], str] = {}
+        # fact id → (subject_lower, predicate_lower, "s p o" haystack_lower);
+        # fields are immutable after creation, so the cache never goes stale.
+        self._lower: dict[str, tuple[str, str, str]] = {}
+        self._iso_cache: tuple[int, str] = (-1, "")
         self.loaded = False
 
     def _iso(self) -> str:
-        t = time.gmtime(self.clock())
-        return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
-                f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+        # Second-resolution timestamps: cache per whole second so ingest
+        # bursts don't pay gmtime + formatting per fact.
+        now = int(self.clock())
+        if self._iso_cache[0] != now:
+            t = time.gmtime(now)
+            self._iso_cache = (now, f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+                                    f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+        return self._iso_cache[1]
 
     def load(self) -> None:
-        if self.loaded:
-            return
-        data = self.storage.load("facts.json")
-        if isinstance(data, dict) and isinstance(data.get("facts"), list):
-            self.facts = {f["id"]: Fact.from_dict(f) for f in data["facts"] if f.get("id")}
-            if self.logger:
-                self.logger.info(f"Loaded {len(self.facts)} facts from storage")
-        self.loaded = True
+        with self._facts_lock:
+            if self.loaded:
+                return
+            data = self.storage.load("facts.json")
+            if isinstance(data, dict) and isinstance(data.get("facts"), list):
+                self.facts = {f["id"]: Fact.from_dict(f) for f in data["facts"]
+                              if f.get("id")}
+                for fact in self.facts.values():
+                    self._index(fact)
+                if self.logger:
+                    self.logger.info(f"Loaded {len(self.facts)} facts from storage")
+            self.loaded = True
 
     def _commit(self) -> None:
         self.storage.save_debounced(
@@ -89,55 +142,115 @@ class FactStore:
         if self.loaded:
             self.storage.flush_all()
 
+    # ── content index ────────────────────────────────────────────────
+
+    def _index(self, fact: Fact) -> None:
+        # setdefault, not assignment: on the paths where duplicate content
+        # keys are possible (loading a pre-index facts.json, or a fact
+        # inserted behind the store's back), the index must resolve to the
+        # FIRST fact in iteration order — exactly what the linear-scan
+        # oracle (find_by_content_scan) returns.
+        self._content_index.setdefault(fact.content_key(), fact.id)
+        self._lower[fact.id] = (
+            fact.subject.lower(), fact.predicate.lower(),
+            f"{fact.subject} {fact.predicate} {fact.object}".lower())
+
+    def _unindex(self, fact: Fact) -> None:
+        key = fact.content_key()
+        if self._content_index.get(key) == fact.id:
+            del self._content_index[key]
+            # Duplicate content keys exist only when facts landed behind the
+            # store's back (or a pre-index file held them) — detectable in
+            # O(1): every distinctly-keyed indexed fact contributes one index
+            # entry, so fewer entries than facts means a shadowed duplicate
+            # may survive this removal and must inherit the key, or the index
+            # would diverge from the linear-scan oracle. Normal operation
+            # never enters the scan.
+            if len(self._content_index) + 1 < len(self.facts):
+                for other in self.facts.values():
+                    if other.id != fact.id and other.content_key() == key:
+                        self._content_index[key] = other.id
+                        break
+        self._lower.pop(fact.id, None)
+
+    def find_by_content_scan(self, subject: str, predicate: str,
+                             object_: str) -> Optional[Fact]:
+        """The pre-index O(n) dedupe scan, kept as the equivalence oracle:
+        property tests replay randomized add/decay/prune sequences and pin
+        that the index finds exactly what this scan finds."""
+        with self._facts_lock:
+            for fact in self.facts.values():
+                if (fact.subject == subject and fact.predicate == predicate
+                        and fact.object == object_):
+                    return fact
+            return None
+
     def add_fact(self, subject: str, predicate: str, object_: str,
                  source: str = "extracted-regex") -> Fact:
         if not self.loaded:
             raise RuntimeError("FactStore not loaded; call load() first")
-        now = self._iso()
-        for fact in self.facts.values():
-            if (fact.subject == subject and fact.predicate == predicate
-                    and fact.object == object_):
+        with self.timer.stage("ingest"), self._facts_lock:
+            now = self._iso()
+            existing_id = self._content_index.get((subject, predicate, object_))
+            if existing_id is not None:
+                fact = self.facts[existing_id]
                 fact.relevance = min(1.0, fact.relevance + self.config["relevanceBoost"])
                 fact.last_accessed = now
                 self._commit()
                 return fact
-        fact = Fact(id=str(uuid.uuid4()), subject=subject, predicate=predicate,
-                    object=object_, source=source, created_at=now,
-                    last_accessed=now, relevance=1.0)
-        self.facts[fact.id] = fact
-        self._prune()
-        self._commit()
-        return fact
+            fact = Fact(id=_new_fact_id(), subject=subject, predicate=predicate,
+                        object=object_, source=source, created_at=now,
+                        last_accessed=now, relevance=1.0)
+            self.facts[fact.id] = fact
+            self._index(fact)
+            self._prune()
+            self._commit()
+            return fact
 
     def query(self, subject: Optional[str] = None, predicate: Optional[str] = None,
               text: Optional[str] = None, limit: int = 50) -> list[Fact]:
-        out = []
-        needle = (text or "").lower()
-        for fact in self.facts.values():
-            if subject and fact.subject.lower() != subject.lower():
-                continue
-            if predicate and fact.predicate.lower() != predicate.lower():
-                continue
-            if needle and needle not in f"{fact.subject} {fact.predicate} {fact.object}".lower():
-                continue
-            out.append(fact)
-        out.sort(key=lambda f: -f.relevance)
-        return out[:limit]
+        with self.timer.stage("query"), self._facts_lock:
+            out = []
+            needle = (text or "").lower()
+            subject_l = subject.lower() if subject else None
+            predicate_l = predicate.lower() if predicate else None
+            for fact in self.facts.values():
+                cached = self._lower.get(fact.id)
+                if cached is None:  # fact inserted behind the store's back
+                    self._index(fact)
+                    cached = self._lower[fact.id]
+                sub_l, pred_l, haystack = cached
+                if subject_l and sub_l != subject_l:
+                    continue
+                if predicate_l and pred_l != predicate_l:
+                    continue
+                if needle and needle not in haystack:
+                    continue
+                out.append(fact)
+            # Deterministic under relevance ties (created_at, then id) so the
+            # limit truncation below is stable run to run.
+            out.sort(key=lambda f: (-f.relevance, f.created_at, f.id))
+            return out[:limit]
 
     def decay_facts(self) -> int:
-        """One decay tick: relevance *= decayFactor; prune below threshold."""
+        """One decay tick: relevance *= decayFactor; prune below threshold.
+
+        Skips the full-store serialization when the tick was an empty delta —
+        nothing decayed (empty store, or decayFactor 1.0) and nothing pruned."""
         factor = self.config["decayFactor"]
         threshold = self.config["pruneBelowRelevance"]
-        dead = []
-        for fact in self.facts.values():
-            fact.relevance *= factor
-            if fact.relevance < threshold:
-                dead.append(fact.id)
-        for fid in dead:
-            del self.facts[fid]
-        if dead or self.facts:
-            self._commit()
-        return len(dead)
+        with self._facts_lock:
+            dead = []
+            for fact in self.facts.values():
+                fact.relevance *= factor
+                if fact.relevance < threshold:
+                    dead.append(fact.id)
+            for fid in dead:
+                self._unindex(self.facts[fid])
+                del self.facts[fid]
+            if dead or (self.facts and factor != 1.0):
+                self._commit()
+            return len(dead)
 
     def _prune(self) -> None:
         cap = self.config["maxFacts"]
@@ -145,7 +258,15 @@ class FactStore:
             return
         ordered = sorted(self.facts.values(), key=lambda f: f.relevance)
         for fact in ordered[: len(self.facts) - cap]:
+            self._unindex(fact)
             del self.facts[fact.id]
+
+    def snapshot(self) -> list[Fact]:
+        """Locked point-in-time list of live facts — what maintenance ticks
+        iterate instead of the live dict, which the gateway thread mutates
+        mid-iteration otherwise."""
+        with self._facts_lock:
+            return list(self.facts.values())
 
     def count(self) -> int:
         return len(self.facts)

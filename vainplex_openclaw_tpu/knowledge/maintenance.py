@@ -7,23 +7,27 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..utils.stage_timer import StageTimer
+
 
 class Maintenance:
     def __init__(self, fact_store, embeddings, logger,
                  decay_hours: float = 24.0, sync_minutes: float = 30.0,
-                 wall_timers: bool = True):
+                 wall_timers: bool = True, timer: Optional[StageTimer] = None):
         self.fact_store = fact_store
         self.embeddings = embeddings
         self.logger = logger
         self.decay_hours = decay_hours
         self.sync_minutes = sync_minutes
         self.wall_timers = wall_timers
+        self.timer = timer if timer is not None else StageTimer()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._synced_ids: set = set()
 
     def run_decay(self) -> int:
-        pruned = self.fact_store.decay_facts()
+        with self.timer.stage("decay"):
+            pruned = self.fact_store.decay_facts()
         if pruned:
             self.logger.info(f"decay pruned {pruned} stale facts")
         return pruned
@@ -32,8 +36,11 @@ class Maintenance:
         if self.embeddings is None or not self.embeddings.enabled():
             return 0
         # Reconcile prunes first (decay / maxFacts cap) so the index never
-        # keeps serving facts the store has deleted.
-        current = set(self.fact_store.facts.keys())
+        # keeps serving facts the store has deleted. Snapshot under the
+        # store lock: the gateway thread ingests concurrently, and iterating
+        # the live dict would die mid-sync on a resize.
+        facts_now = self.fact_store.snapshot()
+        current = {f.id for f in facts_now}
         dead = self._synced_ids - current
         failed_dead: set = set()
         if dead:
@@ -47,8 +54,7 @@ class Maintenance:
                 self.logger.warn(f"{len(dead)} pruned facts remain in the "
                                  "embeddings backend (no remove support)")
         self._synced_ids = (self._synced_ids & current) | failed_dead
-        pending = [f for f in self.fact_store.facts.values()
-                   if f.id not in self._synced_ids]
+        pending = [f for f in facts_now if f.id not in self._synced_ids]
         if not pending:
             return 0
         n = self.embeddings.sync(pending)

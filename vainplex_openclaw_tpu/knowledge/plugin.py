@@ -14,6 +14,7 @@ from typing import Callable, Optional
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand, PluginService
+from ..utils.stage_timer import StageTimer
 from .embeddings import create_embeddings
 from .entity_extractor import EntityExtractor
 from .fact_store import FactStore
@@ -73,6 +74,11 @@ class KnowledgeEnginePlugin:
         self.wall_timers = wall_timers
         self.http_post = http_post
         self.config: dict = {}
+        # One shared StageTimer across store / embeddings / maintenance: the
+        # serve-path breakdown (ingest, query, sync, search, decay, extract)
+        # reads as one attribution surface (ISSUE 2, mirroring the trace
+        # analyzer's stageMs).
+        self.timer = StageTimer()
         self.extractor: Optional[EntityExtractor] = None
         self.fact_store: Optional[FactStore] = None
         self.embeddings = None
@@ -91,15 +97,18 @@ class KnowledgeEnginePlugin:
         self.extractor = EntityExtractor(api.logger, clock=self.clock)
         self.fact_store = FactStore(workspace, self.config.get("storage"),
                                     api.logger, clock=self.clock,
-                                    wall_timers=self.wall_timers)
+                                    wall_timers=self.wall_timers,
+                                    timer=self.timer)
         kwargs = {"http_post": self.http_post} if self.http_post else {}
         self.embeddings = create_embeddings(self.config.get("embeddings"),
-                                            api.logger, **kwargs)
+                                            api.logger, timer=self.timer,
+                                            **kwargs)
         mcfg = self.config.get("maintenance", {})
         self.maintenance = Maintenance(self.fact_store, self.embeddings, api.logger,
                                        decay_hours=mcfg.get("decayHours", 24),
                                        sync_minutes=mcfg.get("syncMinutes", 30),
-                                       wall_timers=self.wall_timers)
+                                       wall_timers=self.wall_timers,
+                                       timer=self.timer)
         if self.config.get("llm", {}).get("enabled") and self.call_llm is not None:
             self.enhancer = KnowledgeLlmEnhancer(self.call_llm, api.logger,
                                                  self.config["llm"].get("batchSize", 3))
@@ -152,7 +161,9 @@ class KnowledgeEnginePlugin:
             self._ensure_loaded()
             min_importance = self.config.get("extraction", {}).get("minImportance", 0.5)
             predicate = self.config.get("extraction", {}).get("mentionPredicate", "mentioned")
-            for entity in self.extractor.extract(content):
+            with self.timer.stage("extract"):
+                entities = self.extractor.extract(content)
+            for entity in entities:
                 if entity.importance < min_importance:
                     continue
                 self.fact_store.add_fact("conversation", predicate, entity.value,
@@ -175,6 +186,30 @@ class KnowledgeEnginePlugin:
 
     # ── status ───────────────────────────────────────────────────────
 
+    def stats(self) -> dict:
+        """Machine-readable serve-path stats: counts plus the shared
+        StageTimer breakdown (same shape discipline as the trace analyzer's
+        ``runStats.stageMs``) so a slow knowledge path arrives
+        pre-attributed to ingest / query / sync / search / decay."""
+        self._ensure_loaded()
+        out = {
+            "facts": self.fact_store.count(),
+            "embedded": (self.embeddings.count()
+                         if hasattr(self.embeddings, "count") else None),
+            "stageMs": self.timer.stages_ms(),
+            "stageCounts": self.timer.counts(),
+        }
+        if hasattr(self.embeddings, "query_cache_hits"):
+            out["queryCache"] = {"hits": self.embeddings.query_cache_hits,
+                                 "misses": self.embeddings.query_cache_misses}
+        return out
+
+    def _stage_line(self) -> str:
+        stage_ms = self.timer.stages_ms()
+        if not stage_ms:
+            return ""
+        return "stages: " + " ".join(f"{k}={v:.1f}ms" for k, v in stage_ms.items())
+
     def status_text(self, args: str = "") -> str:
         self._ensure_loaded()
         query = args.strip()
@@ -187,8 +222,13 @@ class KnowledgeEnginePlugin:
                 lines.append("  semantic:")
                 lines += [f"    {r['document']} ({r['score']:.2f})"
                           for r in self.embeddings.search(query, k=3)]
+            stage = self._stage_line()
+            if stage:
+                lines.append(f"  {stage}")
             return "\n".join(lines)
         n_vec = self.embeddings.count() if hasattr(self.embeddings, "count") else "n/a"
-        return (f"📚 knowledge: {self.fact_store.count()} facts, "
+        base = (f"📚 knowledge: {self.fact_store.count()} facts, "
                 f"{n_vec} embedded "
                 f"(backend={self.config.get('embeddings', {}).get('backend')})")
+        stage = self._stage_line()
+        return f"{base}\n  {stage}" if stage else base

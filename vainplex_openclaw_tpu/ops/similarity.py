@@ -39,8 +39,10 @@ LEVENSHTEIN_CAP = 500
 TRACE_COUNTS = {"jaccard": 0, "levenshtein": 0}
 
 
-def _pow2_bucket(n: int) -> int:
-    """Smallest power of two ≥ n (n ≥ 1)."""
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two ≥ n (n ≥ 1). Public: the knowledge engine's
+    embedding path buckets its batch dim through the same policy so every
+    jitted batch kernel in the repo shares one shape discipline."""
     return 1 << max(n - 1, 0).bit_length()
 
 
@@ -170,9 +172,9 @@ def jaccard_from_rows(Xa: np.ndarray, Xb: Optional[np.ndarray] = None,
         # Bucket the batch dims to powers of two: zero-row padding changes
         # nothing inside the real block (sliced right back out) and caps
         # the jit cache at O(log N) shapes instead of one compile per N.
-        Xa_p = _pad_rows(Xa, _pow2_bucket(na))
-        Xb_p = Xa_p if Xb is None and _pow2_bucket(na) == _pow2_bucket(nb) \
-            else _pad_rows(B, _pow2_bucket(nb))
+        Xa_p = pad_rows(Xa, pow2_bucket(na))
+        Xb_p = Xa_p if Xb is None and pow2_bucket(na) == pow2_bucket(nb) \
+            else pad_rows(B, pow2_bucket(nb))
         return np.asarray(_jaccard_matrix_jax(Xa_p, Xb_p))[:na, :nb]
     # numpy formulation — identical math, and the safe default in processes
     # that never pinned a jax platform (see _jax_enabled)
@@ -184,7 +186,8 @@ def jaccard_from_rows(Xa: np.ndarray, Xb: Optional[np.ndarray] = None,
     return sim
 
 
-def _pad_rows(X: np.ndarray, n: int) -> np.ndarray:
+def pad_rows(X: np.ndarray, n: int) -> np.ndarray:
+    """Zero-pad a row batch up to ``n`` rows (no-op at exactly ``n``)."""
     if len(X) == n:
         return X
     out = np.zeros((n, X.shape[1]), dtype=X.dtype)
@@ -356,9 +359,9 @@ def batch_levenshtein_ratio(pairs: list[tuple[str, str]], length: int = 128,
     len_a = (A > 0).sum(axis=1).astype(np.int32)
     len_b = (B > 0).sum(axis=1).astype(np.int32)
     if use_jax:
-        bucket = _pow2_bucket(len(pairs))
+        bucket = pow2_bucket(len(pairs))
         dist = np.asarray(_batch_levenshtein_jax(
-            _pad_rows(A, bucket), _pad_rows(B, bucket),
+            pad_rows(A, bucket), pad_rows(B, bucket),
             _pad_vec(len_a, bucket), _pad_vec(len_b, bucket)))[:len(pairs)]
     else:
         dist = _batch_levenshtein_numpy(A, B, len_a, len_b)

@@ -9,6 +9,7 @@ nothing here may tax the path it is measuring.
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Callable
@@ -19,12 +20,17 @@ class StageTimer:
 
     Re-entering a stage name accumulates (a stage split across code paths
     still reads as one line in the breakdown). ``clock`` is injectable for
-    tests; it must be a monotonic seconds counter.
+    tests; it must be a monotonic seconds counter. Accumulation is guarded
+    by a lock: the knowledge plugin shares one timer between the serve
+    thread and the maintenance daemon, and an unguarded read-modify-write
+    would silently drop updates from the attribution it exists to provide.
     """
 
     def __init__(self, clock: Callable[[], float] = time.perf_counter):
         self._clock = clock
+        self._lock = threading.Lock()
         self._ms: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
 
     @contextmanager
     def stage(self, name: str):
@@ -35,11 +41,22 @@ class StageTimer:
             self.add(name, (self._clock() - t0) * 1000.0)
 
     def add(self, name: str, ms: float) -> None:
-        self._ms[name] = self._ms.get(name, 0.0) + ms
+        with self._lock:
+            self._ms[name] = self._ms.get(name, 0.0) + ms
+            self._counts[name] = self._counts.get(name, 0) + 1
 
     def stages_ms(self, precision: int = 2) -> dict:
         """Fresh {stage: rounded ms} dict in stage-entry order."""
-        return {k: round(v, precision) for k, v in self._ms.items()}
+        with self._lock:
+            return {k: round(v, precision) for k, v in self._ms.items()}
+
+    def counts(self) -> dict:
+        """Fresh {stage: entries} dict in stage-entry order — accumulated ms
+        alone can't distinguish one slow call from many fast ones (the
+        knowledge engine's ingest/search attribution needs per-call cost)."""
+        with self._lock:
+            return dict(self._counts)
 
     def total_ms(self) -> float:
-        return sum(self._ms.values())
+        with self._lock:
+            return sum(self._ms.values())
