@@ -221,23 +221,21 @@ def bench_consumer_read(n: int = 50_000) -> dict:
             "unit": "msg/s", "vs_baseline": round(rate / 20_000.0, 2)}
 
 
-def bench_policy_eval(n: int = 5_000) -> dict:
-    """Full governance pipeline latency per before_tool_call (reference
-    budget: <5 ms for 10+ regex policies, governance/README.md:624)."""
+def policy_eval_stage_records(stage_ms: dict) -> list[dict]:
+    """One machine-readable record per governance pipeline stage (ISSUE 3 —
+    same discipline as the trace-analyzer and knowledge stage lines): an
+    enforcement latency regression arrives pre-attributed to enrich /
+    frequency / risk / evaluate / trust / audit."""
+    return _stage_records("policy_eval_stage_ms", stage_ms)
+
+
+def _bench_policy_eval(metric: str, user_policies: list, n: int) -> dict:
     import os
     import tempfile
 
     from vainplex_openclaw_tpu.core import Gateway
     from vainplex_openclaw_tpu.governance import GovernancePlugin
 
-    user_policies = [
-        {"id": f"p{i}", "priority": 50 + i, "scope": {"hooks": ["before_tool_call"]},
-         "rules": [{"action": "audit",
-                    "conditions": [{"type": "tool", "tools": ["exec"],
-                                    "params": {"command":
-                                               {"matches": f"pattern-{i}-[a-z]+"}}}]}]}
-        for i in range(10)
-    ]
     saved_home = os.environ.get("OPENCLAW_HOME")
     try:
         with tempfile.TemporaryDirectory() as ws:
@@ -252,6 +250,7 @@ def bench_policy_eval(n: int = 5_000) -> dict:
             for i in range(n):
                 gw.before_tool_call("exec", {"command": f"ls -la /tmp/dir{i}"}, ctx)
             dt_ms = (time.perf_counter() - t0) * 1000.0 / n
+            stage_ms = plugin.engine.timer.stages_ms()
             gw.stop()
     finally:
         # An exception mid-bench must not leak a deleted-tempdir OPENCLAW_HOME
@@ -261,8 +260,47 @@ def bench_policy_eval(n: int = 5_000) -> dict:
         else:
             os.environ["OPENCLAW_HOME"] = saved_home
     baseline_ms = 5.0
-    return {"metric": "policy_eval_latency", "value": round(dt_ms, 4), "unit": "ms",
-            "vs_baseline": round(baseline_ms / dt_ms, 1)}  # >1 = faster than budget
+    return {"metric": metric, "value": round(dt_ms, 4), "unit": "ms",
+            "vs_baseline": round(baseline_ms / dt_ms, 1),  # >1 = faster than budget
+            "stage_ms": stage_ms}
+
+
+def bench_policy_eval(n: int = 5_000) -> dict:
+    """Full governance pipeline latency per before_tool_call (reference
+    budget: <5 ms for 10+ regex policies, governance/README.md:624). The ten
+    user policies regex-gate on the exec command (the compiled planner folds
+    them into one prefilter bank); after the first minute's budget the
+    builtin rate limiter denies, so the steady state also exercises the
+    trust-violation + audit deny path."""
+    user_policies = [
+        {"id": f"p{i}", "priority": 50 + i, "scope": {"hooks": ["before_tool_call"]},
+         "rules": [{"action": "audit",
+                    "conditions": [{"type": "tool", "tools": ["exec"],
+                                    "params": {"command":
+                                               {"matches": f"pattern-{i}-[a-z]+"}}}]}]}
+        for i in range(10)
+    ]
+    return _bench_policy_eval("policy_eval_latency", user_policies, n)
+
+
+def bench_policy_eval_deny(n: int = 5_000) -> dict:
+    """Deny-path variant (ISSUE 3): a top-priority user deny policy matches
+    every call, so 100% of evaluations pay policy match + trust violation +
+    session signal + audit regardless of rate-limiter state."""
+    user_policies = [
+        {"id": "bench-deny", "priority": 500,
+         "scope": {"hooks": ["before_tool_call"]},
+         "rules": [{"id": "always", "conditions": [{"type": "tool", "name": "exec"}],
+                    "effect": {"action": "deny", "reason": "bench deny path"}}]},
+    ] + [
+        {"id": f"p{i}", "priority": 50 + i, "scope": {"hooks": ["before_tool_call"]},
+         "rules": [{"action": "audit",
+                    "conditions": [{"type": "tool", "tools": ["exec"],
+                                    "params": {"command":
+                                               {"matches": f"pattern-{i}-[a-z]+"}}}]}]}
+        for i in range(10)
+    ]
+    return _bench_policy_eval("policy_eval_latency_deny", user_policies, n)
 
 
 # Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
@@ -858,12 +896,18 @@ if __name__ == "__main__":
     except Exception as exc:  # noqa: BLE001 — diagnosable, not fatal
         print(f"force-cpu pin failed: {exc}", file=sys.stderr)
     for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval,
-               bench_knowledge_ingest, bench_knowledge_search):
+               bench_policy_eval_deny, bench_knowledge_ingest,
+               bench_knowledge_search):
         try:
             rec = fn()
             print(f"secondary: {json.dumps(rec)}", file=sys.stderr)
             if rec.get("metric", "").startswith("knowledge_"):
                 for srec in knowledge_stage_records(rec.get("stage_ms")):
+                    print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+            elif rec.get("metric") == "policy_eval_latency":
+                # the deny variant's breakdown rides inline in its own record
+                # (two stage families with one name would be ambiguous)
+                for srec in policy_eval_stage_records(rec.get("stage_ms")):
                     print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — secondaries must not kill the headline
             print(f"secondary failed: {exc}", file=sys.stderr)

@@ -225,6 +225,27 @@ class TestAuditTrail:
         rec = self.rec(trail)
         assert rec["verdict"] == "deny"  # recorded despite scrub crash
 
+    def test_flush_survives_external_rotation(self, tmp_path):
+        # The persistent per-day handle must not keep writing to an unlinked
+        # inode after logrotate/rm recreates or removes today's file.
+        trail = self.make(tmp_path)
+        self.rec(trail)
+        trail.flush()
+        audit_dir = tmp_path / "governance" / "audit"
+        day_file = next(iter(audit_dir.glob("*.jsonl")))
+        day_file.unlink()  # rotation
+        self.rec(trail)
+        trail.flush()
+        recreated = list(audit_dir.glob("*.jsonl"))
+        assert recreated and len(recreated[0].read_text().splitlines()) == 1
+        day_file2 = recreated[0]
+        day_file2.rename(audit_dir / "rotated.old")  # rename-style rotation
+        (audit_dir / "rotated.old").rename(audit_dir / "rotated.bak")
+        self.rec(trail)
+        trail.flush()
+        fresh = [f for f in audit_dir.glob("*.jsonl")]
+        assert fresh and len(fresh[0].read_text().splitlines()) == 1
+
 
 class TestCrossAgent:
     CHILD = "agent:main:subagent:forge:abc"

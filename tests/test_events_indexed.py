@@ -1,0 +1,227 @@
+"""Equivalence tests for the indexed event transports (ISSUE 3).
+
+`FileTransport` now serves fetch/count/seq-recovery from a per-file
+(mtime, size, offset, seq, count) incremental index instead of re-reading
+and re-parsing every daily JSONL per call; `MemoryTransport.fetch` pre-splits
+subject patterns, memoizes per-subject verdicts, and binary-searches the
+consumed prefix. Each is pinned here against a literal re-parse oracle (the
+seed's implementation) across randomized publish/fetch interleavings,
+foreign-writer appends, garbage lines, day rollovers, and truncations.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+
+from vainplex_openclaw_tpu.events.envelope import build_envelope
+from vainplex_openclaw_tpu.events.transport import (
+    FileTransport,
+    MemoryTransport,
+    _last_seq_in_file,
+    _subject_matches,
+)
+
+from helpers import FakeClock
+
+SUBJECTS = ["claw.main.msg", "claw.main.tool", "claw.forge.msg",
+            "claw.forge.run.started", "sys.health"]
+FILTERS = [">", "", "claw.>", "claw.*.msg", "claw.main.*", "claw.main.msg",
+           "*.main.msg", "claw.*.run.started", "nope.*", "claw", "*"]
+
+
+def make_event(i: int, agent: str = "main"):
+    return build_envelope("message.in.received", {"i": i},
+                          {"agent_id": agent, "session_key": f"agent:{agent}:s",
+                           "message_id": f"m{i}"})
+
+
+def oracle_file_fetch(root, subject_filter=">", start_seq=0, batch=None):
+    """The seed FileTransport.fetch, verbatim: full re-read + re-parse."""
+    out = []
+    for f in sorted(Path(root).glob("*.jsonl")):
+        for line in f.read_text(encoding="utf-8").splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if (rec.get("seq") or 0) <= start_seq:
+                continue
+            if not _subject_matches(subject_filter, rec.get("subject", "")):
+                continue
+            out.append(rec)
+            if batch is not None and len(out) >= batch:
+                return out
+    return out
+
+
+def fetched_keys(events):
+    return [(e.seq, e.id, e.payload) for e in events]
+
+
+def oracle_keys(records):
+    return [(r.get("seq"), r.get("id"), r.get("payload")) for r in records]
+
+
+class TestFileTransportIndexEquivalence:
+    def test_randomized_interleaving_vs_reparse_oracle(self, tmp_path):
+        rng = random.Random(0xD15C)
+        clock = FakeClock()
+        transport = FileTransport(tmp_path, clock=clock)
+        published = 0
+        for round_no in range(12):
+            for _ in range(rng.randint(1, 30)):
+                published += 1
+                subject = rng.choice(SUBJECTS)
+                transport.publish(subject, make_event(published))
+                if rng.random() < 0.2:
+                    clock.advance(rng.choice([3600.0, 86400.0]))
+            if rng.random() < 0.3:
+                # foreign writer appends directly to a daily file: a valid
+                # record, a garbage line, and a blank
+                files = sorted(tmp_path.glob("*.jsonl"))
+                target = rng.choice(files)
+                with target.open("a", encoding="utf-8") as fh:
+                    fh.write(json.dumps({"subject": "claw.other.msg",
+                                         "seq": 0, "foreign": True}) + "\n")
+                    fh.write("{broken json\n")
+                    fh.write("\n")
+            for filt in rng.sample(FILTERS, k=4):
+                start = rng.choice([0, 1, published // 2, published])
+                batch = rng.choice([None, 1, 7])
+                got = fetched_keys(transport.fetch(filt, start_seq=start, batch=batch))
+                want = oracle_keys(oracle_file_fetch(tmp_path, filt, start, batch))
+                assert got == want, (round_no, filt, start, batch)
+            assert transport.event_count() == len(oracle_file_fetch(tmp_path))
+
+    def test_truncated_file_reparses(self, tmp_path):
+        clock = FakeClock()
+        transport = FileTransport(tmp_path, clock=clock)
+        for i in range(20):
+            transport.publish("claw.main.msg", make_event(i + 1))
+        assert transport.event_count() == 20
+        f = next(iter(sorted(tmp_path.glob("*.jsonl"))))
+        lines = f.read_text().splitlines(keepends=True)
+        f.write_text("".join(lines[:5]))  # rotation/truncation
+        assert transport.event_count() == 5
+        assert fetched_keys(transport.fetch()) == \
+            oracle_keys(oracle_file_fetch(tmp_path))
+
+    def test_partial_trailing_line_deferred_until_complete(self, tmp_path):
+        clock = FakeClock()
+        transport = FileTransport(tmp_path, clock=clock)
+        transport.publish("claw.main.msg", make_event(1))
+        f = next(iter(tmp_path.glob("*.jsonl")))
+        foreign = make_event(99)
+        foreign.seq = 99
+        half = json.dumps({"subject": "claw.main.msg", **foreign.to_dict()})
+        with f.open("a", encoding="utf-8") as fh:
+            fh.write(half[: len(half) // 2])
+        assert [e.seq for e in transport.fetch()] == [1]  # half line invisible
+        with f.open("a", encoding="utf-8") as fh:
+            fh.write(half[len(half) // 2:] + "\n")
+        assert [e.seq for e in transport.fetch()] == [1, 99]
+
+    def test_seq_recovery_matches_full_parse(self, tmp_path):
+        rng = random.Random(0x5EC)
+        clock = FakeClock()
+        transport = FileTransport(tmp_path, clock=clock)
+        for i in range(60):
+            transport.publish(rng.choice(SUBJECTS), make_event(i + 1))
+            if rng.random() < 0.1:
+                clock.advance(86400.0)
+        # trailing garbage after the last record must not defeat recovery
+        f = sorted(tmp_path.glob("*.jsonl"))[-1]
+        with f.open("a", encoding="utf-8") as fh:
+            fh.write("not json at all\n\n{]\n")
+        full_parse_max = max(
+            (r.get("seq") or 0) for r in oracle_file_fetch(tmp_path))
+        recovered = FileTransport(tmp_path, clock=clock)
+        assert recovered.last_sequence() == full_parse_max == 60
+        nxt = make_event(61)
+        recovered.publish("claw.main.msg", nxt)
+        assert nxt.seq == 61
+
+    def test_cache_eviction_streams_from_disk(self, tmp_path, monkeypatch):
+        clock = FakeClock()
+        transport = FileTransport(tmp_path, clock=clock)
+        monkeypatch.setattr(FileTransport, "MAX_CACHED_RECORDS", 10)
+        for i in range(30):
+            transport.publish(SUBJECTS[i % len(SUBJECTS)], make_event(i + 1))
+            if i % 10 == 9:
+                clock.advance(86400.0)  # three daily files
+        got = fetched_keys(transport.fetch())
+        assert got == oracle_keys(oracle_file_fetch(tmp_path))
+        # old files were evicted to offset-only entries, newest stays cached
+        entries = [e for _, e in transport._refresh_index()]
+        assert any(e.records is None for e in entries[:-1])
+        assert entries[-1].records is not None
+        assert transport.event_count() == 30
+        # filtered + seq'd fetch over the streamed path still matches oracle
+        for filt in ("claw.>", "claw.main.msg"):
+            got = fetched_keys(transport.fetch(filt, start_seq=3))
+            assert got == oracle_keys(oracle_file_fetch(tmp_path, filt, 3))
+
+    def test_recovery_tail_takes_block_max_with_interleaved_writers(self, tmp_path):
+        # Two transports sharing a root keep independent counters, so seqs
+        # in the tail can be locally non-monotone; recovery must take the
+        # block max, not the last line's seq.
+        clock = FakeClock()
+        a = FileTransport(tmp_path, clock=clock)
+        for i in range(10):
+            a.publish("claw.main.msg", make_event(i + 1))  # seqs 1..10
+        b = FileTransport(tmp_path, clock=clock)  # recovers 10
+        for i in range(5):
+            b.publish("claw.main.msg", make_event(100 + i))  # seqs 11..15
+        a.publish("claw.main.msg", make_event(200))  # a's counter: seq 11 (stale)
+        assert FileTransport(tmp_path, clock=clock).last_sequence() == 15
+
+    def test_recovery_reads_tails_not_whole_files(self, tmp_path):
+        # one large file: recovery must find the tail seq even when the last
+        # physical block holds many lines, and must survive an empty file
+        clock = FakeClock()
+        transport = FileTransport(tmp_path, clock=clock)
+        for i in range(2000):
+            transport.publish("claw.main.msg", make_event(i + 1))
+        (tmp_path / "0000-empty.jsonl").write_text("")
+        f = sorted(tmp_path.glob("*.jsonl"))[-1]
+        assert _last_seq_in_file(f, block=256) == 2000
+        assert FileTransport(tmp_path, clock=clock).last_sequence() == 2000
+
+
+class TestMemoryTransportFetchEquivalence:
+    def test_filter_and_seq_vs_oracle(self):
+        rng = random.Random(0xA11)
+        transport = MemoryTransport(max_msgs=500)
+        log = []
+        for i in range(400):
+            subject = rng.choice(SUBJECTS)
+            ev = make_event(i)
+            transport.publish(subject, ev)
+            log.append((subject, ev))
+        for filt in FILTERS:
+            for start in (0, -3, 1, 200, 399, 400, 1000):
+                for batch in (None, 1, 5):
+                    got = [e.seq for e in transport.fetch(filt, start_seq=start,
+                                                          batch=batch)]
+                    want = []
+                    for subject, ev in log:  # seed semantics, verbatim
+                        if ev.seq is not None and ev.seq <= start:
+                            continue
+                        if not _subject_matches(filt, subject):
+                            continue
+                        want.append(ev.seq)
+                        if batch is not None and len(want) >= batch:
+                            break
+                    assert got == want, (filt, start, batch)
+
+    def test_after_retention_eviction(self):
+        transport = MemoryTransport(max_msgs=50)
+        for i in range(120):
+            transport.publish("claw.main.msg", make_event(i))
+        seqs = [e.seq for e in transport.fetch(start_seq=90)]
+        assert seqs == list(range(91, 121))
+        assert [e.seq for e in transport.fetch()] == list(range(71, 121))

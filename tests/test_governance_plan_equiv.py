@@ -1,0 +1,547 @@
+"""Randomized equivalence: compiled policy plans vs the interpretive oracle.
+
+ISSUE 3 contract: the planner (governance/policy_plan.py) may be faster than
+the dict-walking interpreter, never different. These property tests pin the
+compiled path to `evaluate_conditions_interp` / `PolicyEvaluator` across
+randomized policy matrices (scopes × trust tiers × all 8 condition types,
+including `any`/`not` composites and prefilter-bank shapes) and randomized
+contexts: verdict action, reason, matched (policy_id, rule_id) sequence,
+effects, and derived controls must be identical. A full-engine pass runs the
+same call sequence through a compiled and an interp engine and compares
+verdicts AND audit records. The audit redactor's combined-pattern fast path
+is pinned to the sequential oracle the same way.
+"""
+
+from __future__ import annotations
+
+import random
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.governance.audit import (
+    create_redactor,
+    create_redactor_seq,
+    derive_controls,
+)
+from vainplex_openclaw_tpu.governance.conditions import (
+    create_condition_evaluators,
+    evaluate_conditions,
+    evaluate_conditions_interp,
+)
+from vainplex_openclaw_tpu.governance.engine import GovernanceEngine
+from vainplex_openclaw_tpu.governance.frequency import FrequencyTracker
+from vainplex_openclaw_tpu.governance.policy_evaluator import PolicyEvaluator
+from vainplex_openclaw_tpu.governance.policy_loader import (
+    build_policy_index,
+    policies_for,
+)
+from vainplex_openclaw_tpu.governance.policy_plan import (
+    PolicyPlanner,
+    evaluate_plan,
+)
+from vainplex_openclaw_tpu.governance.types import (
+    ConditionDeps,
+    EvalTrust,
+    EvaluationContext,
+    RiskAssessment,
+    TrustSnapshot,
+)
+from vainplex_openclaw_tpu.governance.util import TimeContext, score_to_tier
+
+from helpers import FakeClock
+
+EVALUATORS = create_condition_evaluators()
+
+AGENTS = ["main", "forge", "scout", "ops"]
+TOOLS = ["exec", "read", "write", "gateway", "deploy_tool", None]
+CHANNELS = [None, "dev", "prod", "general"]
+HOOKS = ["before_tool_call", "message_sending"]
+PARAM_KEYS = ["command", "path", "file_path", "host"]
+COMMANDS = [
+    "ls -la /tmp", "cat secrets.env", "git push origin main",
+    "docker push registry/app", "kubectl get pods", "rm -rf build",
+    "pattern-3-abc", "scp key.pem host:", "",
+]
+PATTERNS = [
+    r"pattern-\d-[a-z]+", r"git push.*main", r"docker\s+push", r"\.env",
+    r"kubectl .*", r"^ls", r"secret", "(unclosed", r"rm -rf \S+",
+]
+TIERS = ["untrusted", "restricted", "standard", "trusted", "elevated"]
+RISKS = ["low", "medium", "high", "critical"]
+TIME_WINDOWS = {
+    "night": {"start": "23:00", "end": "06:00"},
+    "lunch": {"start": "12:00", "end": "13:00", "days": [1, 2, 3, 4, 5]},
+}
+
+
+def rand_matcher(rng: random.Random) -> dict:
+    def one(kind: str) -> dict:
+        if kind == "equals":
+            return {"equals": rng.choice(COMMANDS + [42, None])}
+        if kind == "contains":
+            return {"contains": rng.choice(["push", "secret", "tmp", "xyz"])}
+        if kind == "matches":
+            return {"matches": rng.choice(PATTERNS)}
+        if kind == "startsWith":
+            return {"startsWith": rng.choice(["ls", "git", "docker", "/"])}
+        return {"in": rng.sample(COMMANDS, k=rng.randint(1, 3))}
+
+    kinds = ["equals", "contains", "matches", "startsWith", "in"]
+    matcher = one(rng.choice(kinds))
+    # Multi-key matchers: only the highest-precedence key is consulted by
+    # _match_param — a shadowed "matches" must not become a prefilter-bank
+    # requirement (the code-review repro for the bank-soundness bug).
+    if rng.random() < 0.25:
+        matcher = {**one(rng.choice(kinds)), **matcher}
+    return matcher
+
+
+def rand_condition(rng: random.Random, depth: int = 0) -> dict:
+    kinds = ["tool", "time", "context", "agent", "risk", "frequency"]
+    if depth == 0:
+        kinds += ["any", "not", "bogus"]
+    kind = rng.choice(kinds)
+    if kind == "tool":
+        c: dict = {"type": "tool"}
+        if rng.random() < 0.7:
+            c["name"] = rng.choice([
+                "exec", "read", ["exec", "write"], "ex*", ["dep*", "read"], "?ead"])
+        if rng.random() < 0.7:
+            c["params"] = {k: rand_matcher(rng)
+                          for k in rng.sample(PARAM_KEYS, k=rng.randint(1, 2))}
+        return c
+    if kind == "time":
+        if rng.random() < 0.3:
+            return {"type": "time",
+                    "window": rng.choice(["night", "lunch", "missing"])}
+        c = {"type": "time"}
+        if rng.random() < 0.7:
+            c["after"] = rng.choice(["08:00", "22:30", "25:99", "bad"])
+        if rng.random() < 0.7:
+            c["before"] = rng.choice(["18:00", "06:00", "bad"])
+        if rng.random() < 0.4:
+            c["days"] = rng.sample(range(7), k=rng.randint(1, 3))
+        return c
+    if kind == "context":
+        c = {"type": "context"}
+        if rng.random() < 0.4:
+            c["messageContains"] = rng.choice(
+                [r"deploy", ["secret", r"\d{3}"], "(unclosed"])
+        if rng.random() < 0.3:
+            c["conversationContains"] = rng.choice(["urgent", ["prod", "push"]])
+        if rng.random() < 0.3:
+            c["hasMetadata"] = rng.choice(["priority", ["a", "b"]])
+        if rng.random() < 0.3:
+            c["channel"] = rng.choice(["dev", ["prod", "general"]])
+        if rng.random() < 0.3:
+            c["sessionKey"] = rng.choice(["agent:*", "agent:forge*", "nope"])
+        return c
+    if kind == "agent":
+        c = {"type": "agent"}
+        if rng.random() < 0.5:
+            c["id"] = rng.choice(["main", ["forge", "scout"], "m*", "*"])
+        if rng.random() < 0.5:
+            c["trustTier"] = rng.choice([rng.choice(TIERS),
+                                         rng.sample(TIERS, k=2)])
+        if rng.random() < 0.4:
+            c["minScore"] = rng.randint(0, 100)
+        if rng.random() < 0.4:
+            c["maxScore"] = rng.randint(0, 100)
+        return c
+    if kind == "risk":
+        c = {"type": "risk"}
+        if rng.random() < 0.7:
+            c["minRisk"] = rng.choice(RISKS + ["weird"])
+        if rng.random() < 0.7:
+            c["maxRisk"] = rng.choice(RISKS)
+        return c
+    if kind == "frequency":
+        return {"type": "frequency", "windowSeconds": rng.choice([30, 60]),
+                "maxCount": rng.randint(0, 5),
+                "scope": rng.choice(["agent", "session", "global"])}
+    if kind == "any":
+        if rng.random() < 0.4:
+            # prefilter-fusable shape: OR made only of single-param matchers
+            subs = [{"type": "tool",
+                     "params": {rng.choice(PARAM_KEYS): rand_matcher(rng)}}
+                    for _ in range(rng.randint(1, 4))]
+        else:
+            subs = [rand_condition(rng, depth + 1)
+                    for _ in range(rng.randint(1, 3))]
+        return {"type": "any", "conditions": subs}
+    if kind == "not":
+        if rng.random() < 0.15:
+            return {"type": "not"}
+        return {"type": "not", "condition": rand_condition(rng, depth + 1)}
+    return {"type": "bogus", "x": 1}
+
+
+def rand_policy(rng: random.Random, i: int) -> dict:
+    scope: dict = {}
+    if rng.random() < 0.4:
+        scope["agents"] = rng.sample(AGENTS, k=rng.randint(1, 2))
+    if rng.random() < 0.3:
+        scope["excludeAgents"] = rng.sample(AGENTS, k=1)
+    if rng.random() < 0.3:
+        scope["channels"] = rng.sample(["dev", "prod", "general"],
+                                       k=rng.randint(1, 2))
+    if rng.random() < 0.6:
+        scope["hooks"] = rng.sample(HOOKS, k=rng.randint(1, 2))
+    rules = []
+    for j in range(rng.randint(1, 3)):
+        rule: dict = {"id": f"r{j}",
+                      "conditions": [rand_condition(rng)
+                                     for _ in range(rng.randint(0, 3))]}
+        if rng.random() < 0.25:
+            rule["minTrust"] = rng.choice(TIERS + [""])
+        if rng.random() < 0.25:
+            rule["maxTrust"] = rng.choice(TIERS)
+        if rng.random() < 0.9:
+            rule["effect"] = {"action": rng.choice(["allow", "deny", "audit", "2fa"]),
+                              "reason": f"reason-{i}-{j}"}
+        rules.append(rule)
+    policy = {"id": f"pol{i}", "priority": rng.choice([0, 50, 50, 100, 150]),
+              "scope": scope, "rules": rules}
+    if rng.random() < 0.5:
+        policy["controls"] = rng.sample(["A.8.11", "A.5.24", "A.8.6", "A.7.1"],
+                                        k=rng.randint(1, 2))
+    # Some policies gate every rule on the same param regex → bank members.
+    if rng.random() < 0.35:
+        pat = rng.choice([p for p in PATTERNS if p != "(unclosed"])
+        policy["rules"] = [{"id": "r0",
+                            "conditions": [{"type": "tool",
+                                            "params": {"command": {"matches": pat}}}],
+                            "effect": {"action": rng.choice(["audit", "deny"]),
+                                       "reason": f"bank-{i}"}}]
+    return policy
+
+
+def rand_ctx(rng: random.Random) -> EvaluationContext:
+    agent = rng.choice(AGENTS + ["stranger"])
+    agent_score = rng.uniform(0, 100)
+    session_score = rng.uniform(0, 100)
+    params = rng.choice([
+        None, {},
+        {"command": rng.choice(COMMANDS)},
+        {"command": rng.choice(COMMANDS), "host": rng.choice(["sandbox", "prod-1"])},
+        {"path": "secrets/creds.env"},
+        {"file_path": 42},
+    ])
+    return EvaluationContext(
+        agent_id=agent,
+        session_key=f"agent:{agent}:s{rng.randint(0, 2)}",
+        hook=rng.choice(HOOKS),
+        trust=EvalTrust(
+            agent=TrustSnapshot(agent_score, score_to_tier(agent_score)),
+            session=TrustSnapshot(session_score, score_to_tier(session_score))),
+        time=TimeContext(hour=rng.randint(0, 23), minute=rng.randint(0, 59),
+                         day_of_week=rng.randint(0, 6), date="2026-08-01"),
+        tool_name=rng.choice(TOOLS),
+        tool_params=params,
+        message_content=rng.choice([None, "", "please deploy to prod",
+                                    "the secret is 123"]),
+        message_to=rng.choice([None, "user@ext"]),
+        channel=rng.choice(CHANNELS),
+        conversation_context=rng.choice([[], ["urgent prod push", "ok"]]),
+        metadata=rng.choice([{}, {"priority": 1}, {"a": 1, "b": 2}]),
+    )
+
+
+def result_key(result):
+    return (result.action, result.reason, result.audit_only,
+            [(m.policy_id, m.rule_id, m.effect, m.controls)
+             for m in result.matches])
+
+
+class TestPlannerOracleEquivalence:
+    def test_randomized_policy_matrix(self):
+        rng = random.Random(0xC0FFEE)
+        evaluator = PolicyEvaluator()
+        clock = FakeClock()
+        for round_no in range(40):
+            policies = [rand_policy(rng, i) for i in range(rng.randint(1, 8))]
+            index = build_policy_index(policies)
+            planner = PolicyPlanner(index, TIME_WINDOWS)
+            tracker = FrequencyTracker(clock=clock)
+            for _ in range(rng.randint(0, 6)):
+                tracker.record(rng.choice(AGENTS), f"agent:{rng.choice(AGENTS)}:s0")
+            for _ in range(12):
+                ctx = rand_ctx(rng)
+                risk = RiskAssessment(level=rng.choice(RISKS),
+                                      score=rng.randint(0, 100), factors=[])
+                deps = ConditionDeps(regex_cache={}, time_windows=TIME_WINDOWS,
+                                     risk=risk, frequency_tracker=tracker,
+                                     evaluators=EVALUATORS)
+                interp = evaluator.evaluate(
+                    ctx, policies_for(index, ctx.agent_id, ctx.hook), deps)
+                plan, inherited = planner.plan_for(ctx.agent_id, ctx.hook)
+                compiled = evaluate_plan(plan, ctx, risk, tracker)
+                assert result_key(compiled) == result_key(interp), (
+                    round_no, ctx, policies)
+                assert inherited == ()
+                assert (derive_controls(compiled.matches, compiled.action)
+                        == derive_controls(interp.matches, interp.action))
+
+    def test_cross_agent_inheritance_equivalence(self):
+        rng = random.Random(0xBEEF)
+        evaluator = PolicyEvaluator()
+        clock = FakeClock()
+        for _ in range(25):
+            policies = [rand_policy(rng, i) for i in range(rng.randint(2, 8))]
+            index = build_policy_index(policies)
+            planner = PolicyPlanner(index, TIME_WINDOWS)
+            tracker = FrequencyTracker(clock=clock)
+            ctx = rand_ctx(rng)
+            parent = rng.choice([a for a in AGENTS if a != ctx.agent_id])
+            # interp merge — the literal resolve_effective_policies logic
+            own = policies_for(index, ctx.agent_id, ctx.hook)
+            seen = {p["id"] for p in own}
+            merged, inherited_oracle = list(own), []
+            for policy in policies_for(index, parent, ctx.hook):
+                if policy["id"] not in seen:
+                    merged.append(policy)
+                    seen.add(policy["id"])
+                    inherited_oracle.append(policy["id"])
+            risk = RiskAssessment(level="medium", score=40, factors=[])
+            deps = ConditionDeps(regex_cache={}, time_windows=TIME_WINDOWS,
+                                 risk=risk, frequency_tracker=tracker,
+                                 evaluators=EVALUATORS)
+            interp = evaluator.evaluate(ctx, merged, deps)
+            plan, inherited = planner.plan_for(ctx.agent_id, ctx.hook, parent)
+            compiled = evaluate_plan(plan, ctx, risk, tracker)
+            assert result_key(compiled) == result_key(interp)
+            assert list(inherited) == inherited_oracle
+
+    def test_plan_cache_returns_same_plan(self):
+        index = build_policy_index([rand_policy(random.Random(1), 0)])
+        planner = PolicyPlanner(index, {})
+        p1, _ = planner.plan_for("main", "before_tool_call")
+        p2, _ = planner.plan_for("main", "before_tool_call")
+        assert p1 is p2
+
+    def test_bank_hit_and_miss_paths(self):
+        policies = [
+            {"id": f"b{i}", "priority": 50,
+             "scope": {"hooks": ["before_tool_call"]},
+             "rules": [{"id": "r", "conditions": [
+                 {"type": "tool", "params": {"command": {"matches": f"tok-{i}-[a-z]+"}}}],
+                 "effect": {"action": "audit", "reason": f"b{i}"}}]}
+            for i in range(6)
+        ]
+        index = build_policy_index(policies)
+        planner = PolicyPlanner(index, {})
+        plan, _ = planner.plan_for("main", "before_tool_call")
+        assert plan.banks and plan.banks[0][0] == "command"
+        assert sum(1 for pk, _, _ in plan.entries if pk == "command") == 6
+        tracker = FrequencyTracker(clock=FakeClock())
+        risk = RiskAssessment(level="low", score=0, factors=[])
+        evaluator = PolicyEvaluator()
+        deps = ConditionDeps(regex_cache={}, time_windows={}, risk=risk,
+                             frequency_tracker=tracker, evaluators=EVALUATORS)
+        for command in ("nothing here", "tok-3-abc", "tok-0-z tok-5-q", None):
+            params = {"command": command} if command is not None else None
+            ctx = EvaluationContext(
+                agent_id="main", session_key="agent:main:s",
+                hook="before_tool_call",
+                trust=EvalTrust(TrustSnapshot(50, "standard"),
+                                TrustSnapshot(50, "standard")),
+                time=TimeContext(12, 0, 3, "2026-08-01"),
+                tool_name="exec", tool_params=params)
+            compiled = evaluate_plan(plan, ctx, risk, tracker)
+            interp = evaluator.evaluate(ctx, policies, deps)
+            assert result_key(compiled) == result_key(interp), command
+
+    def test_bank_excludes_shadowed_matches_keys(self):
+        # Reviewer repro: {"equals": X, "matches": Y} — equals shadows the
+        # regex, so a bank miss on Y must NOT skip the policy.
+        policies = [
+            {"id": "weird", "priority": 60,
+             "rules": [{"id": "r", "conditions": [
+                 {"type": "tool", "params": {"command": {
+                     "equals": "rm -rf /", "matches": r"zzz[0-9]+"}}}],
+                 "effect": {"action": "deny", "reason": "equals wins"}}]},
+            {"id": "plain", "priority": 50,
+             "rules": [{"id": "r", "conditions": [
+                 {"type": "tool", "params": {"command": {"matches": r"qqq[0-9]+"}}}],
+                 "effect": {"action": "audit", "reason": "regex"}}]},
+        ]
+        index = build_policy_index(policies)
+        planner = PolicyPlanner(index, {})
+        plan, _ = planner.plan_for("main", "before_tool_call")
+        tracker = FrequencyTracker(clock=FakeClock())
+        risk = RiskAssessment(level="low", score=0, factors=[])
+        deps = ConditionDeps(regex_cache={}, time_windows={}, risk=risk,
+                             frequency_tracker=tracker, evaluators=EVALUATORS)
+        ctx = EvaluationContext(
+            agent_id="main", session_key="agent:main:s", hook="before_tool_call",
+            trust=EvalTrust(TrustSnapshot(50, "standard"),
+                            TrustSnapshot(50, "standard")),
+            time=TimeContext(12, 0, 3, "2026-08-01"),
+            tool_name="exec", tool_params={"command": "rm -rf /"})
+        compiled = evaluate_plan(plan, ctx, risk, tracker)
+        interp = PolicyEvaluator().evaluate(ctx, policies, deps)
+        assert result_key(compiled) == result_key(interp)
+        assert compiled.action == "deny"
+
+    def test_unknown_condition_type_fails_rule_both_paths(self):
+        policies = [{"id": "u", "rules": [
+            {"id": "r", "conditions": [{"type": "nope"}],
+             "effect": {"action": "deny", "reason": "never"}}]}]
+        index = build_policy_index(policies)
+        planner = PolicyPlanner(index, {})
+        plan, _ = planner.plan_for("main", "before_tool_call")
+        ctx = rand_ctx(random.Random(7))
+        risk = RiskAssessment(level="low", score=0, factors=[])
+        tracker = FrequencyTracker(clock=FakeClock())
+        compiled = evaluate_plan(plan, ctx, risk, tracker)
+        assert compiled.action == "allow" and compiled.matches == []
+
+    def test_interp_alias_preserved(self):
+        assert evaluate_conditions is evaluate_conditions_interp
+
+
+class TestEngineLevelEquivalence:
+    """Same call sequence through a compiled-plan engine and an interp
+    engine: verdicts and audit records must agree field-for-field."""
+
+    CONFIG = {
+        "builtinPolicies": {"credentialGuard": True, "productionSafeguard": True,
+                            "rateLimiter": {"maxPerMinute": 5},
+                            "nightMode": {"after": "23:00", "before": "06:00"}},
+        "timeWindows": TIME_WINDOWS,
+        "policies": [
+            {"id": "chan", "priority": 120,
+             "scope": {"channels": ["prod"], "hooks": ["before_tool_call"]},
+             "rules": [{"id": "r0", "conditions": [{"type": "tool", "name": "exec"}],
+                        "effect": {"action": "2fa", "reason": "prod exec"}}]},
+            {"id": "regex1", "priority": 80, "scope": {"hooks": ["before_tool_call"]},
+             "controls": ["A.8.11"],
+             "rules": [{"id": "r0", "conditions": [
+                 {"type": "tool", "params": {"command": {"matches": r"rm -rf \S+"}}}],
+                 "effect": {"action": "deny", "reason": "destructive"}}]},
+            {"id": "regex2", "priority": 80, "scope": {"hooks": ["before_tool_call"]},
+             "rules": [{"id": "r0", "conditions": [
+                 {"type": "tool", "params": {"command": {"matches": r"git push.*main"}}}],
+                 "effect": {"action": "audit", "reason": "watched"}}]},
+            {"id": "tiered", "priority": 60, "scope": {"agents": ["forge"]},
+             "rules": [{"id": "low", "maxTrust": "restricted",
+                        "conditions": [{"type": "tool", "name": "write"}],
+                        "effect": {"action": "deny", "reason": "low trust write"}}]},
+        ],
+        "audit": {"enabled": True, "redactPatterns": [r"sk-\w+", r"\d{3}-\d{2}-\d{4}"]},
+    }
+
+    VOLATILE = ("id", "timestamp", "timestampIso", "evaluationUs")
+
+    def scrubbed(self, records):
+        out = []
+        for rec in records:
+            r = {k: v for k, v in rec.items() if k not in self.VOLATILE}
+            out.append(r)
+        return out
+
+    def test_sequences_match(self, tmp_path):
+        clock_a, clock_b = FakeClock(), FakeClock()
+        eng_a = GovernanceEngine(dict(self.CONFIG), str(tmp_path / "a"),
+                                 list_logger(), clock=clock_a)
+        eng_b = GovernanceEngine({**self.CONFIG, "compiledPlans": False},
+                                 str(tmp_path / "b"), list_logger(), clock=clock_b)
+        assert eng_a.planner is not None and eng_b.planner is None
+        rng = random.Random(0xFACADE)
+        calls = []
+        for _ in range(120):
+            calls.append(dict(
+                hook=rng.choice(["before_tool_call", "message_sending"]),
+                agent_id=rng.choice(AGENTS),
+                tool_name=rng.choice(["exec", "write", "read", "gateway"]),
+                command=rng.choice(COMMANDS + ["rm -rf /tmp/x", "git push origin main"]),
+                channel=rng.choice(CHANNELS),
+                advance=rng.choice([0.0, 0.5, 2.0, 70.0]),
+            ))
+        for call in calls:
+            verdicts = []
+            for eng, clock in ((eng_a, clock_a), (eng_b, clock_b)):
+                clock.advance(call["advance"])
+                ctx = eng.build_context(
+                    call["hook"], call["agent_id"],
+                    f"agent:{call['agent_id']}:s0",
+                    tool_name=call["tool_name"],
+                    tool_params={"command": call["command"]},
+                    channel=call["channel"],
+                    message_content="deploy the secret sk-abc123 now",
+                )
+                verdicts.append(eng.evaluate(ctx))
+            va, vb = verdicts
+            assert va.action == vb.action, call
+            assert va.reason == vb.reason, call
+            assert ([(m.policy_id, m.rule_id, m.effect, m.controls)
+                     for m in va.matched_policies]
+                    == [(m.policy_id, m.rule_id, m.effect, m.controls)
+                        for m in vb.matched_policies]), call
+            assert va.trust == vb.trust
+        # trust state evolved identically on both sides
+        assert eng_a.trust_manager.store["agents"].keys() == \
+            eng_b.trust_manager.store["agents"].keys()
+        for aid, agent in eng_a.trust_manager.store["agents"].items():
+            assert agent["score"] == eng_b.trust_manager.store["agents"][aid]["score"]
+        # audit records identical minus volatile fields
+        assert self.scrubbed(eng_a.audit_trail.buffer) == \
+            self.scrubbed(eng_b.audit_trail.buffer)
+        assert eng_a.audit_trail.today_count == eng_b.audit_trail.today_count
+
+    def test_status_exposes_stage_timings(self, tmp_path):
+        eng = GovernanceEngine(dict(self.CONFIG), str(tmp_path), list_logger(),
+                               clock=FakeClock())
+        ctx = eng.build_context("before_tool_call", "main", "agent:main:s0",
+                                tool_name="exec", tool_params={"command": "ls"})
+        eng.evaluate(ctx)
+        status = eng.get_status()
+        assert set(status["stageMs"]) == {"enrich", "frequency", "risk",
+                                          "evaluate", "trust", "audit"}
+        assert status["stageCounts"]["evaluate"] == 1
+        assert status["policyCount"] == eng.policy_index.unique_policy_count
+
+
+class TestRedactorEquivalence:
+    VALUES = [
+        "no secrets here", "token sk-abc123 leaked", "ssn 123-45-6789",
+        "REDACTED literal", "", 42, None, True,
+        {"cmd": "use sk-zzz", "nested": {"ssn": "987-65-4321", "n": 7}},
+        ["sk-a", {"deep": ["123-45-6789", "ok"]}, 3.14],
+        {"mixed": ["sk-abc", {"x": "abcABC"}]},
+    ]
+    PATTERN_SETS = [
+        [],
+        [r"sk-\w+"],
+        [r"sk-\w+", r"\d{3}-\d{2}-\d{4}"],
+        [r"[A-Z]+", r"sk-\w+"],          # replacement creates new matches
+        ["(unclosed", r"secret"],        # invalid pattern skipped
+        [r"(ab)\1", r"sk-\w+"],          # backreference → no combined screen
+        [r"sk-\w+", r"sk-\w+"],          # duplicates
+    ]
+
+    def test_fast_matches_sequential_oracle(self):
+        rng = random.Random(0xFEED)
+        for patterns in self.PATTERN_SETS:
+            fast = create_redactor(patterns)
+            oracle = create_redactor_seq(patterns)
+            for value in self.VALUES:
+                assert fast(value) == oracle(value), (patterns, value)
+            for _ in range(50):
+                blob = {
+                    f"k{i}": rng.choice(self.VALUES)
+                    for i in range(rng.randint(1, 4))
+                }
+                assert fast(blob) == oracle(blob), (patterns, blob)
+
+    def test_no_patterns_is_identity(self):
+        redact = create_redactor([])
+        value = {"a": ["b", {"c": 1}]}
+        assert redact(value) is value
+
+    def test_screen_never_leaks(self):
+        # A string the combined screen must flag even when only one member
+        # pattern matches at a position later than another's failed prefix.
+        redact = create_redactor([r"abc(?=d)", r"xyz"])
+        oracle = create_redactor_seq([r"abc(?=d)", r"xyz"])
+        for s in ("abcd", "abce", "wxyz", "abc xyz", "abcdxyz"):
+            assert redact(s) == oracle(s), s

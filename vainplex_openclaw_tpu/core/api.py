@@ -171,6 +171,8 @@ class HookBus:
 
     def __init__(self, logger: Optional[PluginLogger] = None, clock: Callable[[], float] = time.time):
         self._handlers: dict[str, list[_Registration]] = {}
+        self._snapshots: dict[str, list[_Registration]] = {}
+        self._async_memo: dict[str, bool] = {}
         self._seq = 0
         self._logger = logger or make_logger("hook-bus")
         self._clock = clock
@@ -184,12 +186,30 @@ class HookBus:
         regs = self._handlers.setdefault(hook_name, [])
         regs.append(reg)
         regs.sort(key=lambda r: (r.priority, r.seq))
+        self._invalidate(hook_name)
+
+    def _invalidate(self, hook_name: str) -> None:
+        """Drop per-hook dispatch caches after registration or an is_async
+        promotion."""
+        self._snapshots.pop(hook_name, None)
+        self._async_memo.pop(hook_name, None)
 
     def handlers_for(self, hook_name: str) -> list[_Registration]:
-        return list(self._handlers.get(hook_name, ()))
+        # Cached snapshot, rebuilt only when the registration set changes:
+        # the per-fire list() copy (it guards against handlers registering
+        # handlers mid-iteration) was a fixed tax on every enforcement call.
+        # The cached list must be treated as immutable by callers.
+        snap = self._snapshots.get(hook_name)
+        if snap is None:
+            snap = self._snapshots[hook_name] = list(self._handlers.get(hook_name, ()))
+        return snap
 
     def has_async(self, hook_name: str) -> bool:
-        return any(r.is_async for r in self._handlers.get(hook_name, ()))
+        memo = self._async_memo.get(hook_name)
+        if memo is None:
+            memo = self._async_memo[hook_name] = any(
+                r.is_async for r in self._handlers.get(hook_name, ()))
+        return memo
 
     @staticmethod
     async def _await_result(awaitable: Any) -> Any:
@@ -286,6 +306,7 @@ class HookBus:
                                 f"'{reg.plugin_id}' is async"
                             )
                         reg.is_async = True
+                        self._invalidate(hook_name)
                         try:
                             asyncio.get_running_loop()
                         except RuntimeError:

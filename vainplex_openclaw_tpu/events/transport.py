@@ -29,7 +29,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterator, Optional, Protocol
 
-from ..storage.atomic import daily_jsonl_name
+from ..storage.atomic import daily_jsonl_name, jsonl_dumps
 from .envelope import ClawEvent
 from .subjects import build_subject
 
@@ -50,12 +50,7 @@ class EventTransport(Protocol):
     def drain(self) -> None: ...
 
 
-def _subject_matches(pattern: str, subject: str) -> bool:
-    """NATS-style matching: ``*`` = one token, ``>`` = rest-of-subject."""
-    if pattern in ("", ">"):
-        return True
-    p_tokens = pattern.split(".")
-    s_tokens = subject.split(".")
+def _match_tokens(p_tokens: list[str], s_tokens: list[str]) -> bool:
     for i, pt in enumerate(p_tokens):
         if pt == ">":
             return True
@@ -64,6 +59,37 @@ def _subject_matches(pattern: str, subject: str) -> bool:
         if pt != "*" and pt != s_tokens[i]:
             return False
     return len(p_tokens) == len(s_tokens)
+
+
+def _subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style matching: ``*`` = one token, ``>`` = rest-of-subject."""
+    if pattern in ("", ">"):
+        return True
+    return _match_tokens(pattern.split("."), subject.split("."))
+
+
+class _SubjectFilter:
+    """A subject filter pre-split once per fetch, with a per-distinct-subject
+    verdict memo: consumers fetch thousands of events spread over a handful
+    of subjects, and the seed re-split pattern AND subject on every event."""
+
+    __slots__ = ("match_all", "p_tokens", "verdicts")
+
+    def __init__(self, pattern: str):
+        self.match_all = pattern in ("", ">")
+        self.p_tokens = None if self.match_all else pattern.split(".")
+        self.verdicts: dict[str, bool] = {}
+
+    def matches(self, subject: str) -> bool:
+        if self.match_all:
+            return True
+        verdict = self.verdicts.get(subject)
+        if verdict is None:
+            if len(self.verdicts) > 65536:  # attacker-influencable key space
+                self.verdicts.clear()
+            verdict = self.verdicts[subject] = _match_tokens(
+                self.p_tokens, subject.split("."))
+        return verdict
 
 
 class MemoryTransport:
@@ -124,17 +150,39 @@ class MemoryTransport:
 
     def fetch(self, subject_filter: str = ">", start_seq: int = 0,
               batch: Optional[int] = None) -> Iterator[ClawEvent]:
-        n = 0
         # snapshot: consumers iterate while the gateway keeps publishing
-        for subject, event, _ in list(self._events):
-            if event.seq is not None and event.seq <= start_seq:
-                continue
-            if not _subject_matches(subject_filter, subject):
-                continue
-            yield event
-            n += 1
-            if batch is not None and n >= batch:
-                return
+        snapshot = list(self._events)
+        if start_seq > 0:
+            # events sit in seq order (assigned monotonically at publish,
+            # evicted from the left) — binary-search past the consumed prefix
+            # instead of testing every event.
+            lo, hi = 0, len(snapshot)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                seq = snapshot[mid][1].seq
+                if seq is not None and seq <= start_seq:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            snapshot = snapshot[lo:]
+        if subject_filter in ("", ">"):
+            if batch is not None:
+                snapshot = snapshot[:batch]
+            yield from (event for _, event, _ in snapshot)
+            return
+        filt = _SubjectFilter(subject_filter)
+        matches = filt.matches
+        if batch is None:
+            yield from (event for subject, event, _ in snapshot if matches(subject))
+            return
+        n = 0
+        # paging consumers must not pay a full-ring scan per page
+        for subject, event, _ in snapshot:
+            if matches(subject):
+                yield event
+                n += 1
+                if n >= batch:
+                    return
 
     def last_sequence(self) -> int:
         return self._seq
@@ -149,26 +197,114 @@ class MemoryTransport:
         pass
 
 
+class _FileEntry:
+    """Incremental parse state for one daily JSONL file.
+
+    ``offset`` is the byte position up to which complete lines have been
+    parsed — a re-stat that shows the same size means the file needs no work
+    at all, and growth parses only the appended tail. ``records`` holds
+    (seq, subject, raw_record) tuples; ClawEvents are materialized per fetch
+    so callers never share mutable envelope objects. When the cache cap
+    evicts an old file's rows, ``records`` becomes None: count/max_seq/offset
+    stay incrementally maintained and fetch streams that file from disk
+    (the seed's behavior) instead of holding history in memory forever.
+    """
+
+    __slots__ = ("mtime", "size", "offset", "count", "max_seq", "records")
+
+    def __init__(self) -> None:
+        self.mtime = 0.0
+        self.size = 0
+        self.offset = 0
+        self.count = 0  # records with a positive seq (what fetch/count see)
+        self.max_seq = 0
+        self.records: Optional[list[tuple[int, str, dict]]] = []
+
+
+def _parse_jsonl_record(line: bytes) -> Optional[tuple[int, str, dict]]:
+    if not line.strip():
+        return None
+    try:
+        rec = json.loads(line)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if not isinstance(rec, dict):
+        return None
+    seq = rec.get("seq") or 0
+    if not isinstance(seq, int):
+        try:
+            seq = int(seq)
+        except (TypeError, ValueError):
+            seq = 0
+    return seq, rec.get("subject", ""), rec
+
+
+def _last_seq_in_file(path: Path, block: int = 65536) -> int:
+    """Max seq over the LAST block of parseable records, reading backwards
+    from EOF — daily logs are append-ordered, so the tail carries the file's
+    max seq without re-parsing every line (the seed's startup recovery did
+    exactly that). Taking the block max rather than the last line's seq also
+    tolerates interleaved multi-writer appends whose seqs are locally
+    non-monotone within the tail."""
+    try:
+        with path.open("rb") as fh:
+            fh.seek(0, 2)
+            end = fh.tell()
+            buf = b""
+            pos = end
+            while pos > 0:
+                step = min(block, pos)
+                pos -= step
+                fh.seek(pos)
+                buf = fh.read(step) + buf
+                # Complete lines only — the partial first line of the buffer
+                # is resolved once the next block is prepended (or pos hits 0).
+                lines = buf.split(b"\n")
+                start = 0 if pos == 0 else 1
+                best = 0
+                for line in lines[start:]:
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        seq = int(rec.get("seq") or 0) if isinstance(rec, dict) else 0
+                    except (json.JSONDecodeError, TypeError, ValueError,
+                            UnicodeDecodeError):
+                        continue
+                    if seq > best:
+                        best = seq
+                if best > 0:
+                    return best
+                buf = lines[0]
+    except OSError:
+        pass
+    return 0
+
+
 class FileTransport:
-    """Durable daily-JSONL event log with the same interface."""
+    """Durable daily-JSONL event log with the same interface.
+
+    A per-file (mtime, size, offset, seq, count) index backs ``fetch``,
+    ``event_count``, and startup seq recovery: the seed re-read and re-parsed
+    every daily file on every call. Appends by this process (and by other
+    writers) are picked up incrementally from the recorded byte offset; a
+    shrunken file (rotation, truncation) is re-parsed from scratch.
+    """
 
     def __init__(self, root: str | Path, clock: Callable[[], float] = time.time):
         self.root = Path(root)
         self.clock = clock
         self.stats = TransportStats()
+        self._index: dict[Path, _FileEntry] = {}
         self._seq = self._recover_seq()
 
     def _recover_seq(self) -> int:
+        # Max over each file's tail seq: append-ordered files keep their max
+        # seq in the last valid record, so recovery reads file tails instead
+        # of every line of every file.
         seq = 0
-        for f in sorted(self.root.glob("*.jsonl")):
-            try:
-                for line in f.read_text(encoding="utf-8").splitlines():
-                    try:
-                        seq = max(seq, int(json.loads(line).get("seq") or 0))
-                    except (json.JSONDecodeError, TypeError, ValueError):
-                        continue
-            except OSError:
-                continue
+        for f in self.root.glob("*.jsonl"):
+            seq = max(seq, _last_seq_in_file(f))
         return seq
 
     def publish(self, subject: str, event: ClawEvent) -> bool:
@@ -176,10 +312,15 @@ class FileTransport:
             self._seq += 1
             event.seq = self._seq
             path = self.root / daily_jsonl_name(self.clock())
-            path.parent.mkdir(parents=True, exist_ok=True)
             rec = {"subject": subject, **event.to_dict()}
-            with path.open("a", encoding="utf-8") as fh:
-                fh.write(json.dumps(rec, ensure_ascii=False, default=str) + "\n")
+            line = jsonl_dumps(rec) + "\n"
+            try:
+                fh = path.open("a", encoding="utf-8")
+            except FileNotFoundError:
+                path.parent.mkdir(parents=True, exist_ok=True)
+                fh = path.open("a", encoding="utf-8")
+            with fh:
+                fh.write(line)
             self.stats.published += 1
             return True
         except Exception as exc:  # noqa: BLE001
@@ -187,22 +328,96 @@ class FileTransport:
             self.stats.last_error = str(exc)
             return False
 
+    def _refresh_file(self, path: Path) -> Optional[_FileEntry]:
+        try:
+            st = path.stat()
+        except OSError:
+            return None
+        entry = self._index.get(path)
+        if entry is not None and st.st_size == entry.offset:
+            return entry  # fully parsed — nothing new
+        if entry is None or st.st_size < entry.offset:
+            entry = _FileEntry()  # new file, or rewritten shorter: reparse
+            self._index[path] = entry
+        try:
+            with path.open("rb") as fh:
+                fh.seek(entry.offset)
+                chunk = fh.read()
+        except OSError:
+            return entry
+        # Parse complete lines only; a trailing partial line (a concurrent
+        # writer mid-append) stays unconsumed until it gains its newline.
+        end = chunk.rfind(b"\n")
+        if end == -1:
+            return entry
+        for line in chunk[:end].split(b"\n"):
+            parsed = _parse_jsonl_record(line)
+            if parsed is None:
+                continue
+            seq = parsed[0]
+            if entry.records is not None:
+                entry.records.append(parsed)
+            if seq > 0:
+                entry.count += 1
+                if seq > entry.max_seq:
+                    entry.max_seq = seq
+        entry.offset += end + 1
+        entry.mtime, entry.size = st.st_mtime, st.st_size
+        return entry
+
+    # Bound on raw records held in memory across all files: beyond it the
+    # OLDEST files drop to offset-only entries (streamed from disk on fetch)
+    # so a long-lived gateway never mirrors its whole event history in RSS.
+    MAX_CACHED_RECORDS = 200_000
+
+    def _refresh_index(self) -> list[tuple[Path, _FileEntry]]:
+        seen = []
+        present = set()
+        for f in sorted(self.root.glob("*.jsonl")):
+            entry = self._refresh_file(f)
+            if entry is not None:
+                present.add(f)
+                seen.append((f, entry))
+        for stale in [p for p in self._index if p not in present]:
+            del self._index[stale]
+        cached = sum(len(e.records) for _, e in seen if e.records is not None)
+        for _, entry in seen[:-1]:  # newest file always stays cached
+            if cached <= self.MAX_CACHED_RECORDS:
+                break
+            if entry.records is not None:
+                cached -= len(entry.records)
+                entry.records = None
+        return seen
+
+    def _stream_records(self, path: Path, entry: _FileEntry):
+        """Re-read an evicted file's parsed span from disk (seed behavior)."""
+        try:
+            with path.open("rb") as fh:
+                chunk = fh.read(entry.offset)
+        except OSError:
+            return
+        for line in chunk.split(b"\n"):
+            parsed = _parse_jsonl_record(line)
+            if parsed is not None:
+                yield parsed
+
     def fetch(self, subject_filter: str = ">", start_seq: int = 0,
               batch: Optional[int] = None) -> Iterator[ClawEvent]:
         n = 0
-        for f in sorted(self.root.glob("*.jsonl")):
-            try:
-                lines = f.read_text(encoding="utf-8").splitlines()
-            except OSError:
+        filt = _SubjectFilter(subject_filter)
+        matches = filt.matches
+        for path, entry in self._refresh_index():
+            if start_seq > 0 and entry.max_seq <= start_seq:
+                # every positive seq in this file is ≤ max_seq ≤ start_seq,
+                # and seq-0 records are excluded by any start_seq > 0 — a
+                # consumer past the whole file skips it without iterating
                 continue
-            for line in lines:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
+            rows = (entry.records if entry.records is not None
+                    else self._stream_records(path, entry))
+            for seq, subject, rec in rows:
+                if seq <= start_seq:
                     continue
-                if (rec.get("seq") or 0) <= start_seq:
-                    continue
-                if not _subject_matches(subject_filter, rec.get("subject", "")):
+                if not matches(subject):
                     continue
                 yield ClawEvent.from_dict(rec)
                 n += 1
@@ -213,7 +428,7 @@ class FileTransport:
         return self._seq
 
     def event_count(self) -> int:
-        return sum(1 for _ in self.fetch())
+        return sum(entry.count for _, entry in self._refresh_index())
 
     def healthy(self) -> bool:
         return True

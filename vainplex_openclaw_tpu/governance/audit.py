@@ -9,16 +9,32 @@ in memory either.
 
 from __future__ import annotations
 
+import os
+import random
 import re
 import time
-import uuid
 from pathlib import Path
 from typing import Callable, Optional
 
-from ..storage.atomic import append_jsonl, read_jsonl
+from ..storage.atomic import append_jsonl, jsonl_dumps, read_jsonl
 from .types import MatchedPolicy
+from .util import ALTERNATION_UNSAFE
 
 FLUSH_THRESHOLD = 100
+
+# Audit ids are correlation ids, not capability tokens: a PRNG-backed UUID4
+# (seeded from os.urandom once) keeps the format while dropping the per-record
+# syscall that uuid.uuid4() pays on every evaluation.
+_ID_RNG = random.Random()
+
+
+def _record_id() -> str:
+    # Hand-formatted RFC-4122 v4 layout (version nibble 4, variant bits 10):
+    # building a uuid.UUID object just to str() it doubled the cost.
+    v = _ID_RNG.getrandbits(128)
+    v = (v & ~(0xF << 76) | (4 << 76)) & ~(0x3 << 62) | (0x2 << 62)
+    s = f"{v:032x}"
+    return f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
 
 
 def derive_controls(matched: list[MatchedPolicy], verdict: str) -> list[str]:
@@ -30,7 +46,9 @@ def derive_controls(matched: list[MatchedPolicy], verdict: str) -> list[str]:
     return sorted(controls)
 
 
-def create_redactor(patterns: list[str]):
+def create_redactor_seq(patterns: list[str]):
+    """Sequential per-pattern redactor — the equivalence oracle for
+    ``create_redactor`` (tests/test_governance_plan_equiv.py)."""
     compiled = []
     for p in patterns or []:
         try:
@@ -43,6 +61,54 @@ def create_redactor(patterns: list[str]):
             for rx in compiled:
                 value = rx.sub("[REDACTED]", value)
             return value
+        if isinstance(value, dict):
+            return {k: redact_value(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [redact_value(v) for v in value]
+        return value
+
+    return redact_value
+
+
+def create_redactor(patterns: list[str]):
+    """Single-pass audit scrub. With no valid patterns the redactor is the
+    identity (the old tree walk copied every record for nothing). Otherwise
+    strings are screened once with an alternation-combined pattern and only
+    hits pay the per-pattern substitution — output stays bit-identical to the
+    sequential oracle because the substitutions themselves are unchanged.
+    A combined-pattern false negative would LEAK (a secret skipped), so the
+    pre-filter is dropped whenever the alternation cannot be trusted: any
+    pattern with backreferences, or a combination that fails to compile
+    (e.g. embedded global flags)."""
+    valid: list[str] = []
+    compiled = []
+    for p in patterns or []:
+        try:
+            compiled.append(re.compile(p))
+            valid.append(p)
+        except re.error:
+            continue
+    if not compiled:
+        return lambda value: value
+
+    combined = None
+    if not any(ALTERNATION_UNSAFE.search(p) for p in valid):
+        try:
+            combined = re.compile("|".join(f"(?:{p})" for p in valid))
+        except re.error:
+            combined = None
+    screen = combined.search if combined is not None else None
+
+    def redact_str(value: str) -> str:
+        if screen is not None and screen(value) is None:
+            return value
+        for rx in compiled:
+            value = rx.sub("[REDACTED]", value)
+        return value
+
+    def redact_value(value):
+        if isinstance(value, str):
+            return redact_str(value)
         if isinstance(value, dict):
             return {k: redact_value(v) for k, v in value.items()}
         if isinstance(value, list):
@@ -67,10 +133,36 @@ class AuditTrail:
         self.scrubber = None
         self.buffer: list[dict] = []
         self.today_count = 0
+        # Per-second / per-day caches and the controls memo: every record
+        # was re-running strftime, gmtime, and a sorted() over an almost
+        # always identical controls set.
+        self._iso_cache: tuple[int, str] = (-1, "")
+        self._date_cache: tuple[int, str] = (-1, "")
+        self._controls_cache: dict[tuple, list[str]] = {}
+        self._day_fh = None
+        self._day_name = ""
 
     def _date_str(self, ts: float) -> str:
-        t = time.gmtime(ts)
-        return f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}"
+        day = int(ts // 86400)
+        if self._date_cache[0] != day:
+            t = time.gmtime(ts)
+            self._date_cache = (day, f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}")
+        return self._date_cache[1]
+
+    def _iso_str(self, ts: float) -> str:
+        sec = int(ts)
+        if self._iso_cache[0] != sec:
+            self._iso_cache = (sec, time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(sec)))
+        return self._iso_cache[1]
+
+    def _controls_for(self, matched: list[MatchedPolicy], verdict: str) -> list[str]:
+        key = (verdict == "deny", tuple(tuple(m.controls) for m in matched))
+        cached = self._controls_cache.get(key)
+        if cached is None:
+            if len(self._controls_cache) > 1024:
+                self._controls_cache.clear()
+            cached = self._controls_cache[key] = derive_controls(matched, verdict)
+        return list(cached)
 
     def load(self) -> None:
         self.audit_dir.mkdir(parents=True, exist_ok=True)
@@ -88,9 +180,9 @@ class AuditTrail:
             except Exception as exc:  # noqa: BLE001 — scrub failure must not kill auditing
                 self.logger.error(f"Audit scrubber failed: {exc}")
         rec = {
-            "id": str(uuid.uuid4()),
+            "id": _record_id(),
             "timestamp": now * 1000,
-            "timestampIso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)),
+            "timestampIso": self._iso_str(now),
             "verdict": verdict,
             "reason": reason,
             "context": self.redact(context),
@@ -98,7 +190,7 @@ class AuditTrail:
             "risk": risk,
             "matchedPolicies": [m.to_dict() for m in matched],
             "evaluationUs": evaluation_us,
-            "controls": derive_controls(matched, verdict),
+            "controls": self._controls_for(matched, verdict),
         }
         self.buffer.append(rec)
         self.today_count += 1
@@ -109,15 +201,52 @@ class AuditTrail:
     def flush(self) -> None:
         if not self.buffer:
             return
-        by_day: dict[str, list[dict]] = {}
-        for rec in self.buffer:
-            by_day.setdefault(self._date_str(rec["timestamp"] / 1000), []).append(rec)
         try:
-            for day, records in by_day.items():
-                append_jsonl(self.audit_dir / f"{day}.jsonl", records)
+            # The overwhelmingly common case is a same-day batch (the cached
+            # _date_str makes this check a tuple compare per record): it
+            # skips the per-record regroup and reuses one open handle.
+            days = {self._date_str(rec["timestamp"] / 1000) for rec in self.buffer}
+            if len(days) == 1:
+                self._append_day(days.pop(), self.buffer)
+            else:
+                by_day: dict[str, list[dict]] = {}
+                for rec in self.buffer:
+                    by_day.setdefault(self._date_str(rec["timestamp"] / 1000),
+                                      []).append(rec)
+                for day, records in by_day.items():
+                    append_jsonl(self.audit_dir / f"{day}.jsonl", records)
             self.buffer = []
         except OSError as exc:
             self.logger.error(f"Audit flush failed: {exc}")
+
+    def _append_day(self, day: str, records: list[dict]) -> None:
+        """Append via a persistent per-day handle: reopening the same daily
+        file on every 100-record flush was a measurable slice of the audit
+        stage. The handle rolls over when the day does, is re-opened when the
+        file on disk was rotated/deleted out from under it (writing to an
+        unlinked inode would silently lose audit records), and contents are
+        flushed to the OS before returning (query() reads the file back)."""
+        path = self.audit_dir / f"{day}.jsonl"
+        fh = self._day_fh
+        if fh is not None and not fh.closed and self._day_name == day:
+            try:
+                disk = os.stat(path)
+                held = os.fstat(fh.fileno())
+                if (disk.st_dev, disk.st_ino) != (held.st_dev, held.st_ino):
+                    fh = None  # rotated: same name, different inode
+            except OSError:
+                fh = None  # deleted/renamed: recreate like the seed did
+        if fh is None or fh.closed or self._day_name != day:
+            if self._day_fh is not None and not self._day_fh.closed:
+                self._day_fh.close()
+            try:
+                fh = path.open("a", encoding="utf-8")
+            except FileNotFoundError:
+                path.parent.mkdir(parents=True, exist_ok=True)
+                fh = path.open("a", encoding="utf-8")
+            self._day_fh, self._day_name = fh, day
+        fh.write("".join(jsonl_dumps(rec) + "\n" for rec in records))
+        fh.flush()
 
     def query(self, verdict: Optional[str] = None, agent_id: Optional[str] = None,
               since_ms: Optional[float] = None, limit: int = 100) -> list[dict]:

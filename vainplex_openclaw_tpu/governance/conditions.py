@@ -201,11 +201,21 @@ def create_condition_evaluators() -> dict:
     }
 
 
-def evaluate_conditions(conditions: list[Condition], ctx: EvaluationContext,
-                        deps: ConditionDeps) -> bool:
-    """AND across the list; unknown condition types fail the rule (deny-safe)."""
+def evaluate_conditions_interp(conditions: list[Condition], ctx: EvaluationContext,
+                               deps: ConditionDeps) -> bool:
+    """AND across the list; unknown condition types fail the rule (deny-safe).
+
+    This dict-walking interpreter is the governance semantics of record: the
+    compiled planner (policy_plan.py) is pinned to it by randomized
+    equivalence tests and must never diverge from what this returns.
+    """
     for c in conditions:
         fn = deps.evaluators.get(c.get("type"))
         if fn is None or not fn(c, ctx, deps):
             return False
     return True
+
+
+# The hot path now runs compiled policy plans; the interpreter keeps its old
+# name as an alias because it IS the behavior contract, not a legacy path.
+evaluate_conditions = evaluate_conditions_interp

@@ -15,12 +15,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..utils.stage_timer import StageTimer
 from .audit import AuditTrail
 from .cross_agent import CrossAgentManager
 from .conditions import create_condition_evaluators
 from .frequency import FrequencyTracker
 from .policy_evaluator import PolicyEvaluator
 from .policy_loader import build_policy_index, load_policies
+from .policy_plan import PolicyPlanner, evaluate_plan
 from .risk import RiskAssessor
 from .trust import SessionTrustManager, TrustManager
 from .types import (
@@ -75,6 +77,13 @@ class GovernanceEngine:
         self.policy_index = build_policy_index(policies)
         self.evaluators = create_condition_evaluators()
         self.evaluator = PolicyEvaluator()
+        # Load-time compilation of the enforcement hot path. The interpretive
+        # evaluator stays as the equivalence oracle; `compiledPlans: false`
+        # pins an engine to it (tests/test_governance_plan_equiv.py runs both
+        # and compares verdict-for-verdict).
+        self.planner = (PolicyPlanner(self.policy_index, config.get("timeWindows", {}))
+                        if config.get("compiledPlans", True) else None)
+        self.timer = StageTimer()
         self.frequency_tracker = FrequencyTracker(clock=clock)
         self.risk_assessor = RiskAssessor(config.get("toolRiskOverrides", {}))
         self.trust_manager = TrustManager(config.get("trust", {}), workspace, logger, clock=clock)
@@ -83,6 +92,13 @@ class GovernanceEngine:
         self.cross_agent = CrossAgentManager(self.trust_manager, logger, clock=clock)
         self.audit_trail = AuditTrail(config.get("audit", {}), workspace, logger, clock=clock)
         self.stats = EngineStats()
+        # Enforcement flags resolved once at load — config is immutable after
+        # plugin registration, and the chained dict.gets sat on every call.
+        self._audit_enabled = config.get("audit", {}).get("enabled", True)
+        self._trust_enabled = config.get("trust", {}).get("enabled", True)
+        # TimeContext only has minute resolution, so one localtime() per
+        # wall-clock second serves every evaluation in that second.
+        self._time_ctx_cache: Optional[tuple] = None
         self.known_agent_ids: list[str] = []
         # Filled by the validation subsystem (output_validator) when enabled.
         self.output_validator = None
@@ -115,6 +131,12 @@ class GovernanceEngine:
                       conversation_context: Optional[list] = None) -> EvaluationContext:
         agent = self.trust_manager.get_agent_trust(agent_id)
         session = self.session_trust.get_session_trust(session_key, agent_id)
+        now_key = int(self.clock())
+        cached = self._time_ctx_cache
+        if cached is None or cached[0] != now_key:
+            cached = (now_key,
+                      current_time_context(now_key, self.config.get("timezone", "local")))
+            self._time_ctx_cache = cached
         return EvaluationContext(
             agent_id=agent_id,
             session_key=session_key,
@@ -123,7 +145,7 @@ class GovernanceEngine:
                 agent=TrustSnapshot(agent["score"], agent["tier"]),
                 session=TrustSnapshot(session.score, session.tier),
             ),
-            time=current_time_context(self.clock(), self.config.get("timezone", "local")),
+            time=cached[1],
             tool_name=tool_name,
             tool_params=tool_params,
             message_content=message_content,
@@ -142,7 +164,15 @@ class GovernanceEngine:
         except Exception as exc:  # noqa: BLE001 — fail-open/closed per config
             self.logger.error(f"Pipeline crash: {exc}")
             return self._eval_error_verdict(exc, start)
-        self._update_stats(verdict.action, verdict.evaluation_us)
+        stats = self.stats
+        stats.total_evaluations += 1
+        if verdict.action == "deny":
+            stats.deny_count += 1
+        else:
+            stats.allow_count += 1
+        n = stats.total_evaluations
+        stats.avg_evaluation_us = (stats.avg_evaluation_us * (n - 1)
+                                   + verdict.evaluation_us) / n
         return verdict
 
     def _eval_error_verdict(self, exc: Exception, start: int) -> Verdict:
@@ -152,18 +182,16 @@ class GovernanceEngine:
                        risk=None, matched_policies=[], trust={}, evaluation_us=now_us() - start)
 
     def _run_pipeline(self, ctx: EvaluationContext, start_us: int) -> Verdict:
+        pc = time.perf_counter
+        t0 = pc()
         ctx = self.cross_agent.enrich_context(ctx)
+        t1 = pc()
         self.frequency_tracker.record(ctx.agent_id, ctx.session_key, ctx.tool_name)
+        t2 = pc()
         risk = self.risk_assessor.assess(ctx, self.frequency_tracker)
-        policies = self.cross_agent.resolve_effective_policies(ctx, self.policy_index)
-        deps = ConditionDeps(
-            regex_cache=self.regex_cache,
-            time_windows=self.config.get("timeWindows", {}),
-            risk=risk,
-            frequency_tracker=self.frequency_tracker,
-            evaluators=self.evaluators,
-        )
-        result = self.evaluator.evaluate(ctx, policies, deps)
+        t3 = pc()
+        result = self._evaluate_policies(ctx, risk)
+        t4 = pc()
         elapsed = now_us() - start_us
         verdict = Verdict(
             action=result.action,
@@ -174,19 +202,47 @@ class GovernanceEngine:
             evaluation_us=elapsed,
         )
 
-        if verdict.action == "deny" and self.config.get("trust", {}).get("enabled", True):
+        if verdict.action == "deny" and self._trust_enabled:
             time_based = any(m.policy_id in TIME_BASED_POLICY_IDS for m in result.matches
                              if m.effect.get("action") == "deny")
             if not time_based:
                 self.trust_manager.record_violation(ctx.agent_id, f"Policy denial: {verdict.reason}")
                 self.session_trust.apply_signal(ctx.session_key, ctx.agent_id, "policyBlock")
-
+        t5 = pc()
         self._record_audit(ctx, verdict, risk, elapsed)
+        t6 = pc()
+        # One lock round-trip for the whole breakdown — the timer must not
+        # tax the path it attributes.
+        self.timer.add_many((("enrich", (t1 - t0) * 1000.0),
+                             ("frequency", (t2 - t1) * 1000.0),
+                             ("risk", (t3 - t2) * 1000.0),
+                             ("evaluate", (t4 - t3) * 1000.0),
+                             ("trust", (t5 - t4) * 1000.0),
+                             ("audit", (t6 - t5) * 1000.0)))
         return verdict
+
+    def _evaluate_policies(self, ctx: EvaluationContext, risk: RiskAssessment):
+        if self.planner is not None:
+            parent_agent_id = (ctx.cross_agent.parent_agent_id
+                               if ctx.cross_agent is not None else None)
+            plan, inherited = self.planner.plan_for(ctx.agent_id, ctx.hook,
+                                                    parent_agent_id)
+            if ctx.cross_agent is not None:
+                ctx.cross_agent.inherited_policy_ids = list(inherited)
+            return evaluate_plan(plan, ctx, risk, self.frequency_tracker)
+        policies = self.cross_agent.resolve_effective_policies(ctx, self.policy_index)
+        deps = ConditionDeps(
+            regex_cache=self.regex_cache,
+            time_windows=self.config.get("timeWindows", {}),
+            risk=risk,
+            frequency_tracker=self.frequency_tracker,
+            evaluators=self.evaluators,
+        )
+        return self.evaluator.evaluate(ctx, policies, deps)
 
     def _record_audit(self, ctx: EvaluationContext, verdict: Verdict,
                       risk: RiskAssessment, elapsed_us: int) -> None:
-        if not self.config.get("audit", {}).get("enabled", True):
+        if not self._audit_enabled:
             return
         self.audit_trail.record(
             verdict.action, verdict.reason,
@@ -205,7 +261,7 @@ class GovernanceEngine:
     # ── trust feedback (after_tool_call) ─────────────────────────────
 
     def record_tool_success(self, agent_id: str, session_key: str) -> None:
-        if not self.config.get("trust", {}).get("enabled", True):
+        if not self._trust_enabled:
             return
         self.trust_manager.record_success(agent_id)
         self.session_trust.apply_signal(session_key, agent_id, "success")
@@ -224,7 +280,7 @@ class GovernanceEngine:
     # ── status & trust API ───────────────────────────────────────────
 
     def policy_count(self) -> int:
-        return len({p["id"] for p in self.policy_index.all})
+        return self.policy_index.unique_policy_count
 
     def get_status(self) -> dict:
         return {
@@ -234,6 +290,8 @@ class GovernanceEngine:
             "auditEnabled": self.config.get("audit", {}).get("enabled", True),
             "failMode": self.config.get("failMode", "open"),
             "stats": self.stats.to_dict(),
+            "stageMs": self.timer.stages_ms(),
+            "stageCounts": self.timer.counts(),
         }
 
     def get_trust(self, agent_id: Optional[str] = None, session_key: Optional[str] = None):
@@ -249,11 +307,3 @@ class GovernanceEngine:
     def set_trust(self, agent_id: str, score: float) -> None:
         self.trust_manager.set_score(agent_id, score)
 
-    def _update_stats(self, action: str, us: int) -> None:
-        self.stats.total_evaluations += 1
-        if action == "deny":
-            self.stats.deny_count += 1
-        else:
-            self.stats.allow_count += 1
-        n = self.stats.total_evaluations
-        self.stats.avg_evaluation_us = (self.stats.avg_evaluation_us * (n - 1) + us) / n

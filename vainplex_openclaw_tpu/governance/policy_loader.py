@@ -105,7 +105,9 @@ def build_policy_index(policies: list[Policy]) -> PolicyIndex:
                 by_agent.setdefault(agent, []).append(policy)
         else:
             unscoped.append(policy)
-    return PolicyIndex(all=policies, by_hook=by_hook, by_agent=by_agent, unscoped=unscoped)
+    return PolicyIndex(all=policies, by_hook=by_hook, by_agent=by_agent,
+                       unscoped=unscoped,
+                       unique_policy_count=len({p["id"] for p in policies}))
 
 
 def policies_for(index: PolicyIndex, agent_id: str, hook: str) -> list[Policy]:

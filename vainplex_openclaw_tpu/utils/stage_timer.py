@@ -45,6 +45,15 @@ class StageTimer:
             self._ms[name] = self._ms.get(name, 0.0) + ms
             self._counts[name] = self._counts.get(name, 0) + 1
 
+    def add_many(self, items) -> None:
+        """Accumulate several (name, ms) pairs under one lock round-trip —
+        for per-request pipelines (governance enforcement) where six
+        separate acquisitions would tax the path being attributed."""
+        with self._lock:
+            for name, ms in items:
+                self._ms[name] = self._ms.get(name, 0.0) + ms
+                self._counts[name] = self._counts.get(name, 0) + 1
+
     def stages_ms(self, precision: int = 2) -> dict:
         """Fresh {stage: rounded ms} dict in stage-entry order."""
         with self._lock:
