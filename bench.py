@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from typing import Optional
 
 
 def synth_events(n_chains: int = 400) -> list[dict]:
@@ -229,7 +230,9 @@ def policy_eval_stage_records(stage_ms: dict) -> list[dict]:
     return _stage_records("policy_eval_stage_ms", stage_ms)
 
 
-def _bench_policy_eval(metric: str, user_policies: list, n: int) -> dict:
+def _bench_policy_eval(metric: str, user_policies: list, n: int,
+                       plugin_config_extra: Optional[dict] = None,
+                       post=None) -> dict:
     import os
     import tempfile
 
@@ -242,7 +245,8 @@ def _bench_policy_eval(metric: str, user_policies: list, n: int) -> dict:
             os.environ["OPENCLAW_HOME"] = os.path.join(ws, "home")
             gw = Gateway(config={"workspace": ws, "agents": [{"id": "main"}]})
             plugin = GovernancePlugin(workspace=ws)
-            gw.load(plugin, plugin_config={"policies": user_policies})
+            gw.load(plugin, plugin_config={"policies": user_policies,
+                                           **(plugin_config_extra or {})})
             gw.start()
             ctx = {"agent_id": "main", "session_key": "agent:main:s"}
             gw.before_tool_call("exec", {"command": "ls -la /tmp"}, ctx)  # warmup
@@ -251,6 +255,7 @@ def _bench_policy_eval(metric: str, user_policies: list, n: int) -> dict:
                 gw.before_tool_call("exec", {"command": f"ls -la /tmp/dir{i}"}, ctx)
             dt_ms = (time.perf_counter() - t0) * 1000.0 / n
             stage_ms = plugin.engine.timer.stages_ms()
+            extra = post(plugin) if post is not None else {}
             gw.stop()
     finally:
         # An exception mid-bench must not leak a deleted-tempdir OPENCLAW_HOME
@@ -262,7 +267,20 @@ def _bench_policy_eval(metric: str, user_policies: list, n: int) -> dict:
     baseline_ms = 5.0
     return {"metric": metric, "value": round(dt_ms, 4), "unit": "ms",
             "vs_baseline": round(baseline_ms / dt_ms, 1),  # >1 = faster than budget
-            "stage_ms": stage_ms}
+            "stage_ms": stage_ms, **extra}
+
+
+def _bench_user_policies() -> list:
+    """Ten regex-gated audit policies — the compiled planner folds them into
+    one prefilter bank (shared by the latency, deny, and degraded variants)."""
+    return [
+        {"id": f"p{i}", "priority": 50 + i, "scope": {"hooks": ["before_tool_call"]},
+         "rules": [{"action": "audit",
+                    "conditions": [{"type": "tool", "tools": ["exec"],
+                                    "params": {"command":
+                                               {"matches": f"pattern-{i}-[a-z]+"}}}]}]}
+        for i in range(10)
+    ]
 
 
 def bench_policy_eval(n: int = 5_000) -> dict:
@@ -272,15 +290,27 @@ def bench_policy_eval(n: int = 5_000) -> dict:
     them into one prefilter bank); after the first minute's budget the
     builtin rate limiter denies, so the steady state also exercises the
     trust-violation + audit deny path."""
-    user_policies = [
-        {"id": f"p{i}", "priority": 50 + i, "scope": {"hooks": ["before_tool_call"]},
-         "rules": [{"action": "audit",
-                    "conditions": [{"type": "tool", "tools": ["exec"],
-                                    "params": {"command":
-                                               {"matches": f"pattern-{i}-[a-z]+"}}}]}]}
-        for i in range(10)
-    ]
-    return _bench_policy_eval("policy_eval_latency", user_policies, n)
+    return _bench_policy_eval("policy_eval_latency", _bench_user_policies(), n)
+
+
+def bench_policy_eval_degraded(n: int = 3_000) -> dict:
+    """Degraded-mode variant (ISSUE 4): every audit day-file append fails
+    under an installed FaultPlan, so each evaluation pays the fallback path —
+    flush failure accounting, bounded buffer retention with spill, flush
+    backoff. The headline claim is that enforcement latency stays bounded
+    when the durability anchor is down; the record carries the audit
+    degradation counters so the bench line doubles as a recovery-path
+    assertion (flushFailures > 0 proves the faults really fired)."""
+    from vainplex_openclaw_tpu.resilience import FaultPlan, FaultSpec, installed
+
+    plan = FaultPlan([FaultSpec("audit.append", rate=1.0)], seed=7)
+    with installed(plan):
+        rec = _bench_policy_eval(
+            "policy_eval_latency_degraded", _bench_user_policies(), n,
+            plugin_config_extra={"audit": {"maxBufferedRecords": 500}},
+            post=lambda p: {"audit": p.engine.audit_trail.stats()})
+    rec["faults_fired"] = plan.total_fired()
+    return rec
 
 
 def bench_policy_eval_deny(n: int = 5_000) -> dict:
@@ -896,8 +926,8 @@ if __name__ == "__main__":
     except Exception as exc:  # noqa: BLE001 — diagnosable, not fatal
         print(f"force-cpu pin failed: {exc}", file=sys.stderr)
     for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval,
-               bench_policy_eval_deny, bench_knowledge_ingest,
-               bench_knowledge_search):
+               bench_policy_eval_deny, bench_policy_eval_degraded,
+               bench_knowledge_ingest, bench_knowledge_search):
         try:
             rec = fn()
             print(f"secondary: {json.dumps(rec)}", file=sys.stderr)

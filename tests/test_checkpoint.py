@@ -94,6 +94,87 @@ class TestCheckpointRoundTrip:
         assert after <= before, f"fd leak: {before} -> {after}"
 
 
+class TestInterruptedSaveRestore:
+    """Resilience coverage (ISSUE 4): a save killed at any point must leave
+    either a complete checkpoint or nothing restorable — partial files on
+    disk may never poison the next load or the next save."""
+
+    TREE = None  # built lazily; jax arrays shouldn't outlive module import
+
+    def _tree(self):
+        return {"w": jnp.arange(6.0).reshape(2, 3),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def test_stale_partials_from_a_dead_process_are_invisible(self, tmp_path):
+        tree = self._tree()
+        save_checkpoint(str(tmp_path), tree, step=1)
+        # Simulate a writer that died mid-save: tmp litter, an orphan
+        # manifest without its .npz, and a garbage tmp blob.
+        (tmp_path / "step-2.manifest.json").write_text('{"step": 2}')
+        (tmp_path / "abc123.npz.tmp").write_bytes(b"\x00\x01 not an npz")
+        (tmp_path / "def456.json.tmp").write_text("{")
+        assert all_steps(str(tmp_path)) == [1]
+        assert latest_step(str(tmp_path)) == 1
+        restored = restore_checkpoint(str(tmp_path), like=tree)
+        assert _leaves_equal(tree, restored)
+
+    def test_fault_before_rename_leaves_nothing_then_retry_lands(self, tmp_path):
+        from vainplex_openclaw_tpu.resilience import (
+            FaultError, FaultPlan, FaultSpec, installed)
+
+        tree = self._tree()
+        with installed(FaultPlan([FaultSpec("checkpoint.write", steps=(1,))],
+                                 seed=0)):
+            with pytest.raises(FaultError):
+                save_checkpoint(str(tmp_path), tree, step=5)
+        import os
+        assert all_steps(str(tmp_path)) == []
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+        # The interrupted save must not poison the retry at the same step.
+        save_checkpoint(str(tmp_path), tree, step=5)
+        assert all_steps(str(tmp_path)) == [5]
+        assert _leaves_equal(tree, restore_checkpoint(str(tmp_path), like=tree))
+
+    def test_fault_between_renames_keeps_manifest_first_invariant(self, tmp_path):
+        """The atomic-rename ordering contract: the manifest lands BEFORE the
+        .npz, so a crash between the two renames leaves an orphan manifest
+        (harmless — all_steps keys on the .npz) and never a visible .npz
+        without its manifest (which would break bf16 dtype recovery)."""
+        from vainplex_openclaw_tpu.resilience import (
+            FaultError, FaultPlan, FaultSpec, installed)
+
+        tree = self._tree()
+        with installed(FaultPlan([FaultSpec("checkpoint.rename", steps=(1,))],
+                                 seed=0)):
+            with pytest.raises(FaultError):
+                save_checkpoint(str(tmp_path), tree, step=7)
+        assert all_steps(str(tmp_path)) == []  # no torn step visible
+        assert latest_step(str(tmp_path)) is None
+        assert (tmp_path / "step-7.manifest.json").exists()  # orphan, inert
+        assert not (tmp_path / "step-7.npz").exists()
+        # Retry overwrites the orphan manifest and completes normally.
+        save_checkpoint(str(tmp_path), tree, step=7)
+        assert all_steps(str(tmp_path)) == [7]
+        restored = restore_checkpoint(str(tmp_path), like=tree)
+        assert _leaves_equal(tree, restored)
+
+    def test_interrupted_save_does_not_break_resume_from_prior_step(self, tmp_path):
+        from vainplex_openclaw_tpu.resilience import (
+            FaultError, FaultPlan, FaultSpec, installed)
+
+        tree = self._tree()
+        save_checkpoint(str(tmp_path), tree, step=1)
+        newer = {"w": tree["w"] + 100.0, "step": jnp.asarray(2, jnp.int32)}
+        with installed(FaultPlan([FaultSpec("checkpoint.rename", steps=(1,))],
+                                 seed=0)):
+            with pytest.raises(FaultError):
+                save_checkpoint(str(tmp_path), newer, step=2)
+        # Latest restorable state is still step 1, bit-exact.
+        assert latest_step(str(tmp_path)) == 1
+        restored = restore_checkpoint(str(tmp_path), like=tree)
+        assert _leaves_equal(tree, restored)
+
+
 class TestBitExactResume:
     def test_train_resume_equivalence(self, tmp_path):
         """train 4 steps straight  ≡  train 2, checkpoint, restore, train 2 —

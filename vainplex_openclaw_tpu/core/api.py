@@ -30,7 +30,35 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional, Protocol, Union
 
+from ..resilience.policy import CircuitBreaker
+
 HookHandler = Callable[..., Union[Any, Awaitable[Any]]]
+
+# Per-plugin error budget defaults (ISSUE 4): a plugin failing ≥90% of its
+# last-minute handler calls, with at least 25 failures, is *degraded* — its
+# handlers are skipped (visibly: counters + one log line per transition)
+# until a recovery probe succeeds. Generous on purpose: the budget exists to
+# shed a plugin that is broken, not one that is merely unlucky.
+PLUGIN_BREAKER_DEFAULTS = {
+    "failureThreshold": 25,
+    "failureRate": 0.9,
+    "windowS": 60.0,
+    "recoveryS": 30.0,
+    "halfOpenMax": 1,
+}
+
+# Hooks whose handlers may carry a verdict or scrub content before it leaves
+# the process (enforcement deny, response gate, redaction of tool results).
+# Shedding one of these FAILS OPEN — a denied tool call would silently run,
+# a secret would persist unredacted — so the error budget never sheds them:
+# their failures still count (the plugin shows degraded in status, and its
+# handlers on other hooks shed), but these handlers always run.
+NEVER_SHED_HOOKS = frozenset({
+    "before_tool_call",
+    "message_sending",
+    "before_message_write",
+    "tool_result_persist",
+})
 
 # Hooks whose handlers must be synchronous (results are needed inline, before
 # the gateway writes the message).
@@ -157,6 +185,7 @@ class _Registration:
 class HookStats:
     fired: int = 0
     errors: int = 0
+    skipped: int = 0  # handlers shed because their plugin's breaker was open
     last_fired_at: Optional[float] = None
     last_error: Optional[str] = None
 
@@ -169,7 +198,8 @@ class HookBus:
     gets them for free.
     """
 
-    def __init__(self, logger: Optional[PluginLogger] = None, clock: Callable[[], float] = time.time):
+    def __init__(self, logger: Optional[PluginLogger] = None, clock: Callable[[], float] = time.time,
+                 breaker_config: Optional[dict] = None):
         self._handlers: dict[str, list[_Registration]] = {}
         self._snapshots: dict[str, list[_Registration]] = {}
         self._async_memo: dict[str, bool] = {}
@@ -177,6 +207,51 @@ class HookBus:
         self._logger = logger or make_logger("hook-bus")
         self._clock = clock
         self.stats: dict[str, HookStats] = {}
+        # Per-(plugin, hook) error-budget breakers — per hook, not per
+        # plugin, so one broken handler can't be masked by the same plugin's
+        # healthy traffic on OTHER hooks (and a never-shed hook's successes
+        # can't close a half-open breaker that a sheddable hook tripped).
+        # ``breaker_config`` merges over PLUGIN_BREAKER_DEFAULTS;
+        # {"enabled": False} disables shedding entirely (errors are still
+        # logged and counted, seed behavior).
+        cfg = dict(PLUGIN_BREAKER_DEFAULTS)
+        cfg.update(breaker_config or {})
+        self._breaker_cfg = cfg if cfg.get("enabled", True) else None
+        self.breakers: dict[tuple[str, str], CircuitBreaker] = {}
+
+    def _breaker_for(self, plugin_id: str, hook_name: str) -> Optional[CircuitBreaker]:
+        cfg = self._breaker_cfg
+        if cfg is None:
+            return None
+        key = (plugin_id, hook_name)
+        br = self.breakers.get(key)
+        if br is None:
+            br = self.breakers[key] = CircuitBreaker(
+                failure_threshold=int(cfg["failureThreshold"]),
+                failure_rate=float(cfg["failureRate"]),
+                window_s=float(cfg["windowS"]),
+                recovery_s=float(cfg["recoveryS"]),
+                half_open_max=int(cfg["halfOpenMax"]),
+                clock=self._clock)
+        return br
+
+    def _record_handler_failure(self, br: Optional[CircuitBreaker],
+                                plugin_id: str, hook_name: str, err: str) -> None:
+        if br is None:
+            return
+        was_open = br.state == "open"
+        br.record_failure(err)
+        if not was_open and br.state == "open":
+            shed = ("handlers shed" if hook_name not in NEVER_SHED_HOOKS
+                    else "never shed (verdict-bearing hook), failures visible")
+            self._logger.error(
+                f"[hook-bus] plugin '{plugin_id}' DEGRADED on '{hook_name}': "
+                f"error budget exhausted ({br.failures} failures), {shed} "
+                f"for {br.recovery_s:.0f}s (last: {err})")
+
+    def degraded_plugins(self) -> list[str]:
+        return sorted({pid for (pid, _), br in self.breakers.items()
+                       if br.state != "closed"})
 
     def on(self, hook_name: str, handler: HookHandler, priority: int = 100, plugin_id: str = "?") -> None:
         self._seq += 1
@@ -225,13 +300,16 @@ class HookBus:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _record(self, hook_name: str, error: Optional[str], n_errors: int = 0) -> None:
+    def _record(self, hook_name: str, error: Optional[str], n_errors: int = 0,
+                n_skipped: int = 0) -> None:
         st = self.stats.setdefault(hook_name, HookStats())
         st.fired += 1
         st.last_fired_at = self._clock()
         if n_errors:
             st.errors += n_errors
             st.last_error = error
+        if n_skipped:
+            st.skipped += n_skipped
 
     async def fire(
         self,
@@ -250,7 +328,13 @@ class HookBus:
         results: list[Any] = []
         err: Optional[str] = None
         n_errors = 0
+        n_skipped = 0
         for reg in self.handlers_for(hook_name):
+            br = self._breaker_for(reg.plugin_id, hook_name)
+            if (br is not None and hook_name not in NEVER_SHED_HOOKS
+                    and not br.allow()):
+                n_skipped += 1
+                continue
             try:
                 out = reg.handler(*args)
                 if inspect.isawaitable(out):
@@ -265,14 +349,17 @@ class HookBus:
                 n_errors += 1
                 err = f"{reg.plugin_id}/{hook_name}: {exc}"
                 self._logger.error(f"[hook-bus] handler error in {err}")
+                self._record_handler_failure(br, reg.plugin_id, hook_name, err)
                 continue
+            if br is not None:
+                br.record_success()
             if out is not None:
                 results.append(out)
                 if on_result is not None:
                     on_result(out)
                 if until is not None and until(out):
                     break
-        self._record(hook_name, err, n_errors)
+        self._record(hook_name, err, n_errors, n_skipped)
         return results
 
     def fire_sync(
@@ -294,8 +381,14 @@ class HookBus:
         results: list[Any] = []
         err: Optional[str] = None
         n_errors = 0
+        n_skipped = 0
         try:
             for reg in self.handlers_for(hook_name):
+                br = self._breaker_for(reg.plugin_id, hook_name)
+                if (br is not None and hook_name not in NEVER_SHED_HOOKS
+                        and not br.allow()):
+                    n_skipped += 1
+                    continue
                 try:
                     out = reg.handler(*args)
                     if inspect.isawaitable(out):
@@ -320,12 +413,21 @@ class HookBus:
                                 f"async gateway entry points"
                             )
                 except SyncDispatchInAsyncContext:
-                    raise  # fail loud: dropping a verdict here would fail open
+                    # Fail loud (dropping a verdict here would fail open) —
+                    # but settle the breaker first: a half-open probe slot
+                    # consumed by allow() with no record_* afterwards would
+                    # wedge the breaker in half-open forever.
+                    if br is not None:
+                        br.record_failure("awaitable during sync dispatch")
+                    raise
                 except Exception as exc:  # noqa: BLE001
                     n_errors += 1
                     err = f"{reg.plugin_id}/{hook_name}: {exc}"
                     self._logger.error(f"[hook-bus] handler error in {err}")
+                    self._record_handler_failure(br, reg.plugin_id, hook_name, err)
                     continue
+                if br is not None:
+                    br.record_success()
                 if out is not None:
                     results.append(out)
                     if on_result is not None:
@@ -333,7 +435,7 @@ class HookBus:
                     if until is not None and until(out):
                         break
         finally:
-            self._record(hook_name, err, n_errors)
+            self._record(hook_name, err, n_errors, n_skipped)
         return results
 
 

@@ -85,7 +85,9 @@ class Gateway:
         self.config = config or {}
         self.logger = logger or make_logger("gateway")
         self.clock = clock
-        self.bus = HookBus(self.logger, clock=clock)
+        self.bus = HookBus(
+            self.logger, clock=clock,
+            breaker_config=(self.config.get("resilience") or {}).get("pluginBreaker"))
         self.plugins: dict[str, _LoadedPlugin] = {}
         self.services: list[tuple[str, PluginService]] = []
         self.commands: dict[str, PluginCommand] = {}
@@ -330,3 +332,23 @@ class Gateway:
         if handler is None:
             raise KeyError(f"unknown gateway method: {method}")
         return handler(*args)
+
+    # ── status ───────────────────────────────────────────────────────
+
+    def get_status(self) -> dict:
+        """Degradation surface (ISSUE 4): which plugins are shedding, which
+        hooks skipped handlers, and every tripped breaker's counters."""
+        hooks = {name: {"fired": st.fired, "errors": st.errors,
+                        "skipped": st.skipped}
+                 for name, st in self.bus.stats.items()}
+        breakers: dict[str, dict] = {}
+        for (pid, hook), br in self.bus.breakers.items():
+            if br.failures or br.state != "closed":
+                breakers.setdefault(pid, {})[hook] = br.stats()
+        return {
+            "started": self._started,
+            "plugins": sorted(self.plugins),
+            "degraded": self.bus.degraded_plugins(),
+            "breakers": breakers,
+            "hooks": hooks,
+        }

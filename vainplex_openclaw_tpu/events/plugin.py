@@ -166,14 +166,14 @@ class EventStorePlugin:
         t = self.transport
         if t is None:
             return {"enabled": False}
-        return {
-            "enabled": True,
-            "healthy": t.healthy(),
-            "published": t.stats.published,
-            "publish_failures": t.stats.publish_failures,
-            "last_error": t.stats.last_error,
-            "transport": type(t).__name__,
-        }
+        out = {"enabled": True, "healthy": t.healthy(),
+               "transport": type(t).__name__}
+        # Full resilience counter surface (ISSUE 4): outbox/reconnect state
+        # from the NATS adapter, torn-tail/quarantine counts from the file
+        # log, plus the base published/failure counters every transport has.
+        stats_dict = getattr(t, "stats_dict", None)
+        out.update(stats_dict() if stats_dict is not None else t.stats())
+        return out
 
     def status_text(self) -> str:
         s = self.status()

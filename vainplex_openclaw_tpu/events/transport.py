@@ -29,7 +29,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterator, Optional, Protocol
 
-from ..storage.atomic import daily_jsonl_name, jsonl_dumps
+from ..resilience.faults import maybe_fail, write_with_faults
+from ..storage.atomic import daily_jsonl_name, jsonl_dumps, repair_torn_tail
 from .envelope import ClawEvent
 from .subjects import build_subject
 
@@ -40,6 +41,35 @@ class TransportStats:
     publish_failures: int = 0
     dropped_retention: int = 0
     last_error: Optional[str] = None
+    # Resilience counters (ISSUE 4). reconnects/replayed/outbox_dropped are
+    # written by the NATS adapter's outbox; corrupt_lines/torn_tails/
+    # quarantined_files by FileTransport's recovery paths.
+    reconnects: int = 0
+    replayed: int = 0
+    outbox_dropped: int = 0
+    corrupt_lines: int = 0
+    torn_tails: int = 0
+    quarantined_files: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "published": self.published,
+            "publish_failures": self.publish_failures,
+            "dropped_retention": self.dropped_retention,
+            "last_error": self.last_error,
+            "reconnects": self.reconnects,
+            "replayed": self.replayed,
+            "outbox_dropped": self.outbox_dropped,
+            "corrupt_lines": self.corrupt_lines,
+            "torn_tails": self.torn_tails,
+            "quarantined_files": self.quarantined_files,
+        }
+
+    # ``transport.stats`` stays the live counter object every existing caller
+    # reads attributes off; making it *callable* also satisfies the
+    # ``transport.stats()`` dict contract without a second name.
+    def __call__(self) -> dict:
+        return self.to_dict()
 
 
 class EventTransport(Protocol):
@@ -114,6 +144,7 @@ class MemoryTransport:
 
     def publish(self, subject: str, event: ClawEvent) -> bool:
         try:
+            maybe_fail("transport.publish")
             self._seq += 1
             event.seq = self._seq
             # repr is ~3x cheaper than json.dumps and retention accounting
@@ -210,7 +241,8 @@ class _FileEntry:
     (the seed's behavior) instead of holding history in memory forever.
     """
 
-    __slots__ = ("mtime", "size", "offset", "count", "max_seq", "records")
+    __slots__ = ("mtime", "size", "offset", "count", "max_seq", "records",
+                 "corrupt", "parsed_any", "tail_len")
 
     def __init__(self) -> None:
         self.mtime = 0.0
@@ -219,6 +251,9 @@ class _FileEntry:
         self.count = 0  # records with a positive seq (what fetch/count see)
         self.max_seq = 0
         self.records: Optional[list[tuple[int, str, dict]]] = []
+        self.corrupt = 0      # complete-but-unparseable lines seen in this file
+        self.parsed_any = False
+        self.tail_len = 0     # bytes past the last newline (torn/in-flight tail)
 
 
 def _parse_jsonl_record(line: bytes) -> Optional[tuple[int, str, dict]]:
@@ -296,6 +331,11 @@ class FileTransport:
         self.clock = clock
         self.stats = TransportStats()
         self._index: dict[Path, _FileEntry] = {}
+        # True when the current day file may end mid-line: after a failed
+        # append in THIS process, and at startup (a crashed previous writer
+        # leaves a torn tail this process would otherwise merge its first
+        # record into). The first publish newline-isolates it.
+        self._tail_dirty = True
         self._seq = self._recover_seq()
 
     def _recover_seq(self) -> int:
@@ -320,21 +360,34 @@ class FileTransport:
                 path.parent.mkdir(parents=True, exist_ok=True)
                 fh = path.open("a", encoding="utf-8")
             with fh:
-                fh.write(line)
+                if self._tail_dirty:
+                    if not repair_torn_tail(path):
+                        # Repair failed: appending now would concatenate this
+                        # record onto the torn tail and corrupt BOTH.
+                        raise OSError("torn tail unrepaired; append deferred")
+                    self._tail_dirty = False
+                write_with_faults("transport.publish", fh.write, line)
             self.stats.published += 1
             return True
         except Exception as exc:  # noqa: BLE001
             self.stats.publish_failures += 1
             self.stats.last_error = str(exc)
+            # The failed write may have landed a partial line; the next
+            # publish newline-isolates it so one torn record can't merge
+            # with (and corrupt) the record appended after it.
+            self._tail_dirty = True
             return False
 
     def _refresh_file(self, path: Path) -> Optional[_FileEntry]:
         try:
+            maybe_fail("transport.fetch")
             st = path.stat()
         except OSError:
-            return None
+            # Unreadable this round (including injected fetch faults): serve
+            # what the index already has rather than crashing the consumer.
+            return self._index.get(path)
         entry = self._index.get(path)
-        if entry is not None and st.st_size == entry.offset:
+        if entry is not None and st.st_size == entry.offset + entry.tail_len:
             return entry  # fully parsed — nothing new
         if entry is None or st.st_size < entry.offset:
             entry = _FileEntry()  # new file, or rewritten shorter: reparse
@@ -346,14 +399,21 @@ class FileTransport:
         except OSError:
             return entry
         # Parse complete lines only; a trailing partial line (a concurrent
-        # writer mid-append) stays unconsumed until it gains its newline.
+        # writer mid-append, or a torn final write) stays unconsumed — it is
+        # tracked as the file's tail, never an error.
         end = chunk.rfind(b"\n")
         if end == -1:
-            return entry
+            entry.tail_len = len(chunk)
+            entry.mtime, entry.size = st.st_mtime, st.st_size
+            return self._maybe_quarantine(path, entry)
         for line in chunk[:end].split(b"\n"):
             parsed = _parse_jsonl_record(line)
             if parsed is None:
+                if line.strip():
+                    entry.corrupt += 1
+                    self.stats.corrupt_lines += 1
                 continue
+            entry.parsed_any = True
             seq = parsed[0]
             if entry.records is not None:
                 entry.records.append(parsed)
@@ -362,8 +422,33 @@ class FileTransport:
                 if seq > entry.max_seq:
                     entry.max_seq = seq
         entry.offset += end + 1
+        entry.tail_len = len(chunk) - (end + 1)
         entry.mtime, entry.size = st.st_mtime, st.st_size
-        return entry
+        return self._maybe_quarantine(path, entry)
+
+    def _maybe_quarantine(self, path: Path, entry: _FileEntry) -> Optional[_FileEntry]:
+        """Move a file aside when its *entire* parsed span is garbage: at
+        least one complete line, none of them records. A healthy file with a
+        few corrupt lines keeps serving (bad payloads are skipped and
+        counted); a wholly-corrupt file would otherwise be re-scanned on
+        every fetch forever. The rename drops it out of the ``*.jsonl`` glob
+        while preserving the bytes for post-mortem."""
+        if entry.parsed_any or entry.corrupt == 0 or entry.offset == 0:
+            return entry
+        if entry.tail_len:
+            # An unterminated tail may be a concurrent writer mid-append of
+            # a perfectly good record — renaming now would strand its
+            # O_APPEND handle on the quarantined inode and silently lose
+            # everything it writes next. Only fully-terminated garbage
+            # qualifies.
+            return entry
+        try:
+            path.rename(path.with_name(path.name + ".quarantined"))
+        except OSError:
+            return entry  # rename failed: keep serving the (empty) entry
+        self.stats.quarantined_files += 1
+        self._index.pop(path, None)
+        return None
 
     # Bound on raw records held in memory across all files: beyond it the
     # OLDEST files drop to offset-only entries (streamed from disk on fetch)
@@ -380,6 +465,9 @@ class FileTransport:
                 seen.append((f, entry))
         for stale in [p for p in self._index if p not in present]:
             del self._index[stale]
+        # Gauge, not a counter: files currently ending in a partial line
+        # (torn final write, or a concurrent writer mid-append).
+        self.stats.torn_tails = sum(1 for _, e in seen if e.tail_len > 0)
         cached = sum(len(e.records) for _, e in seen if e.records is not None)
         for _, entry in seen[:-1]:  # newest file always stays cached
             if cached <= self.MAX_CACHED_RECORDS:

@@ -17,6 +17,7 @@ from collections import deque
 from typing import Callable, Optional
 from urllib.parse import quote
 
+from ...resilience.policy import RetryPolicy
 from ...storage.atomic import read_json
 
 CODE_RE = re.compile(r"\b(\d{6})\b")
@@ -44,12 +45,20 @@ def _default_http_get(url: str, headers: dict, timeout: float = 10.0) -> dict:
 class MatrixPoller:
     def __init__(self, creds: dict, on_code: Callable[[str, str], None],
                  logger, interval_s: float = 2.0,
-                 http_get: Callable = _default_http_get):
+                 http_get: Callable = _default_http_get,
+                 retry: Optional[RetryPolicy] = None):
         self.creds = creds
         self.on_code = on_code
         self.logger = logger
         self.interval_s = interval_s
         self.http_get = http_get
+        # Transient homeserver hiccups (ISSUE 4): a flaky poll retries with
+        # short backoff *inside* the tick instead of silently losing up to
+        # interval_s of approval latency per blip. The whole-tick failure
+        # path still never kills the loop.
+        self.retry = retry or RetryPolicy(max_attempts=3, base_delay_s=0.25,
+                                          max_delay_s=2.0, seed=0)
+        self.polls = 0
         self._since: Optional[str] = None
         self._seen: deque[str] = deque(maxlen=SEEN_CAP)
         self._seen_set: set[str] = set()
@@ -73,9 +82,26 @@ class MatrixPoller:
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:
-                self.poll_once()
+                self.poll_with_retry()
             except Exception as exc:  # noqa: BLE001 — keep polling through transient failures
                 self.logger.warn(f"[2fa] Matrix poll failed: {exc}")
+
+    def poll_with_retry(self) -> int:
+        """One tick under the retry policy; raises only when the whole
+        attempt budget is spent (the loop logs and keeps polling)."""
+        self.polls += 1
+        return self.retry.call(
+            self.poll_once,
+            on_retry=lambda attempt, exc: self.logger.warn(
+                f"[2fa] Matrix poll failed (attempt "
+                f"{attempt + 1}/{self.retry.max_attempts}, retrying): {exc}"))
+
+    def stats(self) -> dict:
+        # Failure counters live on the RetryPolicy — one source of truth:
+        # a giveup IS a failed poll, and last_error covers retried blips too.
+        rs = self.retry.stats
+        return {"polls": self.polls, "pollFailures": rs.giveups,
+                "retries": rs.retries, "lastError": rs.last_error}
 
     def _messages_url(self, query: str) -> str:
         base = self.creds["homeserver"].rstrip("/")

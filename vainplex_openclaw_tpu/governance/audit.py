@@ -16,11 +16,17 @@ import time
 from pathlib import Path
 from typing import Callable, Optional
 
-from ..storage.atomic import append_jsonl, jsonl_dumps, read_jsonl
+from ..resilience.faults import maybe_fail, write_with_faults
+from ..storage.atomic import (append_jsonl, jsonl_dumps, read_jsonl,
+                              repair_torn_tail)
 from .types import MatchedPolicy
 from .util import ALTERNATION_UNSAFE
 
 FLUSH_THRESHOLD = 100
+# On persistent flush failure the buffer keeps at most this many records
+# (configurable via audit.maxBufferedRecords); beyond it the OLDEST are
+# dropped and counted as spilled — bounded memory, no silent loss.
+MAX_BUFFERED_RECORDS = 10_000
 
 # Audit ids are correlation ids, not capability tokens: a PRNG-backed UUID4
 # (seeded from os.urandom once) keeps the format while dropping the per-record
@@ -133,6 +139,16 @@ class AuditTrail:
         self.scrubber = None
         self.buffer: list[dict] = []
         self.today_count = 0
+        self.max_buffered = int(self.config.get("maxBufferedRecords",
+                                                MAX_BUFFERED_RECORDS))
+        self.flush_failures = 0
+        self.spilled = 0
+        self.last_flush_error: Optional[str] = None
+        # Flush gate with failure backoff: after a failed flush the next
+        # attempt waits for FLUSH_THRESHOLD *more* records — re-encoding the
+        # whole retained buffer on every record during an outage would turn
+        # a disk failure into an O(n²) CPU failure on the verdict path.
+        self._next_flush_len = FLUSH_THRESHOLD
         # Per-second / per-day caches and the controls memo: every record
         # was re-running strftime, gmtime, and a sorted() over an almost
         # always identical controls set.
@@ -194,7 +210,7 @@ class AuditTrail:
         }
         self.buffer.append(rec)
         self.today_count += 1
-        if len(self.buffer) >= FLUSH_THRESHOLD:
+        if len(self.buffer) >= self._next_flush_len:
             self.flush()
         return rec
 
@@ -216,8 +232,35 @@ class AuditTrail:
                 for day, records in by_day.items():
                     append_jsonl(self.audit_dir / f"{day}.jsonl", records)
             self.buffer = []
+            self._next_flush_len = FLUSH_THRESHOLD
         except OSError as exc:
-            self.logger.error(f"Audit flush failed: {exc}")
+            self._flush_failed(exc)
+
+    def _flush_failed(self, exc: OSError) -> None:
+        """Durability fallback (ISSUE 4): the audit log is the governance
+        pipeline's anchor, so a failed day-file write must neither crash the
+        verdict path nor grow the buffer without bound nor lose records
+        silently. Records are retained for the next flush attempt up to
+        ``max_buffered``; beyond that the oldest are dropped AND counted.
+        Delivery is at-least-once: a failure mid-batch may leave part of the
+        batch on disk and rewrite it next flush (duplicates over loss)."""
+        self.flush_failures += 1
+        self.last_flush_error = str(exc)
+        self.logger.error(f"Audit flush failed (#{self.flush_failures}, "
+                          f"buffered={len(self.buffer)}): {exc}")
+        # The handle may point at a half-written line or a dead fd — drop it
+        # so the next attempt reopens (and tail-repairs) cleanly.
+        if self._day_fh is not None and not self._day_fh.closed:
+            try:
+                self._day_fh.close()
+            except OSError:
+                pass
+        self._day_fh, self._day_name = None, ""
+        overflow = len(self.buffer) - self.max_buffered
+        if overflow > 0:
+            del self.buffer[:overflow]
+            self.spilled += overflow
+        self._next_flush_len = len(self.buffer) + FLUSH_THRESHOLD
 
     def _append_day(self, day: str, records: list[dict]) -> None:
         """Append via a persistent per-day handle: reopening the same daily
@@ -244,8 +287,17 @@ class AuditTrail:
             except FileNotFoundError:
                 path.parent.mkdir(parents=True, exist_ok=True)
                 fh = path.open("a", encoding="utf-8")
+            # A torn tail from an earlier failed write (this process or a
+            # crashed predecessor) must be newline-isolated before the batch
+            # lands, or the first retried record merges into it and BOTH are
+            # lost. An uninspectable tail fails the flush instead — records
+            # stay buffered for retry.
+            if not repair_torn_tail(path):
+                fh.close()
+                raise OSError("audit tail unrepaired; append deferred")
             self._day_fh, self._day_name = fh, day
-        fh.write("".join(jsonl_dumps(rec) + "\n" for rec in records))
+        write_with_faults("audit.append", fh.write,
+                          "".join(jsonl_dumps(rec) + "\n" for rec in records))
         fh.flush()
 
     def query(self, verdict: Optional[str] = None, agent_id: Optional[str] = None,
@@ -279,4 +331,6 @@ class AuditTrail:
                     pass
 
     def stats(self) -> dict:
-        return {"today": self.today_count, "buffered": len(self.buffer)}
+        return {"today": self.today_count, "buffered": len(self.buffer),
+                "spilled": self.spilled, "flushFailures": self.flush_failures,
+                "lastFlushError": self.last_flush_error}

@@ -292,6 +292,9 @@ class GovernanceEngine:
             "stats": self.stats.to_dict(),
             "stageMs": self.timer.stages_ms(),
             "stageCounts": self.timer.counts(),
+            # Degradation must be *visible* (ISSUE 4): spilled/retained audit
+            # records and flush failures ride every status read.
+            "audit": self.audit_trail.stats(),
         }
 
     def get_trust(self, agent_id: Optional[str] = None, session_key: Optional[str] = None):

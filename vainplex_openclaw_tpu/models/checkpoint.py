@@ -33,6 +33,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..resilience.faults import maybe_fail
+
 _STEP_RE = re.compile(r"^step-(\d+)\.npz$")
 _UINT_BY_ITEMSIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
@@ -107,6 +109,7 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: Optional[int] = None,
             with os.fdopen(fd, "wb") as f:
                 fd = None
                 np.savez(f, **arrays)
+                maybe_fail("checkpoint.write")  # chaos: die before any rename
         finally:
             # An early failure (e.g. non-JSON-serializable metadata) must not
             # leak the raw fd that was never wrapped (ADVICE r2).
@@ -114,6 +117,7 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: Optional[int] = None,
                 if leaked is not None:
                     os.close(leaked)
         os.replace(mtmp, os.path.join(ckpt_dir, f"step-{step}.manifest.json"))
+        maybe_fail("checkpoint.rename")  # chaos: die between the two renames
         os.replace(tmp, final)
     except BaseException:
         for t in (tmp, mtmp):

@@ -1,0 +1,37 @@
+"""Resilience layer: retry/backoff policies, circuit breakers, and
+deterministic fault injection for every serving edge (ISSUE 4).
+
+Stdlib-only by design — every subsystem (storage, events, governance, core,
+models) may import this package without creating cycles.
+"""
+
+from .faults import (
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    active_plan,
+    clear_plan,
+    install_plan,
+    installed,
+    maybe_fail,
+    wrap_clock,
+    write_with_faults,
+)
+from .policy import CircuitBreaker, CircuitOpenError, RetryPolicy, RetryStats
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "FaultError",
+    "FaultPlan",
+    "FaultSpec",
+    "RetryPolicy",
+    "RetryStats",
+    "active_plan",
+    "clear_plan",
+    "install_plan",
+    "installed",
+    "maybe_fail",
+    "wrap_clock",
+    "write_with_faults",
+]

@@ -1,0 +1,183 @@
+"""Deterministic, seed-driven fault injection for the serving edges.
+
+Recovery code that is only exercised when production actually breaks is
+hoped-for, not tested. This registry turns every interesting I/O edge into a
+named *fault site* — ``"transport.publish"``, ``"audit.append"``,
+``"file.rename"``, ``"checkpoint.rename"``, … — that consults the installed
+:class:`FaultPlan` before doing the real work. A plan decides failures from
+``(seed, site, per-site call index)`` only, so a chaos run is bit-reproducible:
+same seed → same faults on the same calls, regardless of interleaving across
+sites.
+
+Fault modes:
+
+- ``"error"`` — the site raises :class:`FaultError` (an ``OSError`` subclass,
+  so existing ``except OSError`` recovery paths handle it like a real fs/broker
+  failure).
+- ``"torn"`` — write sites that route through :func:`write_with_faults` write
+  a deterministic *prefix* of the payload and then raise, simulating a torn
+  write (crash mid-append, full disk, yanked volume).
+
+When no plan is installed every hook is a single module-global ``None`` check —
+nothing here may tax the hot paths it instruments.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from fnmatch import fnmatchcase
+from typing import Callable, Optional
+
+
+class FaultError(OSError):
+    """An injected fault. Subclasses OSError so production recovery paths
+    (``except OSError``) treat it exactly like the failure it simulates."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One rule: fail calls to sites matching ``site`` (fnmatch pattern,
+    e.g. ``"transport.*"``) on the given 1-based ``steps`` and/or at a
+    seeded probabilistic ``rate``."""
+
+    site: str
+    steps: tuple = ()
+    rate: float = 0.0
+    mode: str = "error"  # "error" | "torn"
+    message: str = "injected fault"
+
+
+class FaultPlan:
+    """A seeded schedule of faults over named sites.
+
+    ``fired`` maps site → count of injected faults (observability: chaos
+    tests assert both that faults actually fired and that the counts are
+    identical across reruns with the same seed).
+    """
+
+    def __init__(self, specs: list, seed: int = 0):
+        self.seed = seed
+        self.specs = list(specs)
+        self.fired: dict[str, int] = {}
+        self._calls: dict[str, int] = {}
+        self._rngs: dict[str, random.Random] = {}
+        # Sites are hit from multiple threads (debounce timers, pollers);
+        # the schedule must stay deterministic per site, not per thread.
+        self._lock = threading.Lock()
+
+    def _rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            # str seeding uses the stable sha512 path (PYTHONHASHSEED-proof).
+            rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
+        return rng
+
+    def decide(self, site: str) -> Optional[FaultSpec]:
+        """Consume one call step at ``site``; return the spec to apply, if
+        any. Each call draws at most one uniform variate per matching rate
+        spec, in spec order — the draw sequence is part of the contract."""
+        with self._lock:
+            idx = self._calls.get(site, 0) + 1
+            self._calls[site] = idx
+            hit: Optional[FaultSpec] = None
+            for spec in self.specs:
+                if not fnmatchcase(site, spec.site):
+                    continue
+                if idx in spec.steps:
+                    hit = hit or spec
+                elif spec.rate and self._rng(site).random() < spec.rate:
+                    hit = hit or spec
+            if hit is not None:
+                self.fired[site] = self.fired.get(site, 0) + 1
+            return hit
+
+    def torn_cut(self, site: str, nbytes: int) -> int:
+        """Deterministic cut point for a torn write of ``nbytes``."""
+        if nbytes <= 1:
+            return 0
+        with self._lock:
+            return self._rng(f"{site}#cut").randrange(nbytes)
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def total_fired(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def clear_plan() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+@contextmanager
+def installed(plan: FaultPlan):
+    """``with installed(FaultPlan([...], seed=7)) as plan: ...``"""
+    install_plan(plan)
+    try:
+        yield plan
+    finally:
+        clear_plan()
+
+
+def maybe_fail(site: str) -> None:
+    """The universal hook: no-op without a plan; raises FaultError when the
+    plan schedules a fault here (torn specs degrade to plain errors at sites
+    that don't route writes through ``write_with_faults``)."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    spec = plan.decide(site)
+    if spec is not None:
+        raise FaultError(f"[fault:{site}] {spec.message}")
+
+
+def write_with_faults(site: str, write: Callable, data) -> None:
+    """Write hook for sites that support torn-write simulation: on a
+    ``"torn"`` spec a deterministic prefix of ``data`` is written before the
+    raise, leaving exactly the partial-line damage the recovery paths must
+    absorb."""
+    plan = _ACTIVE
+    if plan is None:
+        write(data)
+        return
+    spec = plan.decide(site)
+    if spec is None:
+        write(data)
+        return
+    if spec.mode == "torn":
+        cut = plan.torn_cut(site, len(data))
+        if cut:
+            write(data[:cut])
+        raise FaultError(f"[fault:{site}] torn write at byte {cut}/{len(data)}")
+    raise FaultError(f"[fault:{site}] {spec.message}")
+
+
+def wrap_clock(clock: Callable[[], float], site: str = "clock"):
+    """A clock that consults the plan: chosen ticks raise (a time source can
+    fail too — NTP death, VM pause detection). Sites that cache per-second
+    state must survive it."""
+
+    def faulty_clock() -> float:
+        maybe_fail(site)
+        return clock()
+
+    return faulty_clock

@@ -3,6 +3,7 @@
 from .atomic import (
     AtomicStorage,
     Debouncer,
+    JsonlReadReport,
     append_jsonl,
     read_json,
     read_jsonl,
@@ -13,6 +14,7 @@ from .workspace import is_file_older_than, is_writable, reboot_dir
 __all__ = [
     "AtomicStorage",
     "Debouncer",
+    "JsonlReadReport",
     "append_jsonl",
     "is_file_older_than",
     "is_writable",

@@ -10,22 +10,56 @@ Reference conventions rebuilt here once instead of per-package:
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
 import time
+import weakref
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable, Iterator, Optional
 
+from ..resilience.faults import maybe_fail, write_with_faults
 
-def write_json_atomic(path: str | Path, obj: Any, indent: Optional[int] = 2) -> None:
+
+def write_json_atomic(path: str | Path, obj: Any, indent: Optional[int] = 2,
+                      durable: bool = False) -> None:
+    """Tmp-then-rename atomic write. ``durable=True`` additionally fsyncs the
+    tmp file *before* the rename (and best-effort fsyncs the directory after),
+    so a machine crash can't replace ``path`` with a rename that points at
+    never-flushed data — the torn-state rename ordering bug (ISSUE 4)."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(path.name + f".tmp{os.getpid()}")
     separators = (",", ":") if indent is None else None
-    tmp.write_text(json.dumps(obj, indent=indent, separators=separators,
-                              ensure_ascii=False, default=str), encoding="utf-8")
-    os.replace(tmp, path)
+    data = json.dumps(obj, indent=indent, separators=separators,
+                      ensure_ascii=False, default=str)
+    try:
+        with tmp.open("w", encoding="utf-8") as fh:
+            write_with_faults("file.write", fh.write, data)
+            if durable:
+                fh.flush()
+                maybe_fail("file.fsync")
+                os.fsync(fh.fileno())
+        maybe_fail("file.rename")
+        os.replace(tmp, path)
+    except BaseException:
+        # A failed write must not litter tmp files next to live state.
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        raise
+    if durable:
+        try:  # directory fsync makes the rename itself durable (POSIX)
+            dfd = os.open(path.parent, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # not supported everywhere; the file fsync stands
+            pass
 
 
 def read_json(path: str | Path, default: Any = None) -> Any:
@@ -65,22 +99,111 @@ def append_jsonl(path: str | Path, records: list[Any]) -> None:
         path.parent.mkdir(parents=True, exist_ok=True)
         fh = path.open("a", encoding="utf-8")
     with fh:
-        fh.write(payload)
+        write_with_faults("file.append", fh.write, payload)
 
 
-def read_jsonl(path: str | Path) -> Iterator[Any]:
+@dataclass
+class JsonlReadReport:
+    """Filled by ``read_jsonl`` when passed: what the reader skipped.
+    ``torn_tail`` is the unparseable final line *without* a trailing newline
+    (a writer died mid-append); corrupt lines are complete lines that fail
+    to parse (bit rot, interleaved writers); ``read_error`` records a file
+    that could not be opened at all (permissions, EIO) — an unreadable log
+    must never be indistinguishable from an empty one."""
+
+    records: int = 0
+    corrupt_lines: int = 0
+    torn_tail: Optional[str] = None
+    read_error: Optional[str] = None
+
+
+def read_jsonl(path: str | Path,
+               report: Optional[JsonlReadReport] = None) -> Iterator[Any]:
+    """Yield parseable records. A torn final line (no trailing newline, not
+    valid JSON) is never an error: complete records still stream, and the
+    tail is reported via ``report`` instead of being silently conflated with
+    mid-file corruption. A *parseable* unterminated tail is a complete
+    record that merely lost its newline — it is yielded.
+
+    A missing file reads as empty (seed parity). Any OTHER open failure is
+    recorded on ``report`` and swallowed, or re-raised when no report was
+    passed — a report-less caller must not silently read EIO as empty."""
     path = Path(path)
-    if not path.exists():
+    try:
+        fh = path.open("rb")
+    except FileNotFoundError:
         return
-    with path.open("r", encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
+    except OSError as exc:
+        if report is None:
+            raise
+        report.read_error = str(exc)
+        return
+    # Streamed, not slurped: audit queries walk day files that can be large,
+    # and only the FINAL line can lack its newline — so the tail case is
+    # detectable per-line without buffering the file.
+    with fh:
+        for raw in fh:
+            if not raw.strip():
                 continue
+            terminated = raw.endswith(b"\n")
             try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
+                rec = json.loads(raw)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                if report is None:
+                    continue
+                if terminated:
+                    report.corrupt_lines += 1
+                else:
+                    report.torn_tail = raw.decode("utf-8", errors="replace")
                 continue
+            if report is not None:
+                report.records += 1
+            yield rec
+
+
+def repair_torn_tail(path: str | Path) -> bool:
+    """Newline-terminate a torn final line so the next append can't
+    concatenate a good record onto the partial one (corrupting both). The
+    isolated torn prefix parses as ONE corrupt line — counted and skipped by
+    ``read_jsonl``.
+
+    Safe under this package's write discipline — every writer emits a line
+    (or batch) in a single ``write()`` call, so a partial final line can only
+    be a *tear* (crash, full disk), never a live writer that will come back
+    to finish it.
+
+    Returns True when appending is safe (repaired, already terminated, or no
+    file); False when the tail could not be inspected — appending blind
+    would cause exactly the corruption this exists to prevent.
+    """
+    try:
+        with Path(path).open("rb+") as fh:
+            fh.seek(0, 2)
+            if fh.tell() > 0:
+                fh.seek(-1, 2)
+                if fh.read(1) != b"\n":
+                    fh.write(b"\n")
+    except FileNotFoundError:
+        return True  # nothing to repair
+    except OSError:
+        return False
+    return True
+
+
+# Debouncers with pending work at interpreter exit used to lose it: the
+# daemon timer thread dies with the process. One atexit hook flushes every
+# live debouncer (weakly referenced — registration must not keep dead
+# stores alive); flush failures are swallowed, exit paths can't raise.
+_LIVE_DEBOUNCERS: "weakref.WeakSet[Debouncer]" = weakref.WeakSet()
+
+
+@atexit.register
+def _flush_live_debouncers() -> None:  # pragma: no cover — exercised manually
+    for deb in list(_LIVE_DEBOUNCERS):
+        try:
+            deb.flush()
+        except Exception:  # noqa: BLE001 — interpreter is going down
+            pass
 
 
 class Debouncer:
@@ -88,6 +211,9 @@ class Debouncer:
 
     ``wall=False`` (tests) never starts a timer thread; callers drive it via
     ``flush()``. With ``wall=True`` a daemon timer fires after ``delay_s``.
+    ``stop()`` cancels the timer and flushes pending work; pending work also
+    flushes at interpreter exit (a 15 s save debounce must not turn a clean
+    shutdown into silent data loss).
     """
 
     def __init__(self, fn: Callable[[], None], delay_s: float, wall: bool = True):
@@ -97,6 +223,7 @@ class Debouncer:
         self._timer: Optional[threading.Timer] = None
         self._pending = False
         self._lock = threading.Lock()
+        _LIVE_DEBOUNCERS.add(self)
 
     def trigger(self) -> None:
         with self._lock:
@@ -118,6 +245,11 @@ class Debouncer:
                 return
             self._pending = False
         self._fn()
+
+    def stop(self) -> None:
+        """Shutdown: cancel any armed timer and flush pending work."""
+        self.flush()
+        _LIVE_DEBOUNCERS.discard(self)
 
     @property
     def pending(self) -> bool:
@@ -151,6 +283,10 @@ class AtomicStorage:
     def flush_all(self) -> None:
         for deb in self._debouncers.values():
             deb.flush()
+
+    def stop(self) -> None:
+        for deb in self._debouncers.values():
+            deb.stop()
 
 
 def daily_jsonl_name(ts: Optional[float] = None) -> str:
