@@ -1,0 +1,414 @@
+"""Randomized equivalence suite pinning the compiled cortex ingest path to
+its interpreter oracles (ISSUE 5).
+
+Three layers, mirroring tests/test_governance_plan_equiv.py:
+- signal extraction: bank-screened ``extract_signals`` / ``detect_mood``
+  must produce IDENTICAL ``ThreadSignals`` / moods to the verbatim per-regex
+  walks (``extract_signals_interp`` / ``detect_mood_interp``) on randomized
+  multilingual messages (CJK included), across multi-pack selections and
+  custom ``extend``/``override`` pattern sets;
+- tracker state: a compiled tracker trio and an interpreter trio
+  (``compiled=False`` — naive ``matches_thread`` walks end-to-end) replaying
+  the same interleaved create/close/decide/wait/mood/prune/LLM-merge/resolve
+  sequence must leave BIT-IDENTICAL threads.json / decisions.json /
+  commitments.json (ids pinned by seeding the PRNG id stream, timestamps by
+  FakeClock) — ≥200 randomized sequences;
+- the ``compiledPatterns: false`` config escape hatch restores the
+  interpreter path end-to-end through the plugin.
+"""
+
+import json
+import random
+import uuid
+
+import pytest
+
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.cortex import storage as cortex_storage
+from vainplex_openclaw_tpu.utils import ids
+from vainplex_openclaw_tpu.cortex.commitment_tracker import CommitmentTracker
+from vainplex_openclaw_tpu.cortex.decision_tracker import DecisionTracker
+from vainplex_openclaw_tpu.cortex.patterns import (
+    MOODS,
+    MergedPatterns,
+    resolve_language_codes,
+)
+from vainplex_openclaw_tpu.cortex.thread_tracker import (
+    ThreadTracker,
+    extract_signals,
+    extract_signals_interp,
+    matches_thread,
+)
+
+from helpers import FakeClock
+
+# ── randomized multilingual corpus ───────────────────────────────────
+
+FRAGMENTS = [
+    # decisions
+    "we decided to use postgres", "the plan is to ship tonight",
+    "approach: rewrite the worker", "wir haben beschlossen zu migrieren",
+    "on a décidé de migrer", "hemos decidido borrar la tabla",
+    "foi decidido apagar tudo", "abbiamo deciso di cancellare",
+    "我们决定用新方案", "最终选择了简单方案", "移行すると決めました",
+    "방침은 단순화입니다", "мы решили мигрировать",
+    # closures
+    "that's done", "it works now", "ist erledigt", "das funktioniert",
+    "c'est fait", "ya está hecho", "está feito", "è fatto", "搞定了",
+    "完了しました", "완료했습니다", "уже готово", "all solved ✅",
+    # waits
+    "waiting for the review", "blocked by infra", "need approval first",
+    "warten auf den upload", "en attente de validation",
+    "esperando a seguridad", "aguardando o deploy", "in attesa di conferma",
+    "等待审批", "依存しています", "기다리고 있습니다", "ждём ответа",
+    # topics
+    "back to the database migration", "let's talk about the auth rotation",
+    "regarding the billing rework", "zurück zu der migration",
+    "revenons à la facturation", "volviendo a la seguridad",
+    "parliamo di deploy", "关于 安全 的问题", "部署について", "보안 에 관해",
+    "насчёт стратегии",
+    # moods
+    "this sucks", "awesome work", "careful, risky", "deployed and shipped",
+    "what if we try", "mist, schon wieder", "génial", "cuidado",
+    "perfekt gebaut", "太好了", "最悪です", "대박", "отлично", "🚀 go",
+    "⚠️ beware", "✅", "🤔 hmm",
+    # commitments
+    "I'll deploy the fix tomorrow", "let me check the logs",
+    "ich werde das morgen bauen", "I will get it done quickly",
+    # neutral / junk / edge
+    "the sky is blue", "lunch at noon", "nothing special here", "ok thanks",
+    "der ordner ist leer", "la carpeta está vacía", "普通的消息", "ただの雑談",
+    "그냥 메시지", "обычный текст", "zzz qqq", "it that this them", "a b c d",
+    "İstanbul trip planning", "Σigma rollout notes",  # fold-unsafe chars
+    "we decıded to go", "it is ſolved", "ﬆill pending",  # sre equivalences
+    "рѣшено дѣло", "ᲀот так",  # historic-Cyrillic equivalence classes
+]
+
+WORDS = ["alpha", "beta", "gamma", "delta", "rollout", "cache", "index",
+         "queue", "tisch", "mesa", "stratégie", "安全", "部署", "보안", "кеш",
+         "flag", "probe", "shard", "бюджет", "massa", "undecided", "reworks"]
+
+
+def random_message(rng: random.Random) -> str:
+    parts = []
+    for _ in range(rng.randrange(1, 4)):
+        if rng.random() < 0.6:
+            parts.append(rng.choice(FRAGMENTS))
+        else:
+            parts.append(" ".join(rng.choice(WORDS)
+                                  for _ in range(rng.randrange(2, 6))))
+    sep = "\n" if rng.random() < 0.1 else " "
+    return sep.join(parts)
+
+
+# (languages, customPatterns) — override/extend, invalid and backref-unsafe
+# customs, CJK-only selections.
+CONFIGS = [
+    ("all", None),
+    ("both", None),
+    (["zh", "ja", "ko"], None),
+    (["en", "fr", "ru"], None),
+    ("all", {"decision": [r"ship it:\s*\w+", r"(dup)\1ed"], "mode": "extend"}),
+    (["en"], {"decision": [r"rollout locked"], "close": [r"finito basta"],
+              "wait": [r"parked until\s+\w+"],
+              "topic": [r"re:\s+(\w[\w\s-]{3,40})"],
+              "mode": "override", "blacklist": ["zzz qqq"],
+              "keywords": ["shard"]}),
+    (["en", "de"], {"decision": ["[invalid(("], "mode": "extend"}),
+]
+
+
+def build_patterns(languages, custom, compiled):
+    return MergedPatterns(resolve_language_codes(languages), custom,
+                          logger=list_logger(), compiled=compiled)
+
+
+# ── extraction equivalence ───────────────────────────────────────────
+
+
+@pytest.mark.parametrize("languages,custom", CONFIGS)
+def test_extract_and_mood_equivalence(languages, custom):
+    compiled = build_patterns(languages, custom, compiled=True)
+    interp = build_patterns(languages, custom, compiled=False)
+    assert compiled.compiled and not interp.compiled
+    rng = random.Random(f"extract:{languages}:{custom}")
+    for _ in range(300):
+        text = random_message(rng)
+        assert extract_signals(text, compiled) == \
+            extract_signals_interp(text, interp), text
+        assert compiled.detect_mood(text) == interp.detect_mood_interp(text), text
+
+
+def test_fold_unsafe_texts_bypass_screens():
+    """İ and Σ lower()/fold differently than regex IGNORECASE — those texts
+    must take the walk-everything path and still agree with the oracle."""
+    p = build_patterns("all", {"decision": [r"İstanbul plan"],
+                               "close": [r"Σigma done"]}, compiled=True)
+    for text in ("the İstanbul plan is decided", "Σigma done and dusted",
+                 "İΣ mixed decided to ship", "plain ascii decided to ship"):
+        assert extract_signals(text, p) == extract_signals_interp(text, p), text
+        assert p.detect_mood(text) == p.detect_mood_interp(text), text
+
+
+def test_sre_equivalence_classes_guarded():
+    """sre IGNORECASE folds beyond str.lower() through its case-equivalence
+    table (ı↔i, ſ↔s, ς↔σ, historic Cyrillic ↔ modern, …) — regression for
+    the screened path silently dropping matches the interpreter finds
+    (found in review: 'decıded' matches the en decision regex but 'decided'
+    is not a substring of the lowered text)."""
+    import sre_compile
+
+    p = build_patterns("all", None, compiled=True)
+    assert extract_signals("we decıded to use the simpler approach", p).decisions
+    for text in ("we decıded to go now", "the issue is ſolved today",
+                 "рѣшено дѣло сделано", "everything decided"):
+        assert extract_signals(text, p) == extract_signals_interp(text, p), text
+        assert p.detect_mood(text) == p.detect_mood_interp(text), text
+    # every sre equivalence class must keep at most ONE unguarded member —
+    # two unguarded siblings could meet as screen-literal vs text and break
+    # the miss-is-proof invariant
+    from vainplex_openclaw_tpu.cortex.patterns import _fold_unsafe
+    for cls in getattr(sre_compile, "_equivalences", ()):
+        unguarded = [hex(c) for c in cls if not _fold_unsafe(chr(c))]
+        assert len(unguarded) <= 1, (cls, unguarded)
+
+
+def test_banks_screen_most_members():
+    """The builtin packs must actually be screenable — an extractor
+    regression that silently dumps everything into ``unscreened`` would
+    revert the hot path to interpreter cost without failing equivalence."""
+    p = build_patterns("all", None, compiled=True)
+    for cat in ("decision", "close", "wait", "topic"):
+        bank = p.prefilter[cat]
+        assert bank.literals, cat
+        assert not bank.unscreened, cat
+    for mood, bank in p.mood_banks:
+        assert bank.literals, mood
+    assert [m for m, _ in p.mood_banks] == list(MOODS)  # priority order kept
+
+
+def test_backref_pattern_never_screened():
+    p = build_patterns("en", {"decision": [r"(echo)\1 chamber"],
+                              "mode": "extend"}, compiled=True)
+    bank = p.prefilter["decision"]
+    assert any(rx.pattern == r"(echo)\1 chamber" for rx in bank.unscreened)
+    # and it still fires through the screened path
+    s = extract_signals("an echoecho chamber moment", p)
+    assert any("echoecho chamber" in d for d in s.decisions)
+
+
+# ── tracker state equivalence (bit-identical JSON) ───────────────────
+
+
+def run_tracker_sequence(ws, patterns, seed: int):
+    """Replay one randomized interleaved sequence; return the raw bytes of
+    all three tracker state files."""
+    ids._ID_RNG.seed(seed)  # pin the shared PRNG id stream
+    clock = FakeClock(1_700_000_000.0)
+    rng = random.Random(seed)
+    tt = ThreadTracker(ws, {"pruneDays": 2, "maxThreads": 7}, patterns,
+                       list_logger(), clock)
+    dt = DecisionTracker(ws, {"dedupeWindowHours": 1}, patterns,
+                         list_logger(), clock)
+    ct = CommitmentTracker(ws, {"overdueDays": 1}, list_logger(), clock,
+                           wall_timers=False)
+    for _ in range(rng.randrange(3, 7)):
+        msg = random_message(rng)
+        sender = rng.choice(["user", "agent"])
+        tt.process_message(msg, sender)
+        dt.process_message(msg, sender)
+        ct.process_message(msg, sender)
+        if rng.random() < 0.35:
+            clock.advance(rng.choice([1, 60, 3600, 90_000, 260_000]))
+        if rng.random() < 0.2:
+            tt.apply_llm_analysis({
+                "threads": [{"title": " ".join(rng.choice(WORDS)
+                                               for _ in range(3)),
+                             "status": "open", "summary": "llm"}],
+                "closures": [random_message(rng)],
+                "mood": rng.choice(["neutral", "excited", "tense"])})
+        if rng.random() < 0.2 and ct.commitments:
+            ct.resolve(rng.choice(ct.commitments)["id"])
+    tt.flush(), dt.flush(), ct.flush()
+    out = []
+    for name in ("threads.json", "decisions.json", "commitments.json"):
+        p = ws / "memory" / "reboot" / name
+        out.append(p.read_bytes() if p.exists() else b"")
+    return out
+
+
+@pytest.mark.parametrize("languages,custom", CONFIGS)
+def test_tracker_state_bit_identical(languages, custom, tmp_path):
+    """≥200 randomized sequences across the configs (7 configs × 30 seeds):
+    compiled (indexed matching + banks) and interpreter (naive
+    matches_thread walks) trackers must write byte-identical state."""
+    compiled = build_patterns(languages, custom, compiled=True)
+    interp = build_patterns(languages, custom, compiled=False)
+    for seed in range(30):
+        ws_a = tmp_path / f"a{seed}"
+        ws_b = tmp_path / f"b{seed}"
+        got_a = run_tracker_sequence(ws_a, compiled, seed)
+        got_b = run_tracker_sequence(ws_b, interp, seed)
+        assert got_a == got_b, f"state diverged for seed {seed}"
+        assert got_a[0], "sequence produced no thread state"
+
+
+def test_indexed_matching_agrees_with_naive_oracle(tmp_path):
+    """Direct pin of the inverted index against matches_thread on the live
+    thread list after a busy sequence."""
+    patterns = build_patterns("all", None, compiled=True)
+    tt = ThreadTracker(tmp_path, {"pruneDays": 7, "maxThreads": 30}, patterns,
+                       list_logger(), FakeClock())
+    rng = random.Random(99)
+    for _ in range(40):
+        tt.process_message(random_message(rng), "user")
+    probes = [random_message(rng) for _ in range(50)] + \
+             [t["title"] for t in tt.threads]
+    for text in probes:
+        want = {id(t) for t in tt.threads if matches_thread(t["title"], text)}
+        assert tt._matched_ids(text) == want, text
+
+
+def test_index_survives_external_thread_append(tmp_path):
+    """The len-mismatch guard reindexes when someone grows the thread list
+    behind the tracker's back (tests and tools hold direct references)."""
+    patterns = build_patterns("en", None, compiled=True)
+    tt = ThreadTracker(tmp_path, {}, patterns, list_logger(), FakeClock())
+    tt.threads.append({"id": "ext-1", "title": "external payment gateway",
+                       "status": "open", "priority": "medium", "summary": "",
+                       "decisions": [], "waiting_for": None, "mood": "neutral",
+                       "last_activity": "2026-01-01T00:00:00Z",
+                       "created": "2026-01-01T00:00:00Z"})
+    tt.process_message("the external payment gateway is done", "user")
+    assert tt.threads[0]["status"] == "closed"
+
+
+# ── config escape hatch through the plugin ───────────────────────────
+
+
+def load_cortex(workspace, config=None):
+    from vainplex_openclaw_tpu.cortex import CortexPlugin
+
+    from helpers import make_gateway
+
+    gw, _logger = make_gateway()
+    plugin = CortexPlugin(workspace=str(workspace), clock=gw.clock,
+                          wall_timers=False)
+    gw.load(plugin, plugin_config={"enabled": True, **(config or {})})
+    gw.start()
+    return gw, plugin
+
+
+CTX = {"agent_id": "main", "session_key": "agent:main"}
+
+
+def test_compiled_patterns_escape_hatch(workspace, openclaw_home):
+    gw, plugin = load_cortex(workspace, {"compiledPatterns": False})
+    assert plugin.patterns.compiled is False
+    gw.message_received("let's discuss the billing rework", CTX)
+    gw.message_received("we decided to split invoices", CTX)
+    trackers = plugin.trackers(CTX)
+    assert trackers.threads.open_threads()
+    assert trackers.decisions.decisions
+
+
+def test_compiled_patterns_default_on(workspace, openclaw_home):
+    gw, plugin = load_cortex(workspace)
+    assert plugin.patterns.compiled is True
+    gw.message_received("let's discuss the metrics dashboard", CTX)
+    assert "stage ms" in plugin.status_text()
+
+
+# ── satellite regressions ────────────────────────────────────────────
+
+
+def count_saves(monkeypatch, module):
+    calls = {"n": 0}
+    real = module.save_json
+
+    def counting(path, obj, logger=None):
+        calls["n"] += 1
+        return real(path, obj, logger)
+
+    monkeypatch.setattr(module, "save_json", counting)
+    return calls
+
+
+def test_thread_flush_clears_dirty(tmp_path, monkeypatch):
+    from vainplex_openclaw_tpu.cortex import thread_tracker as module
+
+    patterns = build_patterns("en", None, compiled=True)
+    tt = ThreadTracker(tmp_path, {}, patterns, list_logger(), FakeClock())
+    tt.process_message("back to the deploy pipeline", "user")
+    calls = count_saves(monkeypatch, module)
+    tt.dirty = True
+    assert tt.flush() is True
+    assert calls["n"] == 1 and tt.dirty is False
+    assert tt.flush() is True
+    assert calls["n"] == 1  # clean flush no longer re-writes the file
+
+
+def test_commitment_flush_saves_once(tmp_path, monkeypatch):
+    from vainplex_openclaw_tpu.cortex import commitment_tracker as module
+
+    ct = CommitmentTracker(tmp_path, {}, list_logger(), FakeClock(),
+                           wall_timers=False)
+    ct.process_message("I'll rotate the api keys this week", "agent")
+    calls = count_saves(monkeypatch, module)
+    assert ct.flush() is True
+    assert calls["n"] == 1  # debouncer flush saved; no duplicate second write
+    assert ct.flush() is True
+    assert calls["n"] == 1  # nothing dirty → nothing written
+
+
+def test_status_text_uses_public_gateway_status(workspace, openclaw_home,
+                                                monkeypatch):
+    gw, plugin = load_cortex(workspace)
+    gw.message_received("let's discuss the metrics dashboard", CTX)
+    monkeypatch.setattr(gw, "get_status", lambda: {
+        "started": True, "plugins": ["cortex"], "degraded": ["cortex"],
+        "breakers": {"cortex": {"message_received": {"state": "open"}}},
+        "hooks": {"message_received": {"fired": 1, "errors": 0, "skipped": 2}},
+    })
+    text = plugin.status_text()
+    assert "hooks fired" in text
+    assert "degraded plugins: ['cortex']" in text
+    assert "message_received" in text and "open" in text
+    assert "skipped" in text
+
+
+def test_new_id_is_valid_uuid4():
+    seen = set()
+    for _ in range(200):
+        s = cortex_storage.new_id()
+        u = uuid.UUID(s)
+        assert u.version == 4 and u.variant == uuid.RFC_4122
+        seen.add(s)
+    assert len(seen) == 200
+
+
+def test_iso_now_cache_matches_gmtime_formula():
+    import time as _time
+
+    rng = random.Random(5)
+    for _ in range(200):
+        v = rng.uniform(0, 2_000_000_000)
+        t = _time.gmtime(v)
+        want = (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
+                f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+        assert cortex_storage.iso_now(lambda: v) == want
+
+
+def test_compact_state_files_still_load(tmp_path):
+    """cheap-persist writes compact JSON now; every reader goes through
+    json.loads, but pin it explicitly for the three state files."""
+    patterns = build_patterns("en", None, compiled=True)
+    clock = FakeClock()
+    tt = ThreadTracker(tmp_path, {}, patterns, list_logger(), clock)
+    tt.process_message("back to the cache layer design", "user")
+    raw = (tmp_path / "memory" / "reboot" / "threads.json").read_text()
+    data = json.loads(raw)
+    assert data["version"] == 2 and data["threads"]
+    assert "\n  " not in raw  # compact, not pretty-printed
+    tt2 = ThreadTracker(tmp_path, {}, patterns, list_logger(), clock)
+    assert tt2.threads[0]["title"] == tt.threads[0]["title"]

@@ -479,5 +479,12 @@ class PluginApi:
         ``api.registerTool`` existence before registering its 5 tools)."""
         self._gateway._register_tool(self.id, tool)
 
+    def get_gateway_status(self) -> dict:
+        """Public view of ``Gateway.get_status()`` (ISSUE 4's degradation
+        surface) so plugin status commands can report degraded/breaker state
+        for their own hooks without reaching through private gateway
+        internals (ISSUE 5 satellite)."""
+        return self._gateway.get_status()
+
     def on(self, hook_name: str, handler: HookHandler, priority: int = 100) -> None:
         self._gateway.bus.on(hook_name, handler, priority=priority, plugin_id=self.id)

@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import re
 import time
-import uuid
 from pathlib import Path
-from typing import Callable
+from typing import Callable, Optional
 
 from ..storage.atomic import Debouncer
-from .storage import ensure_reboot_dir, iso_now, load_json, reboot_dir, save_json
+from ..utils.stage_timer import StageTimer
+from .storage import ensure_reboot_dir, iso_now, load_json, new_id, reboot_dir, save_json
 
 COMMITMENT_PATTERNS = [
     re.compile(r"\bI(?:'ll| will| am going to| can)\s+((?:\w+\s*){2,12})", re.IGNORECASE),
@@ -25,11 +25,20 @@ COMMITMENT_PATTERNS = [
     re.compile(r"\bI(?:'ll| will)\s+get\s+(?:it|that|this)\s+((?:\w+\s*){1,8})", re.IGNORECASE),
 ]
 
+# One combined scan screens all four patterns (ISSUE 5, same move as the
+# MergedPatterns prefilter banks — all members are backref-free). A miss
+# proves every finditer below would come up empty, and most traffic is a
+# miss, so detect_commitments collapses to a single scan.
+_COMMIT_SCREEN = re.compile(
+    "|".join(f"(?i:{rx.pattern})" for rx in COMMITMENT_PATTERNS)).search
+
 _NON_COMMITTAL = re.compile(r"^(?:think|guess|suppose|probably|maybe|see|check if)\b",
                             re.IGNORECASE)
 
 
 def detect_commitments(text: str) -> list[str]:
+    if _COMMIT_SCREEN(text) is None:
+        return []
     out = []
     for rx in COMMITMENT_PATTERNS:
         for m in rx.finditer(text):
@@ -41,21 +50,26 @@ def detect_commitments(text: str) -> list[str]:
 
 class CommitmentTracker:
     def __init__(self, workspace: str | Path, config: dict, logger,
-                 clock: Callable[[], float] = time.time, wall_timers: bool = True):
+                 clock: Callable[[], float] = time.time, wall_timers: bool = True,
+                 timer: Optional[StageTimer] = None):
         self.config = {"enabled": True, "overdueDays": 7, "maxCommitments": 100,
                        "debounceSeconds": 15, **(config or {})}
         self.logger = logger
         self.clock = clock
+        self.timer = timer or StageTimer()
         self.path = reboot_dir(workspace) / "commitments.json"
         self.writeable = ensure_reboot_dir(workspace, logger)
         data = load_json(self.path)
         self.commitments: list[dict] = data.get("commitments") or []
+        self._dirty = False
+        self._oldest_open = None  # mark_overdue watermark; None = recompute
         self._debouncer = Debouncer(self._save_now, self.config["debounceSeconds"],
                                     wall=wall_timers)
 
     def process_message(self, content: str, sender: str = "agent") -> None:
         if not content:
             return
+        t_start = time.perf_counter()
         now = iso_now(self.clock)
         found = detect_commitments(content)
         for what in found:
@@ -68,24 +82,44 @@ class CommitmentTracker:
                 if existing["status"] == "overdue":
                     existing["status"] = "open"
                     existing["created"] = now
+                    if self._oldest_open is not None and now < self._oldest_open:
+                        self._oldest_open = now
                 continue
             self.commitments.append({
-                "id": str(uuid.uuid4()), "what": what, "sender": sender,
+                "id": new_id(), "what": what, "sender": sender,
                 "status": "open", "created": now, "resolved": None,
             })
+            if self._oldest_open is not None and now < self._oldest_open:
+                self._oldest_open = now
         n_overdue = self.mark_overdue()
         if found or n_overdue:
             if len(self.commitments) > self.config["maxCommitments"]:
                 self.commitments = self.commitments[-self.config["maxCommitments"]:]
+            self._dirty = True
             self._debouncer.trigger()
+        self.timer.add("commitments", (time.perf_counter() - t_start) * 1000.0)
 
     def mark_overdue(self) -> int:
         cutoff = iso_now(lambda: self.clock() - self.config["overdueDays"] * 86400)
+        # Watermark fast path (ISSUE 5): the oldest open creation timestamp
+        # bounds every open commitment, so while it is younger than the
+        # cutoff no transition is possible and the per-message O(commitments)
+        # scan is skipped. Any mutation that could add an older open record
+        # resets the watermark to None (recompute on next scan).
+        if self._oldest_open is not None and self._oldest_open >= cutoff:
+            return 0
         n = 0
+        oldest = None
         for c in self.commitments:
-            if c["status"] == "open" and c["created"] < cutoff:
-                c["status"] = "overdue"
-                n += 1
+            if c["status"] == "open":
+                if c["created"] < cutoff:
+                    c["status"] = "overdue"
+                    n += 1
+                elif oldest is None or c["created"] < oldest:
+                    oldest = c["created"]
+        self._oldest_open = oldest or "~"  # "~" sorts after ISO stamps: none open
+        if n:
+            self._dirty = True  # direct callers rely on flush() persisting this
         return n
 
     def resolve(self, commitment_id: str) -> bool:
@@ -93,6 +127,7 @@ class CommitmentTracker:
             if c["id"] == commitment_id and c["status"] in ("open", "overdue"):
                 c["status"] = "resolved"
                 c["resolved"] = iso_now(self.clock)
+                self._dirty = True
                 self._debouncer.trigger()
                 return True
         return False
@@ -103,10 +138,23 @@ class CommitmentTracker:
     def _save_now(self) -> None:
         if not self.writeable:
             return
-        save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
-                              "commitments": self.commitments}, self.logger)
+        t0 = time.perf_counter()
+        ok = save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
+                                   "commitments": self.commitments}, self.logger)
+        self.timer.add("persist", (time.perf_counter() - t0) * 1000.0)
+        if ok:
+            # A failed save must stay dirty so the next flush retries it —
+            # clearing unconditionally would silently drop the state the old
+            # always-write flush() used to recover.
+            self._dirty = False
 
     def flush(self) -> bool:
+        # Save once, iff there is anything to save (ISSUE 5 satellite): the
+        # debouncer's flush already runs _save_now when work is pending, and
+        # the old unconditional second _save_now() re-wrote an unchanged file
+        # on every flush. _dirty covers mutations whose debounce timer
+        # already fired and failed, or external mark_overdue transitions.
         self._debouncer.flush()
-        self._save_now()
+        if self._dirty:
+            self._save_now()
         return True

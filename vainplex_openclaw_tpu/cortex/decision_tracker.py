@@ -11,12 +11,12 @@ from __future__ import annotations
 
 import re
 import time
-import uuid
 from pathlib import Path
-from typing import Callable
+from typing import Callable, Optional
 
-from .patterns import MergedPatterns
-from .storage import ensure_reboot_dir, iso_now, load_json, reboot_dir, save_json
+from ..utils.stage_timer import StageTimer
+from .patterns import _UNSET, MergedPatterns, fold_lower
+from .storage import ensure_reboot_dir, iso_now, load_json, new_id, reboot_dir, save_json
 
 _WHY_RE = re.compile(
     r"(?:because|so that|since|weil|damit|porque|parce que|因为|なぜなら|왜냐하면)\s+(.{5,120})",
@@ -25,23 +25,38 @@ _WHY_RE = re.compile(
 
 class DecisionTracker:
     def __init__(self, workspace: str | Path, config: dict, patterns: MergedPatterns,
-                 logger, clock: Callable[[], float] = time.time):
+                 logger, clock: Callable[[], float] = time.time,
+                 timer: Optional[StageTimer] = None):
         self.config = {"enabled": True, "dedupeWindowHours": 24, "maxDecisions": 200,
                        **(config or {})}
         self.patterns = patterns
         self.logger = logger
         self.clock = clock
+        self.timer = timer or StageTimer()
         self.path = reboot_dir(workspace) / "decisions.json"
         self.writeable = ensure_reboot_dir(workspace, logger)
         data = load_json(self.path)
         self.decisions: list[dict] = data.get("decisions") or []
 
-    def process_message(self, content: str, sender: str = "user") -> None:
+    def _decision_patterns(self, content: str, low=_UNSET):
+        """Decision regexes that still need walking — screened through the
+        shared MergedPatterns required-literal bank (one lowercase + a few
+        C substring sweeps skip all members on the common no-decision
+        message; ISSUE 5), or the full list in interpreter mode."""
+        if not self.patterns.compiled:
+            return self.patterns.decision
+        if low is _UNSET:
+            low = fold_lower(content)
+        return self.patterns.prefilter["decision"].walk_list(low)
+
+    def process_message(self, content: str, sender: str = "user",
+                        low=_UNSET) -> None:
         if not content:
             return
+        t_start = time.perf_counter()
         now = iso_now(self.clock)
         added = False
-        for rx in self.patterns.decision:
+        for rx in self._decision_patterns(content, low):
             for m in rx.finditer(content):
                 start = max(0, m.start() - 50)
                 end = min(len(content), m.end() + 100)
@@ -59,7 +74,7 @@ class DecisionTracker:
                 if self._is_duplicate(full_text):
                     continue
                 self.decisions.append({
-                    "id": str(uuid.uuid4()),
+                    "id": new_id(),
                     "what": what,
                     "why": why,
                     "impact": self._infer_impact(full_text),
@@ -68,6 +83,7 @@ class DecisionTracker:
                     "timestamp": now,
                 })
                 added = True
+        self.timer.add("decisions", (time.perf_counter() - t_start) * 1000.0)
         if added:
             if len(self.decisions) > self.config["maxDecisions"]:
                 self.decisions = self.decisions[-self.config["maxDecisions"]:]
@@ -100,7 +116,7 @@ class DecisionTracker:
             if not what or self._is_duplicate(what):
                 continue
             self.decisions.append({
-                "id": str(uuid.uuid4()), "what": what, "why": None,
+                "id": new_id(), "what": what, "why": None,
                 "impact": self._infer_impact(what), "sender": sender,
                 "date": now[:10], "timestamp": now,
             })
@@ -115,8 +131,10 @@ class DecisionTracker:
     def persist(self) -> None:
         if not self.writeable:
             return
+        t0 = time.perf_counter()
         save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
                               "decisions": self.decisions}, self.logger)
+        self.timer.add("persist", (time.perf_counter() - t0) * 1000.0)
 
     def flush(self) -> bool:
         self.persist()

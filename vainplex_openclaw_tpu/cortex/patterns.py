@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import NamedTuple, Optional
+
+from ..governance.util import ALTERNATION_UNSAFE
 
 MOODS = ("frustrated", "excited", "tense", "productive", "exploratory")
 
@@ -278,6 +280,188 @@ def _compile_custom(patterns: object, category: str, logger=None) -> list[re.Pat
 _CJK = re.compile(r"[぀-ヿ㐀-鿿가-힯]")
 
 
+try:  # Python ≥3.11 moved the regex parser; 3.10 ships it as sre_parse
+    from re import _constants as _sre_c
+    from re import _parser as _sre_parse
+except ImportError:  # pragma: no cover — version-dependent import only
+    import sre_constants as _sre_c
+    import sre_parse as _sre_parse
+
+# str.lower() is the screen's case folder, but regex IGNORECASE matching
+# diverges from it in two ways: str.lower's full-casing specials (İ → "i̇",
+# Σ → context-sensitive final sigma), and sre's case-equivalence classes
+# (sre_compile._equivalences: ı↔i, ſ↔s, µ↔μ, ς↔σ, the Greek symbol
+# variants, historic-Cyrillic letter forms ↔ в/д/о/с/т/ъ/ѣ/ꙋ, …) which
+# fold characters str.lower() keeps distinct. Divergence needs TWO
+# DIFFERENT class members meeting — one in a screen literal, one in the
+# text — so soundness requires at most ONE unguarded member per class: the
+# smallest codepoint (ASCII i/s, modern Cyrillic — what the builtin pack
+# literals actually use) stays unguarded, every other member both poisons
+# screen literals and, when present in a message, bypasses the screens
+# entirely (walk all members: the always-correct, never-fast direction).
+# Screen misses stay PROOF of member misses. Built from sre's own table so
+# new interpreter versions can't silently widen IGNORECASE past the guard.
+
+
+def _build_fold_unsafe_search():
+    try:  # Python ≥3.11 moved sre_compile under re._compiler
+        from re import _compiler as sre_c
+    except ImportError:  # pragma: no cover — version-dependent import only
+        import sre_compile as sre_c
+    chars = {"İ", "Σ"}  # str.lower full-casing specials
+    for cls in getattr(sre_c, "_equivalences", ()) or ():
+        chars.update(chr(c) for c in sorted(cls)[1:])
+    return re.compile("[" + "".join(map(re.escape, sorted(chars))) + "]").search
+
+
+_FOLD_UNSAFE_SEARCH = _build_fold_unsafe_search()
+
+
+def _fold_unsafe(text: str) -> bool:
+    return _FOLD_UNSAFE_SEARCH(text) is not None
+
+
+_UNSET = object()  # "compute fold_lower(text) yourself" default
+
+
+def fold_lower(text: str) -> Optional[str]:
+    """The screen-ready lowercase of ``text``, or None when it carries
+    fold-unsafe chars (screens must be bypassed). The ingest hot path
+    computes this once per message and passes it to both
+    ``extract_signals`` and ``detect_mood`` — the guard scan and the
+    lowercase copy are not free on every-message traffic."""
+    return None if _fold_unsafe(text) else text.lower()
+
+
+def _required_literals(seq) -> Optional[list[str]]:
+    """Literal strings (lowercased) such that every match of ``seq`` contains
+    at least one — or None when no such set can be proven.
+
+    Walks the sre parse tree: a concatenation requires each of its parts, so
+    the single most selective part's literals suffice (longest-min-length set
+    wins); an alternation requires the union over branches (every branch must
+    contribute, or the whole node proves nothing); repeats count only when
+    min ≥ 1; anchors, classes, backrefs and lookarounds contribute nothing
+    but break literal runs. Literals that fold unsafely (see above) poison
+    their candidate set."""
+    candidates: list[list[str]] = []
+    run: list[str] = []
+    repeats = {_sre_c.MAX_REPEAT, _sre_c.MIN_REPEAT}
+    if hasattr(_sre_c, "POSSESSIVE_REPEAT"):  # 3.11+
+        repeats.add(_sre_c.POSSESSIVE_REPEAT)
+
+    def flush_run() -> None:
+        if not run:
+            return
+        raw = "".join(run)
+        run.clear()
+        # Fold-safety must be judged on the RAW chars: İ.lower() already
+        # expands, so checking after lowering would miss it.
+        if not _fold_unsafe(raw) and all(len(c.lower()) == 1 for c in raw):
+            candidates.append([raw.lower()])
+
+    for op, av in seq:
+        if op is _sre_c.LITERAL:
+            run.append(chr(av))
+            continue
+        flush_run()
+        sub = None
+        if op is _sre_c.SUBPATTERN:
+            sub = _required_literals(av[3])
+        elif op is _sre_c.BRANCH:
+            union: Optional[list[str]] = []
+            for branch in av[1]:
+                got = _required_literals(branch)
+                if not got:
+                    union = None
+                    break
+                union.extend(got)
+            sub = union
+        elif op in repeats:
+            if av[0] >= 1:  # traversed at least once
+                sub = _required_literals(av[2])
+        elif op is _sre_c.ASSERT:  # positive lookaround still reads the text
+            sub = _required_literals(av[1])
+        # IN/ANY/AT/NOT_LITERAL/GROUPREF/ASSERT_NOT…: prove nothing, fail
+        # nothing — the surrounding concatenation may still carry a literal.
+        if sub:
+            candidates.append(sub)
+    flush_run()
+    if not candidates:
+        return None
+    return max(candidates, key=lambda lits: min(len(l) for l in lits))
+
+
+class PrefilterBank(NamedTuple):
+    """Required-literal screen over one signal category (ISSUE 5; the same
+    miss-skips-all-members contract as governance/policy_plan.py's banks,
+    rebuilt on substring screening because CPython's re gives combined
+    alternations no Hyperscan-style literal dispatch — measured on this
+    engine, a 40-branch combined alternation scan costs MORE than 40
+    separate member scans).
+
+    ``literals`` is the union of per-member required-literal sets, swept
+    with ``lit in text.lower()`` (C substring scan, <0.1 µs each). A union
+    MISS — the common case — proves no screened member can match anywhere,
+    collapsing the walk to ``unscreened``: members that are backref-unsafe
+    (same exclusion rule as the governance banks) or yielded no provable
+    literal. A union HIT re-attributes per member through ``member_lits``
+    (parallel to ``members``; None = always walk), so typically only the one
+    or two members whose own literals are present pay a regex walk — in the
+    original member order, keeping match output identical to the
+    interpreter. ``literals`` is None when nothing could be screened.
+
+    ``ascii_literals`` is the ASCII subset of the union: a non-ASCII
+    literal can never be a substring of an ASCII message, and
+    ``str.isascii()`` is an O(1) flag check in CPython, so an ASCII message
+    sweeps only that subset — with all ten packs merged, that skips every
+    CJK/Cyrillic/accented literal (roughly half the union) on the most
+    common traffic."""
+
+    literals: Optional[tuple[str, ...]]
+    ascii_literals: tuple
+    members: tuple
+    member_lits: tuple
+    unscreened: tuple
+
+    def walk_list(self, low: Optional[str]):
+        """Members that still need their regex walked against the text.
+        ``low`` is the lowercased text, or None to bypass screening (fold-
+        unsafe text). ``any(map(low.__contains__, …))`` keeps the sweep
+        loop in C — measured ~30% over a genexp on this hot path."""
+        if low is None or self.literals is None:
+            return self.members
+        lits = self.ascii_literals if low.isascii() else self.literals
+        if not any(map(low.__contains__, lits)):
+            return self.unscreened
+        return [rx for rx, mlits in zip(self.members, self.member_lits)
+                if mlits is None or any(map(low.__contains__, mlits))]
+
+
+def _build_bank(members: list[re.Pattern]) -> PrefilterBank:
+    union: list[str] = []
+    member_lits = []
+    unscreened = []
+    for rx in members:
+        lits = None
+        if not ALTERNATION_UNSAFE.search(rx.pattern):
+            try:
+                lits = _required_literals(_sre_parse.parse(rx.pattern, rx.flags))
+            except Exception:  # noqa: BLE001 — a screen is an optimization only
+                lits = None
+        if lits:
+            union.extend(lits)
+            member_lits.append(tuple(lits))
+        else:
+            unscreened.append(rx)
+            member_lits.append(None)
+    if len(unscreened) == len(members):
+        return PrefilterBank(None, (), tuple(members), tuple(member_lits), ())
+    deduped = tuple(dict.fromkeys(union))
+    return PrefilterBank(deduped, tuple(l for l in deduped if l.isascii()),
+                         tuple(members), tuple(member_lits), tuple(unscreened))
+
+
 class MergedPatterns:
     """Pre-compiled merged view over the selected packs + custom patterns.
 
@@ -287,10 +471,18 @@ class MergedPatterns:
     ``"override"`` (a category with at least one VALID custom pattern
     replaces the builtin set for that category; empty or all-invalid custom
     lists leave the builtins alone). Reference: cortex patterns-custom
-    semantics (patterns-registry.ts / patterns-custom.test.ts)."""
+    semantics (patterns-registry.ts / patterns-custom.test.ts).
+
+    ``compiled=True`` (the default; config ``cortex.compiledPatterns``)
+    additionally builds per-category and per-mood ``PrefilterBank``s so the
+    per-message ingest hot path pays one lowercase plus a handful of C
+    substring sweeps per category instead of one regex scan per member
+    pattern (ISSUE 5). ``compiled=False`` restores the interpreter path
+    end-to-end — ``extract_signals_interp`` / ``detect_mood_interp``
+    semantics and the naive thread matching in ``ThreadTracker``."""
 
     def __init__(self, codes: list[str], custom: Optional[dict] = None,
-                 logger=None):
+                 logger=None, compiled: bool = True):
         self.codes = [c for c in codes if c in PACKS]
         packs = [PACKS[c] for c in self.codes]
         custom = custom or {}
@@ -330,7 +522,32 @@ class MergedPatterns:
             for mood, pattern in pack.moods.items():
                 self.moods[mood].append(re.compile(pattern, pack.flags))
 
-    def detect_mood(self, text: str) -> str:
+        self.compiled = bool(compiled)
+        # Banks are built even when compiled=False (load-time cost only);
+        # the flag gates DISPATCH, so flipping ``compiledPatterns`` selects
+        # a code path, never a data shape.
+        self.prefilter: dict[str, PrefilterBank] = {
+            cat: _build_bank(getattr(self, cat))
+            for cat in ("decision", "close", "wait", "topic")
+        }
+        # Mood banks preserve MOODS priority order: detect_mood answers with
+        # the FIRST mood whose bank hits, exactly like the interpreter loop.
+        self.mood_banks: tuple = tuple(
+            (mood, _build_bank(self.moods[mood])) for mood in MOODS)
+
+    def detect_mood(self, text: str, low=_UNSET) -> str:
+        if not self.compiled:
+            return self.detect_mood_interp(text)
+        if low is _UNSET:
+            low = fold_lower(text)
+        for mood, bank in self.mood_banks:
+            if any(rx.search(text) for rx in bank.walk_list(low)):
+                return mood
+        return "neutral"
+
+    def detect_mood_interp(self, text: str) -> str:
+        """Per-member interpreter walk — the equivalence oracle for
+        ``detect_mood`` (tests/test_cortex_perf_equiv.py)."""
         for mood in MOODS:
             if any(rx.search(text) for rx in self.moods[mood]):
                 return mood

@@ -18,11 +18,12 @@ from typing import Callable, Optional
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand
+from ..utils.stage_timer import StageTimer
 from .boot_context import BootContextGenerator
 from .commitment_tracker import CommitmentTracker
 from .decision_tracker import DecisionTracker
 from .llm_enhance import LlmEnhancer
-from .patterns import MergedPatterns, resolve_language_codes
+from .patterns import MergedPatterns, fold_lower, resolve_language_codes
 from .pre_compaction import PreCompaction
 from .thread_tracker import ThreadTracker
 from .tools import register_cortex_tools
@@ -32,6 +33,10 @@ DEFAULTS = {
     "workspace": None,
     "languages": "both",  # "both"=en+de, "all"=10, or explicit list
     "customPatterns": {},
+    # False restores the interpreter ingest path end-to-end (per-regex walks
+    # + naive thread matching) — the escape hatch for the compiled prefilter
+    # banks and inverted thread index (ISSUE 5).
+    "compiledPatterns": True,
     "threads": {"enabled": True, "pruneDays": 7, "maxThreads": 50},
     "decisions": {"enabled": True, "dedupeWindowHours": 24},
     "commitments": {"enabled": True, "overdueDays": 7},
@@ -56,6 +61,7 @@ MANIFEST = PluginManifest(
             "languages": {"type": ["string", "array"],
                           "items": {"type": "string"}},
             "customPatterns": {"type": "object"},
+            "compiledPatterns": {"type": "boolean"},
             "threads": enabled_section(
                 pruneDays={"type": "number", "minimum": 0},
                 maxThreads={"type": "integer", "minimum": 1}),
@@ -97,10 +103,17 @@ class _WorkspaceTrackers:
     def __init__(self, workspace: str, config: dict, patterns: MergedPatterns,
                  logger, clock, wall_timers: bool, call_llm=None):
         self.workspace = workspace
-        self.threads = ThreadTracker(workspace, config["threads"], patterns, logger, clock)
-        self.decisions = DecisionTracker(workspace, config["decisions"], patterns, logger, clock)
+        # One shared StageTimer per workspace (ISSUE 5): extract/mood/threads/
+        # decisions/commitments/persist accumulate into a single breakdown
+        # surfaced by status_text()/cortexstatus and bench.py cortex_stage_ms.
+        self.timer = StageTimer()
+        self.threads = ThreadTracker(workspace, config["threads"], patterns, logger,
+                                     clock, timer=self.timer)
+        self.decisions = DecisionTracker(workspace, config["decisions"], patterns, logger,
+                                         clock, timer=self.timer)
         self.commitments = CommitmentTracker(workspace, config["commitments"], logger,
-                                             clock, wall_timers=wall_timers)
+                                             clock, wall_timers=wall_timers,
+                                             timer=self.timer)
         self.pre_compaction = PreCompaction(workspace, config, logger, self.threads,
                                             self.decisions, self.commitments, clock)
         self.message_sent_fired = False
@@ -143,9 +156,11 @@ class CortexPlugin:
         self._api = api
         self.logger = api.logger
         codes = resolve_language_codes(self.config.get("languages"))
+        compiled = self.config.get("compiledPatterns", True)
         self.patterns = MergedPatterns(codes, self.config.get("customPatterns"),
-                                       logger=api.logger)
-        api.logger.info(f"patterns loaded: {','.join(codes)}")
+                                       logger=api.logger, compiled=compiled)
+        api.logger.info(f"patterns loaded: {','.join(codes)}"
+                        + ("" if compiled else " (interpreter path)"))
 
         api.on("message_received", self._make_ingest("user"), priority=100)
         api.on("message_sent", self._on_message_sent, priority=100)
@@ -191,10 +206,16 @@ class CortexPlugin:
     # ── hook handlers (every one fail-open) ──────────────────────────
 
     def _process(self, trackers: _WorkspaceTrackers, content: str, sender: str) -> None:
+        # One fold-guard scan + lowercase copy per message, shared by the
+        # thread AND decision trackers' prefilter screens (review catch:
+        # each tracker recomputed it on the same content).
+        low = (fold_lower(content)
+               if content and self.patterns is not None and self.patterns.compiled
+               else None)
         if self.config["threads"].get("enabled", True):
-            trackers.threads.process_message(content, sender)
+            trackers.threads.process_message(content, sender, low)
         if self.config["decisions"].get("enabled", True):
-            trackers.decisions.process_message(content, sender)
+            trackers.decisions.process_message(content, sender, low)
         if self.config["commitments"].get("enabled", True):
             trackers.commitments.process_message(content, sender)
         if trackers.enhancer is not None:
@@ -284,11 +305,24 @@ class CortexPlugin:
                          f"mood={c['mood']} events={c['events']} "
                          f"decisions={len(trackers.decisions.decisions)} "
                          f"commitments={len(trackers.commitments.open_commitments())}")
+            stage_ms = trackers.timer.stages_ms()
+            if stage_ms:
+                lines.append(f"  {ws} stage ms: {stage_ms}")
         if self._api is not None:
-            stats = self._api._gateway.bus.stats
-            fired = {h: s.fired for h, s in stats.items() if s.fired}
-            errors = {h: s.errors for h, s in stats.items() if s.errors}
+            # Public degradation surface (ISSUE 4/5): also tells the operator
+            # when the gateway is shedding cortex's own hooks.
+            status = self._api.get_gateway_status()
+            hooks = status["hooks"]
+            fired = {h: s["fired"] for h, s in hooks.items() if s["fired"]}
+            errors = {h: s["errors"] for h, s in hooks.items() if s["errors"]}
+            skipped = {h: s["skipped"] for h, s in hooks.items() if s["skipped"]}
             lines.append(f"  hooks fired: {fired}")
             if errors:
                 lines.append(f"  hook errors: {errors}")
+            if skipped:
+                lines.append(f"  hook handlers skipped: {skipped}")
+            if status["degraded"]:
+                lines.append(f"  degraded plugins: {status['degraded']}")
+            if status["breakers"].get(self.id):
+                lines.append(f"  breakers: {status['breakers'][self.id]}")
         return "\n".join(lines)

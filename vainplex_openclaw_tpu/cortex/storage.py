@@ -7,14 +7,16 @@ workspaces flip components to in-memory mode instead of crashing.
 from __future__ import annotations
 
 import time
+from functools import lru_cache
 from pathlib import Path
 from typing import Any, Optional
 
 from ..storage.atomic import read_json, write_json_atomic
 from ..storage.workspace import is_file_older_than, is_writable, reboot_dir
+from ..utils.ids import prng_uuid4
 
 __all__ = ["ensure_reboot_dir", "is_file_older_than", "load_json", "load_text",
-           "reboot_dir", "save_json", "save_text"]
+           "new_id", "reboot_dir", "save_json", "save_text"]
 
 
 def ensure_reboot_dir(workspace: str | Path, logger=None) -> bool:
@@ -29,8 +31,12 @@ def load_json(path: str | Path, default: Any = None) -> Any:
 
 
 def save_json(path: str | Path, obj: Any, logger=None) -> bool:
+    # indent=None routes through storage.atomic's prebuilt C encoder — the
+    # trackers persist on EVERY message (reference parity), and the pretty
+    # printer's pure-Python _iterencode was >20% of per-message ingest
+    # (ISSUE 5 "cheap persist"). Readers all json.loads; none pin layout.
     try:
-        write_json_atomic(path, obj)
+        write_json_atomic(path, obj, indent=None)
         return True
     except OSError as exc:
         if logger is not None:
@@ -59,7 +65,24 @@ def save_text(path: str | Path, text: str, logger=None) -> bool:
         return False
 
 
-def iso_now(clock=time.time) -> str:
-    t = time.gmtime(clock() if callable(clock) else clock)
+@lru_cache(maxsize=64)
+def _iso_from_sec(sec: int) -> str:
+    t = time.gmtime(sec)
     return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}T"
             f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}Z")
+
+
+def iso_now(clock=time.time) -> str:
+    # Per-second cache (ISSUE 5, same discipline as governance/audit.py and
+    # knowledge/fact_store.py): the trackers call this several times per
+    # message and gmtime+format was pure waste within one second. int() ==
+    # gmtime's floor for the positive epochs every caller uses; the lru keys
+    # on the second itself, so interleaved FakeClocks can't cross-pollute.
+    v = clock() if callable(clock) else clock
+    return _iso_from_sec(int(v))
+
+
+# Tracker ids are correlation ids, not capability tokens — the shared
+# PRNG-backed UUID4 drops the per-creation urandom syscall (utils/ids.py,
+# one copy serving audit, knowledge, and cortex).
+new_id = prng_uuid4

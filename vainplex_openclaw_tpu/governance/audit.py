@@ -10,13 +10,13 @@ in memory either.
 from __future__ import annotations
 
 import os
-import random
 import re
 import time
 from pathlib import Path
 from typing import Callable, Optional
 
 from ..resilience.faults import maybe_fail, write_with_faults
+from ..utils.ids import prng_uuid4
 from ..storage.atomic import (append_jsonl, jsonl_dumps, read_jsonl,
                               repair_torn_tail)
 from .types import MatchedPolicy
@@ -28,19 +28,10 @@ FLUSH_THRESHOLD = 100
 # dropped and counted as spilled — bounded memory, no silent loss.
 MAX_BUFFERED_RECORDS = 10_000
 
-# Audit ids are correlation ids, not capability tokens: a PRNG-backed UUID4
-# (seeded from os.urandom once) keeps the format while dropping the per-record
-# syscall that uuid.uuid4() pays on every evaluation.
-_ID_RNG = random.Random()
-
-
-def _record_id() -> str:
-    # Hand-formatted RFC-4122 v4 layout (version nibble 4, variant bits 10):
-    # building a uuid.UUID object just to str() it doubled the cost.
-    v = _ID_RNG.getrandbits(128)
-    v = (v & ~(0xF << 76) | (4 << 76)) & ~(0x3 << 62) | (0x2 << 62)
-    s = f"{v:032x}"
-    return f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
+# Audit ids are correlation ids, not capability tokens — the shared
+# PRNG-backed UUID4 (utils/ids.py) drops the per-record urandom syscall
+# that uuid.uuid4() pays on every evaluation.
+_record_id = prng_uuid4
 
 
 def derive_controls(matched: list[MatchedPolicy], verdict: str) -> list[str]:

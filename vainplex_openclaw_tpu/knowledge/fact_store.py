@@ -16,8 +16,6 @@ haystack per fact instead of re-lowercasing three fields per fact per call.
 
 from __future__ import annotations
 
-import os
-import random
 import threading
 import time
 import uuid
@@ -26,6 +24,7 @@ from pathlib import Path
 from typing import Callable, Optional
 
 from ..storage.atomic import AtomicStorage
+from ..utils.ids import prng_uuid4
 from ..utils.stage_timer import StageTimer
 
 DEFAULT_STORE_CONFIG = {
@@ -38,20 +37,10 @@ DEFAULT_STORE_CONFIG = {
 
 # uuid4() pays a urandom syscall per call — half the ingest budget at the
 # 2000-fact cap once dedupe is O(1). Fact ids are storage keys, not security
-# tokens, so a process-seeded PRNG with the same 122 random bits (and the
-# same RFC-4122 text shape) keeps the collision math while staying in
-# userspace. Seeded from urandom so parallel processes diverge; reseeded
-# after fork, since children would otherwise inherit the parent's PRNG
-# state and emit colliding id sequences (uuid4 was immune to this).
-_ID_RNG = random.Random(int.from_bytes(os.urandom(16), "big"))
-
-if hasattr(os, "register_at_fork"):  # POSIX only
-    os.register_at_fork(
-        after_in_child=lambda: _ID_RNG.seed(int.from_bytes(os.urandom(16), "big")))
-
-
-def _new_fact_id() -> str:
-    return str(uuid.UUID(int=_ID_RNG.getrandbits(128), version=4))
+# tokens, so the shared process-seeded PRNG (utils/ids.py: same 122 random
+# bits and RFC-4122 text shape, urandom-seeded, reseeded after fork) keeps
+# the collision math while staying in userspace.
+_new_fact_id = prng_uuid4
 
 
 @dataclass

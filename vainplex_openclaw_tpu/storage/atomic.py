@@ -22,21 +22,48 @@ from typing import Any, Callable, Iterator, Optional
 
 from ..resilience.faults import maybe_fail, write_with_faults
 
+# os.getpid() is a real syscall on sandboxed runtimes (gVisor: ~0.1 ms) and
+# the atomic writer pays it per write for the tmp-name collision guard.
+# Cache once and refresh after fork so child processes keep distinct names.
+_PID = os.getpid()
+
+
+def _refresh_pid() -> None:  # pragma: no cover — exercised via fork only
+    global _PID
+    _PID = os.getpid()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_refresh_pid)
+
 
 def write_json_atomic(path: str | Path, obj: Any, indent: Optional[int] = 2,
                       durable: bool = False) -> None:
     """Tmp-then-rename atomic write. ``durable=True`` additionally fsyncs the
     tmp file *before* the rename (and best-effort fsyncs the directory after),
     so a machine crash can't replace ``path`` with a rename that points at
-    never-flushed data — the torn-state rename ordering bug (ISSUE 4)."""
+    never-flushed data — the torn-state rename ordering bug (ISSUE 4).
+
+    ``indent=None`` (compact) encodes with the prebuilt C encoder and only
+    falls back to the ``default=str`` encoder on TypeError — per-message
+    persisters (cortex trackers, ISSUE 5) ride this path; the pretty printer
+    is pure-Python and several times slower."""
     path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    tmp = path.with_name(path.name + f".tmp{os.getpid()}")
-    separators = (",", ":") if indent is None else None
-    data = json.dumps(obj, indent=indent, separators=separators,
-                      ensure_ascii=False, default=str)
+    tmp = path.with_name(path.name + f".tmp{_PID}")
+    if indent is None:  # same encoder-and-fallback contract as JSONL appends
+        data = jsonl_dumps(obj)
+    else:
+        data = json.dumps(obj, indent=indent, ensure_ascii=False, default=str)
     try:
-        with tmp.open("w", encoding="utf-8") as fh:
+        try:
+            fh = tmp.open("w", encoding="utf-8")
+        except FileNotFoundError:
+            # mkdir only when actually needed — the steady state paid a
+            # mkdir+stat round-trip on every persist (same move as
+            # append_jsonl below).
+            path.parent.mkdir(parents=True, exist_ok=True)
+            fh = tmp.open("w", encoding="utf-8")
+        with fh:
             write_with_faults("file.write", fh.write, data)
             if durable:
                 fh.flush()
