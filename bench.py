@@ -477,6 +477,53 @@ def bench_policy_eval_deny(n: int = 5_000) -> dict:
     return _bench_policy_eval("policy_eval_latency_deny", user_policies, n)
 
 
+def bench_slo_report(n_ops: int = 2000, seed: int = 0, tenants: int = 6,
+                     saturation: float = 1.0, mode: str = "wall",
+                     admission: bool = True, watermark: int = 32) -> dict:
+    """Full-pipeline SLO report (ISSUE 6): seeded multi-tenant mixed
+    traffic (all 10 language packs, CJK/emoji, bursty arrivals, tool +
+    message mixes) offered open-loop at ``saturation`` × measured capacity,
+    with p50/p95/p99 per stage and end-to-end. ``mode="sim"`` runs the
+    same pipeline under a virtual clock + seeded service model for
+    bit-reproducible reports (the CI determinism gate)."""
+    from vainplex_openclaw_tpu.slo import run_slo_report
+
+    return run_slo_report(seed=seed, n_ops=n_ops, tenants=tenants,
+                          saturation=saturation, mode=mode,
+                          admission=admission, watermark=watermark)
+
+
+def slo_report_stage_records(report: dict) -> list[dict]:
+    """Per-(edge, stage) quantile lines for the SLO report — same
+    pre-attributed discipline as the other stage families."""
+    from vainplex_openclaw_tpu.slo import slo_stage_records
+
+    return slo_stage_records(report)
+
+
+def _slo_cli(argv: list) -> dict:
+    """``python bench.py slo_report [--seed N] [--ops N] [--tenants N]
+    [--saturation X] [--mode wall|sim] [--no-admission] [--watermark N]``"""
+    kwargs: dict = {}
+    flags = {"--seed": ("seed", int), "--ops": ("n_ops", int),
+             "--tenants": ("tenants", int),
+             "--saturation": ("saturation", float),
+             "--mode": ("mode", str), "--watermark": ("watermark", int)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--no-admission":
+            kwargs["admission"] = False
+            i += 1
+            continue
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"slo_report: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    return bench_slo_report(**kwargs)
+
+
 # Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
 # Public figures; unknown kinds report mfu: null rather than a wrong number.
 _TPU_PEAK_BF16 = (
@@ -1069,6 +1116,14 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
     except Exception as exc:  # noqa: BLE001 — diagnosable, not fatal
         print(f"force-cpu pin failed: {exc}", file=sys.stderr)
+    if len(sys.argv) > 1 and sys.argv[1] == "slo_report":
+        # Subcommand mode (ISSUE 6): ONE stdout line = the SLO report;
+        # per-stage quantile lines ride on stderr like every secondary.
+        rec = _slo_cli(sys.argv[2:])
+        for srec in slo_report_stage_records(rec):
+            print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+        print(json.dumps(rec, ensure_ascii=False))
+        sys.exit(0)
     for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval,
                bench_policy_eval_deny, bench_policy_eval_degraded,
                bench_knowledge_ingest, bench_knowledge_search,

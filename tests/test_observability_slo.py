@@ -1,0 +1,564 @@
+"""The observability plane (ISSUE 6): quantile StageTimers, admission
+control, ops sitrep collectors, and the seeded SLO harness.
+
+Four surfaces, each pinned:
+
+- histogram quantile estimates against ``numpy.percentile`` on randomized
+  samples (documented bound: the estimate interpolates inside the log2
+  bucket holding the ``method='lower'`` order statistic, so it is always
+  within a factor of 2 — in practice ~10%);
+- ``snapshot()`` adoption: one-lock reads on every status path;
+- admission control: queue-depth backpressure, per-tenant fair share,
+  NEVER_SHED verdict hooks running at any depth;
+- SLO harness: bit-identical sim reports per seed, zero verdict losses and
+  visible shedding at 2x saturation, all ten language packs exercised.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.core import Gateway, list_logger
+from vainplex_openclaw_tpu.core.api import (
+    ADMISSION_SHEDDABLE_HOOKS,
+    NEVER_SHED_HOOKS,
+)
+from vainplex_openclaw_tpu.resilience.admission import AdmissionController
+from vainplex_openclaw_tpu.sitrep.aggregator import write_sitrep
+from vainplex_openclaw_tpu.sitrep.collectors import (
+    collect_gateway,
+    collect_resilience,
+    collect_slo,
+    collect_stage_quantiles,
+)
+from vainplex_openclaw_tpu.slo import (
+    generate_workload,
+    run_slo_report,
+    slo_stage_records,
+    workload_digest,
+)
+from vainplex_openclaw_tpu.storage.atomic import read_json
+from vainplex_openclaw_tpu.utils.stage_timer import StageTimer
+
+
+# ── histogram quantiles ──────────────────────────────────────────────
+
+
+class TestHistogramQuantiles:
+    DISTRIBUTIONS = {
+        "lognormal": lambda rng: rng.lognormvariate(0.0, 1.5),
+        "uniform": lambda rng: rng.uniform(0.01, 50.0),
+        "exponential": lambda rng: rng.expovariate(0.5),
+        "bimodal": lambda rng: (rng.uniform(0.1, 0.3) if rng.random() < 0.7
+                                else rng.uniform(30.0, 90.0)),
+    }
+
+    @pytest.mark.parametrize("dist", sorted(DISTRIBUTIONS))
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_within_factor_two_of_numpy(self, dist, seed):
+        """The documented bound: estimate within [q/2, 2q] of the true
+        order statistic, every distribution, every quantile."""
+        rng = random.Random(f"{dist}:{seed}")
+        draw = self.DISTRIBUTIONS[dist]
+        samples = [draw(rng) for _ in range(4000)]
+        timer = StageTimer()
+        for s in samples:
+            timer.record("x", s)
+        est = timer.quantiles((0.5, 0.95, 0.99))["x"]
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            true = float(np.percentile(samples, q * 100, method="lower"))
+            assert true / 2 - 1e-9 <= est[key] <= true * 2 + 1e-9, (
+                f"{dist} seed={seed} {key}: est {est[key]} vs true {true}")
+
+    def test_typical_error_much_tighter_than_bound(self):
+        """Linear interpolation inside the bucket should land well inside
+        the worst case on smooth data — pin 35% so a broken interpolation
+        (e.g. always returning the bucket edge) fails loudly."""
+        rng = random.Random(42)
+        samples = [rng.lognormvariate(1.0, 1.0) for _ in range(8000)]
+        timer = StageTimer()
+        for s in samples:
+            timer.record("x", s)
+        est = timer.quantiles((0.5, 0.95, 0.99))["x"]
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            true = float(np.percentile(samples, q * 100))
+            assert abs(est[key] - true) / true < 0.35, (key, est[key], true)
+
+    def test_extremes_and_empty(self):
+        timer = StageTimer()
+        assert timer.quantiles() == {}
+        timer.record("x", 0.0)
+        timer.record("x", -1.0)      # clock skew lands in bucket 0
+        timer.record("x", 1e9)       # absurd value lands in the top bucket
+        q = timer.quantiles((0.5,))["x"]
+        assert q["p50"] >= 0.0
+
+    def test_add_many_feeds_the_same_histograms(self):
+        a, b = StageTimer(), StageTimer()
+        vals = [0.2, 1.5, 3.7, 9.1, 40.0]
+        for v in vals:
+            a.add("s", v)
+        b.add_many([("s", v) for v in vals])
+        assert a.quantiles() == b.quantiles()
+        assert a.snapshot()["counts"] == b.snapshot()["counts"]
+
+
+class TestSnapshot:
+    def test_single_lock_view_is_consistent(self):
+        ticks = iter(range(100))
+        timer = StageTimer(clock=lambda: next(ticks))
+        with timer.stage("a"):
+            pass
+        with timer.stage("b"):
+            pass
+        snap = timer.snapshot()
+        assert set(snap["stages_ms"]) == set(snap["counts"]) == set(snap["quantiles"])
+        assert snap["total_ms"] == pytest.approx(sum(snap["stages_ms"].values()))
+        assert snap["counts"] == {"a": 1, "b": 1}
+
+    def test_record_is_add(self):
+        timer = StageTimer()
+        timer.record("x", 2.0)
+        assert timer.counts() == {"x": 1}
+
+    def test_one_shot_iterator_qs_serves_every_stage(self):
+        timer = StageTimer()
+        timer.add("a", 1.0)
+        timer.add("b", 2.0)
+        q = timer.quantiles(qs=(x for x in (0.5, 0.99)))
+        assert set(q["a"]) == set(q["b"]) == {"p50", "p99"}
+        snap = timer.snapshot(qs=iter((0.5,)))
+        assert all(v for v in snap["quantiles"].values())
+
+    def test_snapshot_returns_fresh_dicts(self):
+        timer = StageTimer()
+        timer.add("x", 1.0)
+        snap = timer.snapshot()
+        snap["stages_ms"]["x"] = -1
+        snap["counts"]["x"] = -1
+        assert timer.snapshot()["counts"]["x"] == 1
+
+
+# ── admission control ────────────────────────────────────────────────
+
+
+class TestAdmissionController:
+    def test_under_watermark_everything_admitted(self):
+        adm = AdmissionController(high_watermark=10)
+        adm.note_queue_depth(5)
+        assert all(adm.admit("t0") for _ in range(50))
+        assert adm.shed == 0 and adm.admitted == 50
+
+    def test_above_shed_all_everything_shed(self):
+        adm = AdmissionController(high_watermark=10, shed_all_factor=4.0)
+        adm.note_queue_depth(41)
+        assert not adm.admit("t0")
+        assert adm.shed == 1
+        assert adm.stats()["shedByTenant"] == {"t0": 1}
+
+    def test_fair_share_sheds_the_heavy_tenant_first(self):
+        adm = AdmissionController(high_watermark=10, fair_share_factor=1.5)
+        adm.note_queue_depth(0)
+        for i in range(90):        # t0 hogs 90% of recent admissions
+            adm.admit("t0" if i % 10 else "t1")
+        adm.note_queue_depth(20)   # between watermark and shed-all
+        assert not adm.admit("t0"), "over-share tenant must shed"
+        assert adm.admit("t1"), "under-share tenant must pass"
+
+    def test_single_tenant_never_fair_share_shed(self):
+        adm = AdmissionController(high_watermark=10)
+        for _ in range(50):
+            adm.admit("only")
+        adm.note_queue_depth(20)
+        assert adm.admit("only")
+
+    def test_from_config(self):
+        assert AdmissionController.from_config(None) is None
+        assert AdmissionController.from_config({"enabled": False}) is None
+        adm = AdmissionController.from_config({"highWatermark": 7})
+        assert adm is not None and adm.high_watermark == 7
+        assert adm.shed_all_depth == 28
+
+    def test_stats_track_high_water_mark(self):
+        adm = AdmissionController()
+        adm.note_queue_depth(3)
+        adm.note_queue_depth(99)
+        adm.note_queue_depth(1)
+        st = adm.stats()
+        assert st["queueDepth"] == 1 and st["maxQueueDepth"] == 99
+
+
+class TestGatewayAdmission:
+    def make_gateway(self):
+        gw = Gateway(config={"resilience": {"admission": {
+            "enabled": True, "highWatermark": 4, "shedAllFactor": 2.0}}},
+            logger=list_logger())
+        fired = {"sheddable": 0, "verdict": 0}
+        gw.bus.on("message_received",
+                  lambda e, c: fired.__setitem__("sheddable", fired["sheddable"] + 1),
+                  plugin_id="p")
+        gw.bus.on("before_tool_call",
+                  lambda e, c: fired.__setitem__("verdict", fired["verdict"] + 1),
+                  plugin_id="p")
+        return gw, fired
+
+    def test_saturated_gateway_sheds_only_non_verdict_hooks(self):
+        gw, fired = self.make_gateway()
+        gw.admission.note_queue_depth(100)  # way past shed-all
+        gw.message_received("hello", {"workspace": "w1"})
+        assert fired["sheddable"] == 0, "message hook must be shed"
+        d = gw.before_tool_call("read", {"path": "x"}, {"workspace": "w1"})
+        assert fired["verdict"] == 1, "verdict hook must run at any depth"
+        assert d.allowed
+        assert gw.admission.shed == 1
+
+    def test_idle_gateway_sheds_nothing(self):
+        gw, fired = self.make_gateway()
+        gw.admission.note_queue_depth(0)
+        gw.message_received("hello", {"workspace": "w1"})
+        assert fired["sheddable"] == 1
+        assert gw.admission.shed == 0
+
+    def test_no_admission_config_means_never_shed(self):
+        gw = Gateway(logger=list_logger())
+        assert gw.admission is None
+        assert gw.get_status()["admission"] == {"enabled": False}
+
+    def test_status_surfaces_shed_counts(self):
+        gw, _ = self.make_gateway()
+        gw.admission.note_queue_depth(100)
+        gw.message_received("x", {"workspace": "w9"})
+        adm = gw.get_status()["admission"]
+        assert adm["shed"] == 1 and adm["shedByTenant"] == {"w9": 1}
+
+    def test_shed_hook_sets_are_disjoint(self):
+        assert not (ADMISSION_SHEDDABLE_HOOKS & NEVER_SHED_HOOKS)
+
+    def test_never_shed_handler_runs_while_hook_is_shed(self):
+        """Handler-granular shedding (review catch): verdict-relevant
+        handlers on a sheddable hook — 2FA code interception, trust
+        feedback — run at any queue depth; the rest shed."""
+        gw, fired = self.make_gateway()
+        exempt = []
+        gw.bus.on("message_received", lambda e, c: exempt.append(1) or None,
+                  plugin_id="gov", never_shed=True)
+        gw.admission.note_queue_depth(100)
+        gw.message_received("2fa code 123456", {"workspace": "w1"})
+        assert fired["sheddable"] == 0, "plain handler must shed"
+        assert exempt == [1], "never_shed handler must run"
+        assert gw.bus.stats["message_received"].skipped == 1
+
+    def test_governance_verdict_relevant_handlers_marked_never_shed(self, tmp_path):
+        from vainplex_openclaw_tpu.governance import GovernancePlugin
+
+        gw = Gateway(config={"workspace": str(tmp_path)}, logger=list_logger())
+        gw.load(GovernancePlugin(workspace=str(tmp_path),
+                                 approval_2fa=object()), plugin_config={})
+        for hook in ("after_tool_call", "message_received"):
+            regs = [r for r in gw.bus.handlers_for(hook)
+                    if r.plugin_id == "governance"]
+            assert regs and all(r.never_shed for r in regs), hook
+
+
+# ── sitrep: rotation + ops collectors ────────────────────────────────
+
+
+class TestSitrepRotation:
+    def test_rotation_preserves_previous_bytes(self, tmp_path):
+        write_sitrep({"n": 1, "x": "α"}, tmp_path)
+        first_bytes = (tmp_path / "sitrep.json").read_bytes()
+        write_sitrep({"n": 2}, tmp_path)
+        assert (tmp_path / "sitrep.previous.json").read_bytes() == first_bytes
+        assert read_json(tmp_path / "sitrep.json")["n"] == 2
+
+    def test_first_write_no_previous(self, tmp_path):
+        write_sitrep({"n": 1}, tmp_path)
+        assert not (tmp_path / "sitrep.previous.json").exists()
+
+    def test_failed_write_leaves_both_files_intact(self, tmp_path, monkeypatch):
+        """The new report stages before rotation: a failed write must not
+        eat the current sitrep (review catch — rotate-then-write left no
+        sitrep.json at all when the write failed)."""
+        import vainplex_openclaw_tpu.sitrep.aggregator as agg
+
+        write_sitrep({"n": 1}, tmp_path)
+        write_sitrep({"n": 2}, tmp_path)
+
+        def boom(path, data):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(agg, "write_json_atomic", boom)
+        with pytest.raises(OSError):
+            write_sitrep({"n": 3}, tmp_path)
+        assert read_json(tmp_path / "sitrep.json")["n"] == 2
+        assert read_json(tmp_path / "sitrep.previous.json")["n"] == 1
+
+    def test_stale_rotation_tmp_from_crash_is_recovered(self, tmp_path):
+        """A crash between link and replace must not wedge every later
+        rotation onto the gap fallback (review catch)."""
+        write_sitrep({"n": 1}, tmp_path)
+        (tmp_path / ".sitrep.previous.tmp").write_text("{}")  # crash debris
+        write_sitrep({"n": 2}, tmp_path)
+        assert read_json(tmp_path / "sitrep.json")["n"] == 2
+        assert read_json(tmp_path / "sitrep.previous.json")["n"] == 1
+        assert not (tmp_path / ".sitrep.previous.tmp").exists()
+
+
+class TestOpsCollectors:
+    def gateway_ctx(self, status=None, timers=None):
+        ctx = {}
+        if status is not None:
+            ctx["gateway_status"] = lambda: status
+        if timers is not None:
+            ctx["stage_timers"] = lambda: timers
+        return ctx
+
+    def timer_snapshot(self, ms_by_stage):
+        t = StageTimer()
+        for stage, values in ms_by_stage.items():
+            for v in values:
+                t.add(stage, v)
+        return t.snapshot()
+
+    def test_gateway_collector_skipped_without_wiring(self):
+        assert collect_gateway({}, {})["status"] == "skipped"
+
+    def test_gateway_collector_warns_while_actively_shedding(self):
+        status = {"plugins": ["a", "b"], "degraded": [], "breakers": {},
+                  "hooks": {"h": {"fired": 3, "errors": 0, "skipped": 0}},
+                  "admission": {"enabled": True, "shed": 7,
+                                "queueDepth": 50, "highWatermark": 10}}
+        got = collect_gateway({}, self.gateway_ctx(status=status))
+        assert got["status"] == "warn" and got["shed"] == 7
+        assert "7 shed" in got["summary"] and "SHEDDING" in got["summary"]
+
+    def test_gateway_collector_recovers_after_backlog_drains(self):
+        """Lifetime counters must not latch health to warn forever
+        (review catch): sheds stay visible, health reflects NOW."""
+        status = {"plugins": ["a"], "degraded": [], "breakers": {},
+                  "hooks": {"h": {"fired": 3, "errors": 2, "skipped": 5}},
+                  "admission": {"enabled": True, "shed": 7,
+                                "queueDepth": 0, "highWatermark": 10}}
+        got = collect_gateway({}, self.gateway_ctx(status=status))
+        assert got["status"] == "ok" and got["shed"] == 7
+        assert "7 shed" in got["summary"]
+
+    def test_gateway_collector_warns_on_degraded_or_breakers(self):
+        base = {"plugins": ["a"], "hooks": {},
+                "admission": {"enabled": False}}
+        degraded = collect_gateway({}, self.gateway_ctx(
+            status={**base, "degraded": ["a"], "breakers": {}}))
+        assert degraded["status"] == "warn"
+        tripped = collect_gateway({}, self.gateway_ctx(
+            status={**base, "degraded": [],
+                    "breakers": {"a": {"h": {"state": "open"}}}}))
+        assert tripped["status"] == "warn"
+        assert tripped["items"][0]["trippedBreakers"] == ["a/h"]
+        # a long-recovered breaker (closed, lifetime failures > 0) is
+        # history, not a current condition — must not latch warn
+        healed = collect_gateway({}, self.gateway_ctx(
+            status={**base, "degraded": [],
+                    "breakers": {"a": {"h": {"state": "closed",
+                                             "failures": 9}}}}))
+        assert healed["status"] == "ok"
+
+    def test_gateway_collector_ok_when_clean(self):
+        status = {"plugins": ["a"], "degraded": [], "breakers": {},
+                  "hooks": {"h": {"fired": 3, "errors": 0, "skipped": 0}},
+                  "admission": {"enabled": False}}
+        got = collect_gateway({}, self.gateway_ctx(status=status))
+        assert got["status"] == "ok" and got["shed"] == 0
+
+    def test_stage_quantiles_collector_rows(self):
+        snaps = {"governance": self.timer_snapshot({"evaluate": [1.0, 2.0, 4.0]})}
+        got = collect_stage_quantiles({}, self.gateway_ctx(timers=snaps))
+        assert got["status"] == "ok"
+        row = got["items"][0]
+        assert row["edge"] == "governance" and row["stage"] == "evaluate"
+        assert row["count"] == 3 and "p99" in row
+
+    def test_resilience_collector_warns_on_drops(self):
+        ctx = {"eventstore_status": lambda: {
+            "outbox_len": 2, "outbox_dropped": 3, "replayed": 1,
+            "quarantined_files": 0},
+            "governance_status": lambda: {"audit": {"spilled": 0,
+                                                    "flushFailures": 0}}}
+        got = collect_resilience({}, ctx)
+        assert got["status"] == "warn" and "outbox_dropped=3" in got["summary"]
+
+    def test_resilience_collector_ok_when_clean(self):
+        ctx = {"eventstore_status": lambda: {"outbox_len": 0,
+                                             "outbox_dropped": 0}}
+        assert collect_resilience({}, ctx)["status"] == "ok"
+
+    def test_slo_collector_threshold_matrix(self):
+        snaps = {"governance": self.timer_snapshot(
+            {"evaluate": [1.0] * 50 + [30.0]})}
+        ctx = self.gateway_ctx(timers=snaps)
+        # generous budget → ok
+        ok = collect_slo({"p99Ms": {"governance:evaluate": 1000.0}}, ctx)
+        assert ok["status"] == "ok" and "1 SLOs checked" in ok["summary"]
+        # tight budget breached within 2x → warn
+        p99 = snaps["governance"]["quantiles"]["evaluate"]["p99"]
+        warn = collect_slo({"p99Ms": {"governance:evaluate": p99 * 0.7}}, ctx)
+        assert warn["status"] == "warn" and warn["items"]
+        # breached past 2x → error
+        err = collect_slo({"p99Ms": {"governance:evaluate": p99 * 0.2}}, ctx)
+        assert err["status"] == "error"
+        # edge-level key and default both apply
+        edge = collect_slo({"p99Ms": {"governance": p99 * 0.2}}, ctx)
+        assert edge["status"] == "error"
+        dflt = collect_slo({"defaultP99Ms": p99 * 0.2}, ctx)
+        assert dflt["status"] == "error"
+
+    def test_slo_collector_no_thresholds_checks_nothing(self):
+        snaps = {"g": self.timer_snapshot({"s": [1.0]})}
+        got = collect_slo({}, self.gateway_ctx(timers=snaps))
+        assert got["status"] == "ok" and "0 SLOs checked" in got["summary"]
+
+    def test_slo_collector_skipped_without_timers(self):
+        got = collect_slo({"defaultP99Ms": 1.0}, self.gateway_ctx(timers={}))
+        assert got["status"] == "skipped"
+        assert "no stage timers" in got["summary"]
+
+
+class TestOpsCommand:
+    def test_ops_command_through_a_live_gateway(self, tmp_path):
+        from vainplex_openclaw_tpu.governance import GovernancePlugin
+        from vainplex_openclaw_tpu.sitrep import SitrepPlugin
+
+        gw = Gateway(config={"workspace": str(tmp_path)}, logger=list_logger())
+        gw.load(GovernancePlugin(workspace=str(tmp_path)), plugin_config={})
+        gw.load(SitrepPlugin(workspace=str(tmp_path), wall_timers=False),
+                plugin_config={"intervalMinutes": 0})
+        gw.start()
+        gw.before_tool_call("read", {"path": "ok.txt"},
+                            {"agent_id": "a", "session_key": "s"})
+        out = gw.command("ops")
+        assert "ops:" in out["text"]
+        assert "gateway:" in out["text"]
+        assert "governance" in out["text"]  # stage rows from the engine timer
+        gw.stop()
+
+    def test_ops_collectors_forced_on_even_when_sitrep_trims_them(self, tmp_path):
+        from vainplex_openclaw_tpu.sitrep import SitrepPlugin
+
+        gw = Gateway(config={"workspace": str(tmp_path)}, logger=list_logger())
+        gw.load(SitrepPlugin(workspace=str(tmp_path), wall_timers=False),
+                plugin_config={"intervalMinutes": 0,
+                               "collectors": {"gateway": {"enabled": False}}})
+        gw.start()
+        report = gw.plugins["sitrep"].module.ops_report()
+        assert report["collectors"]["gateway"]["status"] != "skipped"
+        gw.stop()
+
+
+# ── SLO harness ──────────────────────────────────────────────────────
+
+
+class TestWorkload:
+    def test_same_seed_same_workload(self):
+        a = workload_digest(generate_workload(5, 400, 4))
+        b = workload_digest(generate_workload(5, 400, 4))
+        assert a == b
+
+    def test_different_seed_different_workload(self):
+        a = workload_digest(generate_workload(5, 400, 4))
+        b = workload_digest(generate_workload(6, 400, 4))
+        assert a["checksum"] != b["checksum"]
+
+    def test_all_ten_language_packs_exercised(self):
+        digest = workload_digest(generate_workload(0, 600, 4))
+        assert digest["languages"] == sorted(
+            ["en", "de", "fr", "es", "pt", "it", "zh", "ja", "ko", "ru"])
+
+    def test_arrivals_sorted_and_bursty(self):
+        ops = generate_workload(3, 500, 3)
+        arrivals = [op.arrival for op in ops]
+        assert arrivals == sorted(arrivals)
+        gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
+        tiny = sum(1 for g in gaps if g < 0.1)
+        assert tiny > len(gaps) * 0.15, "burst gaps missing"
+
+
+class TestSloReportDeterminism:
+    @pytest.fixture(scope="class")
+    def two_sim_runs(self):
+        kw = dict(seed=11, n_ops=260, tenants=4, saturation=2.0, mode="sim")
+        return run_slo_report(**kw), run_slo_report(**kw)
+
+    def test_same_seed_bit_identical_report(self, two_sim_runs):
+        a, b = two_sim_runs
+        assert json.dumps(a, sort_keys=True, ensure_ascii=False) == \
+               json.dumps(b, sort_keys=True, ensure_ascii=False)
+
+    def test_different_seed_differs(self, two_sim_runs):
+        a, _ = two_sim_runs
+        c = run_slo_report(seed=12, n_ops=260, tenants=4, saturation=2.0,
+                           mode="sim")
+        assert c["workload"]["checksum"] != a["workload"]["checksum"]
+
+    def test_report_shape(self, two_sim_runs):
+        a, _ = two_sim_runs
+        assert a["metric"] == "slo_report"
+        for key in ("p50", "p95", "p99"):
+            assert a["e2e"][key] >= 0
+        assert set(a["e2e"]["byKind"]) == {
+            "msg_in", "msg_out", "tool_ok", "tool_denied", "tool_secret"}
+        assert a["workload"]["ops"] == 260
+        assert "stage_counts" in a and a["stage_counts"]
+        assert "stages" not in a, "sim reports must not carry wall-clock stages"
+        json.loads(json.dumps(a, ensure_ascii=False))  # serializable
+
+
+class TestGracefulDegradation:
+    """The 2x-saturation acceptance: bounded p99, zero verdict losses,
+    sheds visible in the admission stats AND the sitrep surface."""
+
+    @pytest.fixture(scope="class")
+    def at_2x(self):
+        return run_slo_report(seed=11, n_ops=260, tenants=4, saturation=2.0,
+                              mode="sim")
+
+    def test_zero_verdict_losses_under_overload(self, at_2x):
+        v = at_2x["verdicts"]
+        assert v["losses"] == 0
+        assert v["false_blocks"] == 0, "over-enforcement is a failure too"
+        assert v["observed_denials"] == v["expected_denials"] > 0
+        assert v["observed_redactions"] == v["expected_redactions"] > 0
+
+    def test_shedding_engaged_and_visible(self, at_2x):
+        assert at_2x["admission"]["shed"] > 0
+        assert at_2x["sitrep"]["gatewayShed"] == at_2x["admission"]["shed"]
+
+    def test_p99_bounded_vs_no_admission(self, at_2x):
+        bare = run_slo_report(seed=11, n_ops=260, tenants=4, saturation=2.0,
+                              mode="sim", admission=False)
+        assert at_2x["e2e"]["p99"] < bare["e2e"]["p99"], (
+            "shedding must beat the unprotected pipeline at 2x")
+        assert bare["verdicts"]["losses"] == 0  # NEVER_SHED holds regardless
+
+    def test_heavy_tenant_sheds_most(self, at_2x):
+        by_tenant = at_2x["admission"]["shedByTenant"]
+        heavy = by_tenant.get("tenant0", 0)
+        assert heavy == max(by_tenant.values()), by_tenant
+
+
+class TestSloWallMode:
+    def test_wall_smoke_reports_real_stage_quantiles(self):
+        r = run_slo_report(seed=2, n_ops=120, tenants=2, saturation=0.8,
+                           mode="wall")
+        assert r["verdicts"]["losses"] == 0
+        assert r["capacity_ops_s"] > 0
+        assert "governance" in r["stages"] and "knowledge" in r["stages"]
+        assert any(e.startswith("cortex:tenant") for e in r["stages"])
+        recs = slo_stage_records(r)
+        assert recs and all(rec["metric"] == "slo_stage_quantiles"
+                            for rec in recs)
+        # the workload identity stays deterministic even in wall mode
+        again = workload_digest(generate_workload(2, 120, 2))
+        assert again == r["workload"]

@@ -223,7 +223,8 @@ class TestGenerateAndRotate:
                                  list_logger(), clock=FakeClock())
         assert report["health"] == "healthy"
         assert set(report["collectors"]) == {
-            "systemd_timers", "nats", "goals", "threads", "errors", "calendar"}
+            "systemd_timers", "nats", "goals", "threads", "errors", "calendar",
+            "gateway", "stage_quantiles", "resilience", "slo"}
         assert all(r["status"] == "skipped" for r in report["collectors"].values())
         assert report["generatedAt"].endswith("Z")
 

@@ -64,6 +64,24 @@ NEVER_SHED_HOOKS = frozenset({
 # the gateway writes the message).
 SYNC_ONLY_HOOKS = frozenset({"before_message_write", "tool_result_persist"})
 
+# Traffic-proportional hooks the admission controller may shed under
+# saturation (ISSUE 6). Shedding is HANDLER-granular, not hook-granular:
+# a shed fire skips only handlers registered without ``never_shed`` —
+# observability/memory work (cortex ingest, knowledge extraction, event
+# mirroring) — while verdict-relevant handlers that happen to live on
+# these hooks (governance's 2FA code interception on message_received,
+# trust feedback + sub-agent linking on after_tool_call) register with
+# ``never_shed=True`` and run at any queue depth. Lifecycle hooks
+# (session/gateway/compaction boundaries) carry state transitions and are
+# not listed at all: shedding is strictly for per-message/per-call volume.
+ADMISSION_SHEDDABLE_HOOKS = frozenset({
+    "message_received",
+    "message_sent",
+    "after_tool_call",
+    "llm_input",
+    "llm_output",
+})
+
 KNOWN_HOOKS = (
     "before_tool_call",
     "after_tool_call",
@@ -179,13 +197,18 @@ class _Registration:
     plugin_id: str
     handler: HookHandler
     is_async: bool = False
+    # Exempt from admission shedding (ISSUE 6): verdict-relevant work
+    # registered on an otherwise-sheddable hook.
+    never_shed: bool = False
 
 
 @dataclass
 class HookStats:
     fired: int = 0
     errors: int = 0
-    skipped: int = 0  # handlers shed because their plugin's breaker was open
+    # Handlers skipped without running: plugin error-budget breaker open,
+    # OR admission-control shed (ISSUE 6) — both deliberate, both visible.
+    skipped: int = 0
     last_fired_at: Optional[float] = None
     last_error: Optional[str] = None
 
@@ -253,11 +276,13 @@ class HookBus:
         return sorted({pid for (pid, _), br in self.breakers.items()
                        if br.state != "closed"})
 
-    def on(self, hook_name: str, handler: HookHandler, priority: int = 100, plugin_id: str = "?") -> None:
+    def on(self, hook_name: str, handler: HookHandler, priority: int = 100, plugin_id: str = "?",
+           never_shed: bool = False) -> None:
         self._seq += 1
         reg = _Registration(priority=priority, seq=self._seq, plugin_id=plugin_id,
                             handler=handler,
-                            is_async=inspect.iscoroutinefunction(inspect.unwrap(handler)))
+                            is_async=inspect.iscoroutinefunction(inspect.unwrap(handler)),
+                            never_shed=never_shed)
         regs = self._handlers.setdefault(hook_name, [])
         regs.append(reg)
         regs.sort(key=lambda r: (r.priority, r.seq))
@@ -317,19 +342,25 @@ class HookBus:
         *args: Any,
         until: Optional[Callable[[Any], bool]] = None,
         on_result: Optional[Callable[[Any], None]] = None,
+        shed: bool = False,
     ) -> list[Any]:
         """Run all handlers in priority order; return their non-None results.
 
         ``until(result)`` short-circuits the chain when it returns True (used
         by the gateway for block verdicts). ``on_result`` is invoked after each
         non-None result so the caller can fold mutations (e.g. redacted params)
-        into the shared event before the next handler sees it.
+        into the shared event before the next handler sees it. ``shed=True``
+        (admission control, ISSUE 6) skips every handler not registered
+        ``never_shed`` — verdict-relevant handlers still run.
         """
         results: list[Any] = []
         err: Optional[str] = None
         n_errors = 0
         n_skipped = 0
         for reg in self.handlers_for(hook_name):
+            if shed and not reg.never_shed:
+                n_skipped += 1
+                continue
             br = self._breaker_for(reg.plugin_id, hook_name)
             if (br is not None and hook_name not in NEVER_SHED_HOOKS
                     and not br.allow()):
@@ -368,6 +399,7 @@ class HookBus:
         *args: Any,
         until: Optional[Callable[[Any], bool]] = None,
         on_result: Optional[Callable[[Any], None]] = None,
+        shed: bool = False,
     ) -> list[Any]:
         """Synchronous dispatch.
 
@@ -384,6 +416,9 @@ class HookBus:
         n_skipped = 0
         try:
             for reg in self.handlers_for(hook_name):
+                if shed and not reg.never_shed:
+                    n_skipped += 1
+                    continue
                 br = self._breaker_for(reg.plugin_id, hook_name)
                 if (br is not None and hook_name not in NEVER_SHED_HOOKS
                         and not br.allow()):
@@ -479,6 +514,13 @@ class PluginApi:
         ``api.registerTool`` existence before registering its 5 tools)."""
         self._gateway._register_tool(self.id, tool)
 
+    def register_stage_timer(self, name: str, timer: Any) -> None:
+        """Publish a StageTimer into the gateway's observability registry
+        (ISSUE 6): sitrep's stage-quantile/SLO collectors and the /ops
+        command read every registered edge from one place instead of
+        knowing each plugin's status shape."""
+        self._gateway._register_stage_timer(self.id, name, timer)
+
     def get_gateway_status(self) -> dict:
         """Public view of ``Gateway.get_status()`` (ISSUE 4's degradation
         surface) so plugin status commands can report degraded/breaker state
@@ -486,5 +528,7 @@ class PluginApi:
         internals (ISSUE 5 satellite)."""
         return self._gateway.get_status()
 
-    def on(self, hook_name: str, handler: HookHandler, priority: int = 100) -> None:
-        self._gateway.bus.on(hook_name, handler, priority=priority, plugin_id=self.id)
+    def on(self, hook_name: str, handler: HookHandler, priority: int = 100,
+           never_shed: bool = False) -> None:
+        self._gateway.bus.on(hook_name, handler, priority=priority, plugin_id=self.id,
+                             never_shed=never_shed)

@@ -29,7 +29,16 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from .api import HookBus, PluginApi, PluginCommand, PluginLogger, PluginService, make_logger
+from ..resilience.admission import AdmissionController
+from .api import (
+    ADMISSION_SHEDDABLE_HOOKS,
+    HookBus,
+    PluginApi,
+    PluginCommand,
+    PluginLogger,
+    PluginService,
+    make_logger,
+)
 
 
 @dataclass
@@ -93,6 +102,13 @@ class Gateway:
         self.commands: dict[str, PluginCommand] = {}
         self.methods: dict[str, Callable[..., Any]] = {}
         self.tools: dict[str, dict] = {}
+        # Observability registry (ISSUE 6): every serving edge publishes its
+        # StageTimer here so sitrep/SLO surfaces read one place.
+        self.stage_timers: dict[str, Any] = {}
+        # Admission control (ISSUE 6): None unless configured — seed
+        # behavior is "never shed".
+        self.admission = AdmissionController.from_config(
+            (self.config.get("resilience") or {}).get("admission"))
         self._started = False
 
     # ── plugin registry ──────────────────────────────────────────────
@@ -128,6 +144,9 @@ class Gateway:
     def _register_tool(self, plugin_id: str, tool: dict) -> None:
         self.tools[tool["name"]] = tool
 
+    def _register_stage_timer(self, plugin_id: str, name: str, timer: Any) -> None:
+        self.stage_timers[name] = timer
+
     # ── lifecycle ────────────────────────────────────────────────────
 
     def _start_service(self, plugin_id: str, service: PluginService) -> None:
@@ -159,19 +178,39 @@ class Gateway:
 
     # ── generic hook firing (the mock-api `_fire` equivalent) ────────
 
+    def _shed(self, hook_name: str, args: tuple) -> bool:
+        """Admission check (ISSUE 6): True → this hook fire is shed.
+        Shedding is handler-granular: the bus still runs handlers
+        registered ``never_shed`` (2FA code interception, trust feedback)
+        and skips the rest (visible in the hook's ``skipped`` counter).
+        Verdict-bearing hooks are not in ``ADMISSION_SHEDDABLE_HOOKS`` and
+        never reach the controller. The tenant key is the ctx's workspace
+        (one per SLO-harness tenant), falling back to session/agent
+        identity."""
+        if self.admission is None or hook_name not in ADMISSION_SHEDDABLE_HOOKS:
+            return False
+        ctx = args[1] if len(args) > 1 and isinstance(args[1], dict) else {}
+        tenant = str(ctx.get("workspace") or ctx.get("session_key")
+                     or ctx.get("agent_id") or "?")
+        return not self.admission.admit(tenant)
+
     def _dispatch(self, hook_name: str, *args: Any, until=None, on_result=None) -> list[Any]:
         """Single sync-vs-async dispatch decision: hooks with only sync
         handlers skip the event loop entirely (the enforcement/ingest hot
         paths are sync in the common case)."""
+        shed = self._shed(hook_name, args)
         if self.bus.has_async(hook_name):
-            return _run(self.bus.fire(hook_name, *args, until=until, on_result=on_result))
-        return self.bus.fire_sync(hook_name, *args, until=until, on_result=on_result)
+            return _run(self.bus.fire(hook_name, *args, until=until,
+                                      on_result=on_result, shed=shed))
+        return self.bus.fire_sync(hook_name, *args, until=until,
+                                  on_result=on_result, shed=shed)
 
     def fire(self, hook_name: str, *args: Any) -> list[Any]:
         return self._dispatch(hook_name, *args)
 
     async def fire_async(self, hook_name: str, *args: Any) -> list[Any]:
-        return await self.bus.fire(hook_name, *args)
+        return await self.bus.fire(hook_name, *args,
+                                   shed=self._shed(hook_name, args))
 
     # ── typed flows ──────────────────────────────────────────────────
 
@@ -351,4 +390,6 @@ class Gateway:
             "degraded": self.bus.degraded_plugins(),
             "breakers": breakers,
             "hooks": hooks,
+            "admission": (self.admission.stats() if self.admission is not None
+                          else {"enabled": False}),
         }

@@ -201,6 +201,12 @@ class CortexPlugin:
             self._trackers[ws] = _WorkspaceTrackers(ws, self.config, self.patterns,
                                                     self.logger, self.clock,
                                                     self.wall_timers, self.call_llm)
+            if self._api is not None and hasattr(self._api, "register_stage_timer"):
+                # Per-workspace edge in the observability registry (ISSUE 6);
+                # keyed by workspace so a multi-tenant gateway's sitrep can
+                # attribute latency to the tenant that paid it.
+                self._api.register_stage_timer(f"cortex:{ws}",
+                                               self._trackers[ws].timer)
         return self._trackers[ws]
 
     # ── hook handlers (every one fail-open) ──────────────────────────
@@ -305,9 +311,11 @@ class CortexPlugin:
                          f"mood={c['mood']} events={c['events']} "
                          f"decisions={len(trackers.decisions.decisions)} "
                          f"commitments={len(trackers.commitments.open_commitments())}")
-            stage_ms = trackers.timer.stages_ms()
-            if stage_ms:
-                lines.append(f"  {ws} stage ms: {stage_ms}")
+            snap = trackers.timer.snapshot()  # one lock: ms + quantiles agree
+            if snap["stages_ms"]:
+                lines.append(f"  {ws} stage ms: {snap['stages_ms']}")
+                p99 = {k: q["p99"] for k, q in snap["quantiles"].items()}
+                lines.append(f"  {ws} stage p99 ms: {p99}")
         if self._api is not None:
             # Public degradation surface (ISSUE 4/5): also tells the operator
             # when the gateway is shedding cortex's own hooks.

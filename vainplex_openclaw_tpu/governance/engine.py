@@ -283,6 +283,10 @@ class GovernanceEngine:
         return self.policy_index.unique_policy_count
 
     def get_status(self) -> dict:
+        # One snapshot() instead of stages_ms()+counts(): both views come
+        # from the same lock round-trip, so ms and counts attribute the
+        # same traffic even while verdicts land concurrently (ISSUE 6).
+        snap = self.timer.snapshot()
         return {
             "enabled": self.config.get("enabled", True),
             "policyCount": self.policy_count(),
@@ -290,8 +294,9 @@ class GovernanceEngine:
             "auditEnabled": self.config.get("audit", {}).get("enabled", True),
             "failMode": self.config.get("failMode", "open"),
             "stats": self.stats.to_dict(),
-            "stageMs": self.timer.stages_ms(),
-            "stageCounts": self.timer.counts(),
+            "stageMs": snap["stages_ms"],
+            "stageCounts": snap["counts"],
+            "stageQuantiles": snap["quantiles"],
             # Degradation must be *visible* (ISSUE 4): spilled/retained audit
             # records and flush failures ride every status read.
             "audit": self.audit_trail.stats(),

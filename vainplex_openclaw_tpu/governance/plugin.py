@@ -146,7 +146,11 @@ class GovernancePlugin:
         self._init_erc8004(api)
 
         api.on("before_tool_call", self.handle_before_tool_call, priority=1000)
-        api.on("after_tool_call", self.handle_after_tool_call, priority=900)
+        # never_shed: trust feedback + sub-agent spawn linking feed later
+        # VERDICTS (parent-keyed policies, trust tiers) — admission
+        # shedding must not drop them with the observability handlers.
+        api.on("after_tool_call", self.handle_after_tool_call, priority=900,
+               never_shed=True)
         api.on("message_sending", self.handle_message_sending, priority=1000)
         api.on("before_message_write", self.handle_before_message_write, priority=1000)
         api.on("before_agent_start", self.handle_before_agent_start, priority=5)
@@ -161,6 +165,7 @@ class GovernancePlugin:
             name="trust", description="Agent trust dashboard",
             handler=lambda ctx: {"text": self.trust_text(ctx.get("args", ""))}))
         api.register_gateway_method("governance.status", lambda: self.engine.get_status())
+        api.register_stage_timer("governance", self.engine.timer)
         api.register_gateway_method("governance.trust",
                                     lambda agent_id=None, session_key=None:
                                     self.engine.get_trust(agent_id, session_key))
@@ -217,7 +222,8 @@ class GovernancePlugin:
         tcfg = self.config.get("twoFa", {})
         if not tcfg.get("enabled") or self.approval_2fa is not None:
             if self.approval_2fa is not None:
-                api.on("message_received", self.handle_2fa_code, priority=100)
+                api.on("message_received", self.handle_2fa_code, priority=100,
+                   never_shed=True)
             return
         from .approval import Approval2FA
 
@@ -226,7 +232,8 @@ class GovernancePlugin:
         except ValueError as exc:
             api.logger.error(f"2FA disabled: {exc}")
             return
-        api.on("message_received", self.handle_2fa_code, priority=100)
+        api.on("message_received", self.handle_2fa_code, priority=100,
+                   never_shed=True)
         creds_path = tcfg.get("matrixCredsPath")
         if creds_path:
             from .approval.matrix import MatrixNotifier

@@ -121,6 +121,7 @@ class KnowledgeEnginePlugin:
             id="knowledge-engine",
             start=lambda ctx: self._ensure_loaded(),
             stop=lambda ctx: self._shutdown()))
+        api.register_stage_timer("knowledge", self.timer)
         api.register_command(PluginCommand(
             name="knowledge", description="Knowledge engine status + search",
             accepts_args=True,
@@ -192,12 +193,17 @@ class KnowledgeEnginePlugin:
         ``runStats.stageMs``) so a slow knowledge path arrives
         pre-attributed to ingest / query / sync / search / decay."""
         self._ensure_loaded()
+        # snapshot(): ms/counts/quantiles from one lock round-trip — this
+        # timer is shared with the maintenance daemon, so back-to-back
+        # stages_ms()+counts() reads could attribute different traffic.
+        snap = self.timer.snapshot()
         out = {
             "facts": self.fact_store.count(),
             "embedded": (self.embeddings.count()
                          if hasattr(self.embeddings, "count") else None),
-            "stageMs": self.timer.stages_ms(),
-            "stageCounts": self.timer.counts(),
+            "stageMs": snap["stages_ms"],
+            "stageCounts": snap["counts"],
+            "stageQuantiles": snap["quantiles"],
         }
         if hasattr(self.embeddings, "query_cache_hits"):
             out["queryCache"] = {"hits": self.embeddings.query_cache_hits,

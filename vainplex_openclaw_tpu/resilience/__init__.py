@@ -5,6 +5,7 @@ Stdlib-only by design — every subsystem (storage, events, governance, core,
 models) may import this package without creating cycles.
 """
 
+from .admission import ADMISSION_DEFAULTS, AdmissionController
 from .faults import (
     FaultError,
     FaultPlan,
@@ -20,6 +21,8 @@ from .faults import (
 from .policy import CircuitBreaker, CircuitOpenError, RetryPolicy, RetryStats
 
 __all__ = [
+    "ADMISSION_DEFAULTS",
+    "AdmissionController",
     "CircuitBreaker",
     "CircuitOpenError",
     "FaultError",

@@ -3,11 +3,12 @@ openclaw-sitrep/src/aggregator.ts:19-44 + service.ts)."""
 
 from __future__ import annotations
 
+import os
 import time
 from pathlib import Path
 from typing import Callable, Optional
 
-from ..storage.atomic import read_json, write_json_atomic
+from ..storage.atomic import write_json_atomic
 from .collectors import BUILTIN_COLLECTORS, run_custom_collector, safe_collect
 
 HEALTH_ORDER = {"ok": 0, "skipped": 0, "warn": 1, "error": 2}
@@ -47,10 +48,36 @@ def generate_sitrep(config: dict, ctx: dict, logger,
 
 
 def write_sitrep(report: dict, workspace: str | Path) -> Path:
-    """Write sitrep.json, rotating the previous one to sitrep.previous.json."""
+    """Write sitrep.json, rotating the previous one to sitrep.previous.json.
+
+    Rotation renames instead of read_json + re-encoding the whole previous
+    report — the old path paid a full parse/serialize of a report that was
+    already valid JSON on disk (ISSUE 6 satellite). Ordering keeps
+    sitrep.json present at every instant: the new report is staged first
+    (all write failures land before anything is touched), the current
+    report becomes sitrep.previous.json via a hardlink (the original name
+    stays in place), and one final ``os.replace`` swaps the new report in
+    atomically. On a hardlink-capable filesystem a crash anywhere leaves
+    sitrep.json valid — worst case a stale staging file lingers and is
+    cleaned next rotation; the no-hardlink fallback keeps the no-re-encode
+    win but reopens a brief rename window where sitrep.json is absent."""
     path = Path(workspace) / "sitrep.json"
-    previous = read_json(path)
-    if previous is not None:
-        write_json_atomic(path.with_name("sitrep.previous.json"), previous)
-    write_json_atomic(path, report)
+    staged = path.with_name(".sitrep.json.new")
+    write_json_atomic(staged, report)  # all failure modes land here
+    previous = path.with_name("sitrep.previous.json")
+    prev_tmp = path.with_name(".sitrep.previous.tmp")
+    try:
+        prev_tmp.unlink(missing_ok=True)  # stale tmp from a crashed rotation
+        os.link(path, prev_tmp)
+        os.replace(prev_tmp, previous)
+    except FileNotFoundError:
+        pass  # first sitrep: nothing to rotate
+    except OSError:
+        # Filesystem without hardlinks: fall back to rename rotation (a
+        # brief sitrep.json-absent window, still no re-encode).
+        try:
+            os.replace(path, previous)
+        except FileNotFoundError:
+            pass
+    os.replace(staged, path)
     return path

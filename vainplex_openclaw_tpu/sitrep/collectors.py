@@ -1,10 +1,22 @@
 """Sitrep collectors (reference: openclaw-sitrep/src/collectors/*).
 
-Six built-ins — systemd_timers (shells out to systemctl), nats (event-store
-status probe), goals, threads (reads Cortex threads.json), errors (audit
-denials + hook errors), calendar — plus custom shell-command collectors.
-Each runs through ``safe_collect`` so a broken collector degrades to an
-error entry, never a crashed sitrep.
+Six reference built-ins — systemd_timers (shells out to systemctl), nats
+(event-store status probe), goals, threads (reads Cortex threads.json),
+errors (audit denials + hook errors), calendar — plus custom shell-command
+collectors. Each runs through ``safe_collect`` so a broken collector
+degrades to an error entry, never a crashed sitrep.
+
+ISSUE 6 revives the deprecated reference plugin as the system's OWN
+observability plane with four ops built-ins:
+
+- ``gateway`` — degraded plugins, tripped breakers, per-hook skip/error
+  counters, admission-control shed counts (``Gateway.get_status``);
+- ``stage_quantiles`` — p50/p95/p99 per stage for every StageTimer edge
+  registered with the gateway;
+- ``resilience`` — NATS outbox/replay/drop counters, torn-tail/quarantine
+  counts, audit spill/flush failures;
+- ``slo`` — threshold rollup: configured per-edge/per-stage p99 budgets
+  compared against the live quantiles.
 """
 
 from __future__ import annotations
@@ -98,6 +110,145 @@ def collect_calendar(config: dict, ctx: dict) -> dict:
     return {"status": "ok", "items": events[:20], "summary": f"{len(events)} events"}
 
 
+# ── ops-plane collectors (ISSUE 6) ──────────────────────────────────
+
+
+def collect_gateway(config: dict, ctx: dict) -> dict:
+    """Gateway health: degraded plugins, breakers, hook skip/error
+    counters, and admission shed counts — the degradation surface ISSUE 4
+    built, finally on one pane of glass.
+
+    Health reflects CURRENT conditions only (degraded plugins, tripped
+    breakers, queue depth over the admission watermark) — those clear when
+    the system recovers. Lifetime counters (hook errors, handler skips,
+    total sheds) stay visible in the items/summary but never latch the
+    report to warn forever over one long-past incident."""
+    status_fn = ctx.get("gateway_status")
+    if status_fn is None:
+        return {"status": "skipped", "items": [], "summary": "no gateway wired"}
+    s = status_fn()
+    degraded = s.get("degraded") or []
+    breakers = s.get("breakers") or {}
+    hooks = s.get("hooks") or {}
+    hook_errors = sum(h.get("errors", 0) for h in hooks.values())
+    handler_skips = sum(h.get("skipped", 0) for h in hooks.values())
+    adm = s.get("admission") or {}
+    shed = adm.get("shed", 0)
+    over_watermark = (adm.get("enabled")
+                      and adm.get("queueDepth", 0) > adm.get("highWatermark", 0))
+    # get_status lists any breaker with lifetime failures, including long-
+    # recovered CLOSED ones — only a non-closed state is a CURRENT problem.
+    tripped = [f"{pid}/{hook}"
+               for pid, hooks_ in breakers.items()
+               for hook, st in hooks_.items()
+               if st.get("state") != "closed"]
+    items = [{"plugins": s.get("plugins", []), "degraded": degraded,
+              "breakers": breakers, "trippedBreakers": tripped,
+              "hookErrors": hook_errors,
+              "handlerSkips": handler_skips, "admission": adm}]
+    worst = degraded or tripped or over_watermark
+    return {"status": "warn" if worst else "ok",
+            "items": items,
+            "shed": shed,
+            "summary": (f"{len(s.get('plugins', []))} plugins, "
+                        f"{len(degraded)} degraded, {handler_skips} handler "
+                        f"skips, {shed} shed, {hook_errors} hook errors"
+                        + (", SHEDDING" if over_watermark else ""))}
+
+
+def collect_stage_quantiles(config: dict, ctx: dict) -> dict:
+    """p50/p95/p99 per stage for every registered StageTimer edge, read
+    via ``snapshot()`` so ms/counts/quantiles per edge are torn-free."""
+    timers_fn = ctx.get("stage_timers")
+    if timers_fn is None:
+        return {"status": "skipped", "items": [], "summary": "no gateway wired"}
+    snaps = timers_fn()
+    if not snaps:
+        return {"status": "skipped", "items": [],
+                "summary": "no stage timers registered"}
+    items = []
+    for edge in sorted(snaps):
+        snap = snaps[edge]
+        for stage, qd in snap["quantiles"].items():
+            items.append({"edge": edge, "stage": stage,
+                          "count": snap["counts"].get(stage, 0),
+                          "totalMs": snap["stages_ms"].get(stage, 0.0),
+                          **qd})
+    return {"status": "ok", "items": items,
+            "summary": f"{len(snaps)} edges, {len(items)} stages"}
+
+
+def collect_resilience(config: dict, ctx: dict) -> dict:
+    """ISSUE-4 counters in one place: event-transport outbox/replay/drop +
+    torn-tail/quarantine, and governance audit spill/flush failures."""
+    items = []
+    worries = []
+    es_fn = ctx.get("eventstore_status")
+    if es_fn is not None:
+        s = es_fn()
+        row = {"source": "eventstore"}
+        for key in ("outbox_len", "outbox_dropped", "replayed", "reconnects",
+                    "corrupt_lines", "torn_tails", "quarantined_files",
+                    "publish_failures"):
+            if key in s:
+                row[key] = s[key]
+        items.append(row)
+        for key in ("outbox_dropped", "corrupt_lines", "torn_tails",
+                    "quarantined_files"):
+            if row.get(key):
+                worries.append(f"{key}={row[key]}")
+    gov_fn = ctx.get("governance_status")
+    if gov_fn is not None:
+        audit = (gov_fn() or {}).get("audit") or {}
+        row = {"source": "audit", **audit}
+        items.append(row)
+        for key in ("spilled", "flushFailures"):
+            if audit.get(key):
+                worries.append(f"audit.{key}={audit[key]}")
+    if not items:
+        return {"status": "skipped", "items": [],
+                "summary": "no resilience surfaces wired"}
+    return {"status": "warn" if worries else "ok", "items": items,
+            "summary": (", ".join(worries) if worries
+                        else f"{len(items)} surfaces clean")}
+
+
+def collect_slo(config: dict, ctx: dict) -> dict:
+    """SLO-threshold rollup: p99 budgets (ms) from config against live
+    stage quantiles. Keys: ``"edge:stage"`` beats ``"edge"`` beats
+    ``defaultP99Ms``. A breach warns; a breach past 2× its budget errors
+    (the rollup drives the report's headline health)."""
+    timers_fn = ctx.get("stage_timers")
+    if timers_fn is None:
+        return {"status": "skipped", "items": [], "summary": "no gateway wired"}
+    thresholds = config.get("p99Ms") or {}
+    default = config.get("defaultP99Ms")
+    snaps = timers_fn()
+    if not snaps:
+        # Same condition, same verdict as collect_stage_quantiles: an
+        # "ok" here would imply budgets were validated when none could be.
+        return {"status": "skipped", "items": [],
+                "summary": "no stage timers registered"}
+    checked = 0
+    breaches = []
+    hard = False
+    for edge in sorted(snaps):
+        for stage, qd in snaps[edge]["quantiles"].items():
+            budget = thresholds.get(f"{edge}:{stage}",
+                                    thresholds.get(edge, default))
+            if budget is None:
+                continue
+            checked += 1
+            p99 = qd.get("p99")
+            if p99 is not None and p99 > budget:
+                breaches.append({"edge": edge, "stage": stage,
+                                 "p99Ms": p99, "budgetMs": budget})
+                hard = hard or p99 > 2 * budget
+    status = "error" if hard else ("warn" if breaches else "ok")
+    return {"status": status, "items": breaches,
+            "summary": f"{checked} SLOs checked, {len(breaches)} breached"}
+
+
 BUILTIN_COLLECTORS: dict[str, Callable] = {
     "systemd_timers": collect_systemd_timers,
     "nats": collect_nats,
@@ -105,6 +256,10 @@ BUILTIN_COLLECTORS: dict[str, Callable] = {
     "threads": collect_threads,
     "errors": collect_errors,
     "calendar": collect_calendar,
+    "gateway": collect_gateway,
+    "stage_quantiles": collect_stage_quantiles,
+    "resilience": collect_resilience,
+    "slo": collect_slo,
 }
 
 

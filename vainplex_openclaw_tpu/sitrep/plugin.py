@@ -23,9 +23,19 @@ DEFAULTS = {
         "threads": {"enabled": True},
         "errors": {"enabled": True},
         "calendar": {"enabled": False},
+        # Ops plane (ISSUE 6): in-process, no I/O — on by default.
+        "gateway": {"enabled": True},
+        "stage_quantiles": {"enabled": True},
+        "resilience": {"enabled": True},
+        "slo": {"enabled": True},
     },
     "customCollectors": [],
 }
+
+# The four collectors /ops always renders, whatever the sitrep interval
+# config says — the live dashboard must not go dark because an operator
+# trimmed the periodic report.
+OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "slo")
 
 MANIFEST = PluginManifest(
     id="sitrep",
@@ -44,7 +54,7 @@ MANIFEST = PluginManifest(
                                "command": {"type": "string"}}}},
         },
     },
-    commands=("sitrep",),
+    commands=("sitrep", "ops"),
     hooks=("gateway_stop",),
 )
 
@@ -61,6 +71,7 @@ class SitrepPlugin:
         self.config: dict = {}
         self._stop = threading.Event()
         self._gateway = None
+        self._api = None
 
     def register(self, api) -> None:
         self.config = load_plugin_config(self.id, api.plugin_config,
@@ -69,18 +80,57 @@ class SitrepPlugin:
             api.logger.info("disabled via config")
             return
         self.logger = api.logger
+        self._api = api
         self._gateway = api._gateway
         api.register_service(PluginService(id="sitrep", start=self._start,
                                            stop=lambda ctx: self._stop.set()))
         api.register_command(PluginCommand(
             name="sitrep", description="Generate a situation report now",
             handler=lambda ctx: {"text": self.sitrep_text()}))
+        api.register_command(PluginCommand(
+            name="ops", description="Live ops dashboard: gateway health, "
+                                    "per-edge stage quantiles, resilience "
+                                    "counters, SLO rollup",
+            handler=lambda ctx: {"text": self.ops_text()}))
 
     def _ctx(self) -> dict:
         ctx = {"workspace": (self._workspace_override or self.config.get("workspace")
                              or ".")}
-        if self._gateway is not None and "eventstore.status" in self._gateway.methods:
-            ctx["eventstore_status"] = lambda: self._gateway.call_method("eventstore.status")
+        gw = self._gateway
+        if gw is None:
+            return ctx
+        if "eventstore.status" in gw.methods:
+            ctx["eventstore_status"] = lambda: gw.call_method("eventstore.status")
+        if "governance.status" in gw.methods:
+            # Memoized per generation: get_status() eagerly estimates the
+            # engine timer's quantiles — cap that cost at once per report
+            # however many collectors end up reading it.
+            gov_memo: list = []
+
+            def governance_status() -> dict:
+                if not gov_memo:
+                    gov_memo.append(gw.call_method("governance.status"))
+                return gov_memo[0]
+
+            ctx["governance_status"] = governance_status
+        # Ops plane (ISSUE 6): gateway degradation surface (through the
+        # public PluginApi view) + every registered StageTimer,
+        # snapshotted once per report generation — the stage_quantiles
+        # and slo collectors must read the SAME view (two snapshots could
+        # disagree about samples landing between them), and quantile
+        # estimation is not free to repeat per collector.
+        # register() sets _api and _gateway together, and gw is non-None
+        # here — the public PluginApi view is always available.
+        ctx["gateway_status"] = self._api.get_gateway_status
+        memo: list = []
+
+        def stage_snapshots() -> dict:
+            if not memo:
+                memo.append({name: timer.snapshot()
+                             for name, timer in sorted(gw.stage_timers.items())})
+            return memo[0]
+
+        ctx["stage_timers"] = stage_snapshots
         return ctx
 
     def generate(self) -> dict:
@@ -109,4 +159,62 @@ class SitrepPlugin:
                 continue
             icon = {"ok": "✅", "warn": "⚠️", "error": "❌"}.get(result["status"], "•")
             lines.append(f"  {icon} {name}: {result['summary']}")
+        return "\n".join(lines)
+
+    # ── /ops: the live dashboard (ISSUE 6) ───────────────────────────
+
+    def ops_report(self) -> dict:
+        """Consolidated ops report: the four ops collectors forced on,
+        whatever the interval-sitrep config enables."""
+        cfg = dict(self.config)
+        collectors = dict(cfg.get("collectors", {}))
+        for name in OPS_COLLECTORS:
+            collectors[name] = {**collectors.get(name, {}), "enabled": True}
+        # The periodic report's other collectors stay as configured; /ops
+        # is about the serving plane, not goals/calendar.
+        for name in list(collectors):
+            if name not in OPS_COLLECTORS:
+                collectors[name] = {**collectors.get(name, {}),
+                                    "enabled": False}
+        cfg["collectors"] = collectors
+        cfg["customCollectors"] = []
+        return generate_sitrep(cfg, self._ctx(), self.logger, self.clock)
+
+    def ops_text(self) -> str:
+        report = self.ops_report()
+        results = report["collectors"]
+        icon = {"ok": "✅", "warn": "⚠️", "error": "❌", "skipped": "•"}
+        lines = [f"🛰 ops: {report['health']} ({report['generatedAt']})"]
+        gw = results.get("gateway", {})
+        lines.append(f"  {icon.get(gw.get('status'), '•')} gateway: "
+                     f"{gw.get('summary', 'n/a')}")
+        for item in gw.get("items", []):
+            adm = item.get("admission") or {}
+            if adm.get("enabled"):
+                lines.append(f"    admission: depth={adm.get('queueDepth')} "
+                             f"(max {adm.get('maxQueueDepth')}), "
+                             f"admitted={adm.get('admitted')} "
+                             f"shed={adm.get('shed')} "
+                             f"byTenant={adm.get('shedByTenant')}")
+            if item.get("degraded"):
+                lines.append(f"    degraded: {item['degraded']}")
+            if item.get("breakers"):
+                lines.append(f"    breakers: {item['breakers']}")
+        res = results.get("resilience", {})
+        lines.append(f"  {icon.get(res.get('status'), '•')} resilience: "
+                     f"{res.get('summary', 'n/a')}")
+        slo = results.get("slo", {})
+        lines.append(f"  {icon.get(slo.get('status'), '•')} slo: "
+                     f"{slo.get('summary', 'n/a')}")
+        for b in slo.get("items", [])[:10]:
+            lines.append(f"    BREACH {b['edge']}/{b['stage']}: "
+                         f"p99 {b['p99Ms']}ms > budget {b['budgetMs']}ms")
+        sq = results.get("stage_quantiles", {})
+        if sq.get("status") == "ok":
+            lines.append(f"  📈 stages ({sq['summary']}):")
+            for item in sq.get("items", [])[:40]:
+                lines.append(
+                    f"    {item['edge']}/{item['stage']}: "
+                    f"n={item['count']} p50={item.get('p50')}ms "
+                    f"p95={item.get('p95')}ms p99={item.get('p99')}ms")
         return "\n".join(lines)

@@ -1,0 +1,10 @@
+"""SLO load harness (ISSUE 6): deterministic seeded multi-tenant traffic
+through the full gateway→governance→cortex→knowledge→events pipeline, with
+p50/p95/p99 per stage and end-to-end, admission-control degradation at
+saturation, and bit-reproducible simulated-time runs for CI gating."""
+
+from .harness import run_slo_report, slo_stage_records
+from .workload import generate_workload, workload_digest
+
+__all__ = ["generate_workload", "run_slo_report", "slo_stage_records",
+           "workload_digest"]

@@ -1,0 +1,346 @@
+"""Open-loop SLO harness over the full serving pipeline (ISSUE 6).
+
+Drives the seeded workload (``workload.py``) through a real gateway with
+all five plugins loaded — governance enforcement + redaction, cortex
+ingest, knowledge extraction, event mirroring, sitrep — and reports
+p50/p95/p99 per stage and end-to-end, admission-control shedding, and
+verdict-path integrity.
+
+Two modes:
+
+- ``mode="wall"`` — honest wall-clock measurement. Capacity is calibrated
+  on a throwaway gateway first, then the workload is offered OPEN-LOOP at
+  ``saturation`` × capacity: each op has a scheduled arrival instant and
+  its latency is measured from that instant (not from dispatch), so queue
+  wait is charged to the report — no coordinated omission. Latencies are
+  real and therefore not bit-reproducible; the workload digest still is.
+- ``mode="sim"`` — deterministic discrete-event run. The same real
+  pipeline executes (verdicts, redaction, shed decisions, stage counts
+  all real), but time comes from a virtual clock and per-op service times
+  from a seeded log-normal model, so the ENTIRE report is bit-identical
+  for a given seed — the regression contract CI pins. Real per-stage
+  milliseconds are meaningless under a virtual clock, so sim reports
+  carry deterministic stage *counts* instead of stage quantiles.
+
+Saturation > 1 demonstrates graceful degradation: the backlog crosses the
+admission watermark, non-verdict work is shed per-tenant fair-share, and
+the verdict path (tool-call decisions, redaction) keeps its latency
+budget with zero losses.
+"""
+
+from __future__ import annotations
+
+import random
+import tempfile
+import time
+from pathlib import Path
+
+from ..utils.stage_timer import StageTimer
+
+# Simulated service-time model (seconds) per op kind. The absolute values
+# are a stylized container profile (persist-dominated messages, cheaper
+# verdict-only tool ops); what matters is the RATIO — shedding a message's
+# cortex/knowledge handlers removes ~94% of its cost, which is what makes
+# 2x-saturation degradation graceful rather than collapsing.
+_SIM_SERVICE_S = {"msg_in": 0.0020, "msg_out": 0.0018, "tool_ok": 0.0012,
+                  "tool_denied": 0.0010, "tool_secret": 0.0008}
+_SIM_SHED_FACTOR = 0.06
+SIM_CAPACITY_OPS_S = 600.0  # ≈ 1 / Σ p(kind)·service(kind)
+
+_QS = (0.5, 0.95, 0.99)
+
+
+class _SimClock:
+    """Mutable virtual clock handed to the gateway and every plugin."""
+
+    def __init__(self, start: float = 1_753_772_400.0):
+        self.t = start
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _build_gateway(root: Path, tenants: int, clock, admission: bool,
+                   watermark: int):
+    from ..core import Gateway
+    from ..cortex import CortexPlugin
+    from ..events import EventStorePlugin
+    from ..events.transport import MemoryTransport
+    from ..governance import GovernancePlugin
+    from ..knowledge import KnowledgeEnginePlugin
+    from ..sitrep import SitrepPlugin
+
+    config = {"workspace": str(root),
+              "agents": [{"id": f"agent{i}"} for i in range(tenants)]}
+    if admission:
+        config["resilience"] = {"admission": {"enabled": True,
+                                              "highWatermark": watermark,
+                                              "shedAllFactor": 4.0}}
+    kwargs = {} if clock is None else {"clock": clock}
+    gw = Gateway(config=config, **kwargs)
+    gov = GovernancePlugin(workspace=str(root), **kwargs)
+    gw.load(gov, plugin_config={
+        "redaction": {"enabled": True},
+        "builtinPolicies": {"credentialGuard": True,
+                            "rateLimiter": {"maxPerMinute": 10_000_000}},
+    })
+    transport = MemoryTransport(**kwargs)
+    gw.load(EventStorePlugin(transport=transport, **kwargs), plugin_config={})
+    cortex = CortexPlugin(workspace=str(root), wall_timers=False, **kwargs)
+    gw.load(cortex, plugin_config={"languages": "all",
+                                   "traceAnalyzer": {"enabled": False}})
+    knowledge = KnowledgeEnginePlugin(workspace=str(root), wall_timers=False,
+                                      **kwargs)
+    gw.load(knowledge, plugin_config={})
+    sitrep = SitrepPlugin(workspace=str(root), wall_timers=False, **kwargs)
+    gw.load(sitrep, plugin_config={"intervalMinutes": 0})
+    gw.start()
+    return gw, sitrep
+
+
+def _tenant_ctx(root: Path, tenant: int) -> dict:
+    return {"agent_id": f"agent{tenant}",
+            "session_key": f"agent:agent{tenant}:slo",
+            "workspace": str(root / f"tenant{tenant}")}
+
+
+def _dispatch(gw, op, ctx) -> dict:
+    """Run one op through the gateway; returns verdict-path observations."""
+    if op.kind == "msg_in":
+        gw.message_received(op.content, ctx)
+        return {}
+    if op.kind == "msg_out":
+        gw.message_sent(op.content, ctx)
+        return {}
+    if op.kind == "tool_ok" or op.kind == "tool_denied":
+        decision, _ = gw.run_tool("read", {"path": op.content},
+                                  lambda p: f"contents of {op.content}", ctx)
+        return {"decision": decision}
+    # tool_secret: result must come back redacted (NEVER_SHED path)
+    out = gw.tool_result_persist("exec", op.content, ctx)
+    return {"redacted": isinstance(out, str) and "[REDACTED" in out}
+
+
+def _normalize_edge(name: str, root: Path) -> str:
+    """cortex:/tmp/xyz/tenant3 → cortex:tenant3 (stable report keys)."""
+    return name.replace(str(root) + "/", "").replace(str(root), "ws")
+
+
+def _calibrate(ops, tenants: int, watermark: int) -> float:
+    """Closed-loop ops/s on a throwaway gateway — the capacity that
+    ``saturation`` scales. Uses the workload's own head so the calibration
+    mix matches the offered mix."""
+    sample = ops[:min(220, len(ops))]
+    # Warmup shrinks with tiny workloads so the timed set is never empty
+    # (a 40-op warmup on a 40-op run would report garbage capacity).
+    warm = max(0, min(40, len(sample) - 10))
+    with tempfile.TemporaryDirectory() as tmp:
+        root = Path(tmp)
+        gw, _ = _build_gateway(root, tenants, None, False, watermark)
+        ctxs = {t: _tenant_ctx(root, t) for t in range(tenants)}
+        for t in range(tenants):
+            gw.session_start(ctxs[t])
+        for op in sample[:warm]:  # warmup: banks, indexes, first persist
+            _dispatch(gw, op, ctxs[op.tenant])
+        t0 = time.perf_counter()
+        for op in sample[warm:]:
+            _dispatch(gw, op, ctxs[op.tenant])
+        dt = time.perf_counter() - t0
+        gw.stop()
+    return max(len(sample) - warm, 1) / max(dt, 1e-6)
+
+
+def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
+                   saturation: float = 1.0, mode: str = "wall",
+                   admission: bool = True, watermark: int = 32) -> dict:
+    """The ``bench.py slo_report`` entry point. Returns one JSON-ready
+    record; see module docstring for the wall/sim contract."""
+    from .workload import generate_workload, workload_digest
+
+    if mode not in ("wall", "sim"):
+        raise ValueError(f"mode must be 'wall' or 'sim', got {mode!r}")
+    if n_ops < 1:
+        raise ValueError(f"n_ops must be >= 1, got {n_ops}")
+    if saturation <= 0:
+        raise ValueError(f"saturation must be > 0, got {saturation}")
+    if tenants < 1:
+        raise ValueError(f"tenants must be >= 1, got {tenants}")
+    ops = generate_workload(seed, n_ops, tenants)
+    digest = workload_digest(ops)
+
+    if mode == "wall":
+        capacity = _calibrate(ops, tenants, watermark)
+        rate = capacity * saturation
+        clock = None
+    else:
+        capacity = SIM_CAPACITY_OPS_S
+        rate = capacity * saturation
+        clock = _SimClock()
+
+    e2e = StageTimer()
+    expected_denials = sum(1 for op in ops if op.kind == "tool_denied")
+    expected_redactions = sum(1 for op in ops if op.kind == "tool_secret")
+    observed_denials = 0
+    observed_redactions = 0
+    false_blocks = 0
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = Path(tmp)
+        gw, sitrep = _build_gateway(root, tenants, clock, admission, watermark)
+        ctxs = {t: _tenant_ctx(root, t) for t in range(tenants)}
+        for t in range(tenants):
+            gw.session_start(ctxs[t])
+
+        arrivals = [op.arrival / rate for op in ops]  # seconds from start
+        adm = gw.admission
+
+        if mode == "wall":
+            t0 = time.perf_counter()
+            arrived = 0
+            for i, op in enumerate(ops):
+                sched = t0 + arrivals[i]
+                now = time.perf_counter()
+                while now < sched:  # open-loop: honor the arrival schedule
+                    time.sleep(min(sched - now, 0.0005))
+                    now = time.perf_counter()
+                if adm is not None:
+                    while arrived < len(ops) and t0 + arrivals[arrived] <= now:
+                        arrived += 1
+                    adm.note_queue_depth(arrived - i)
+                obs = _dispatch(gw, op, ctxs[op.tenant])
+                lat_ms = (time.perf_counter() - sched) * 1000.0
+                e2e.add("e2e", lat_ms)
+                e2e.add(f"kind:{op.kind}", lat_ms)
+                observed_denials += _denied(obs, op)
+                observed_redactions += _redacted(obs)
+                false_blocks += _false_block(obs, op)
+            elapsed = time.perf_counter() - t0
+        else:
+            svc_rng = random.Random(f"svc:{seed}")
+            factors = [svc_rng.lognormvariate(0.0, 0.4) for _ in ops]
+            server_free = 0.0
+            base_t = clock.t
+            arrived = 0
+            for i, op in enumerate(ops):
+                start = max(arrivals[i], server_free)
+                clock.t = base_t + start
+                if adm is not None:
+                    while arrived < len(ops) and arrivals[arrived] <= start:
+                        arrived += 1
+                    adm.note_queue_depth(arrived - i)
+                    shed_before = adm.shed
+                obs = _dispatch(gw, op, ctxs[op.tenant])
+                service = _SIM_SERVICE_S[op.kind] * factors[i]
+                if adm is not None and adm.shed > shed_before:
+                    service *= _SIM_SHED_FACTOR
+                done = start + service
+                server_free = done
+                lat_ms = (done - arrivals[i]) * 1000.0
+                e2e.add("e2e", lat_ms)
+                e2e.add(f"kind:{op.kind}", lat_ms)
+                observed_denials += _denied(obs, op)
+                observed_redactions += _redacted(obs)
+                false_blocks += _false_block(obs, op)
+            elapsed = max(server_free, arrivals[-1])
+
+        for t in range(tenants):
+            gw.session_end(ctxs[t])
+
+        status = gw.get_status()
+        hook_stats = {name: dict(st) for name, st in sorted(status["hooks"].items())}
+        admission_stats = dict(status["admission"])
+        if admission_stats.get("shedByTenant"):
+            # Tenant keys are tmp workspace paths — normalize so the
+            # report is stable across runs (the determinism contract).
+            admission_stats["shedByTenant"] = {
+                _normalize_edge(k, root): v
+                for k, v in admission_stats["shedByTenant"].items()}
+
+        if mode == "wall":
+            edge_snaps = {_normalize_edge(name, root): timer.snapshot(qs=_QS)
+                          for name, timer in sorted(gw.stage_timers.items())}
+            stage_counts = {edge: snap["counts"]
+                            for edge, snap in sorted(edge_snaps.items())}
+        else:
+            # Sim reports carry counts only — skip the quantile estimation
+            # the wall snapshot pays, it would be discarded anyway.
+            edge_snaps = {}
+            stage_counts = {_normalize_edge(name, root): timer.counts()
+                            for name, timer in sorted(gw.stage_timers.items())}
+
+        sitrep_report = sitrep.generate()
+        sitrep_line = {
+            "health": sitrep_report["health"],
+            "gatewayShed": ((sitrep_report["collectors"].get("gateway") or {})
+                            .get("shed", None)),
+        }
+        gw.stop()
+
+    e2e_snap = e2e.snapshot(qs=_QS)
+    e2e_q = e2e_snap["quantiles"]
+
+    report = {
+        "metric": "slo_report",
+        "seed": seed,
+        "mode": mode,
+        "saturation": saturation,
+        "tenants": tenants,
+        "admission": admission_stats,
+        "capacity_ops_s": round(capacity, 1),
+        "offered_ops_s": round(rate, 1),
+        "workload": digest,
+        "verdicts": {
+            "expected_denials": expected_denials,
+            "observed_denials": observed_denials,
+            "expected_redactions": expected_redactions,
+            "observed_redactions": observed_redactions,
+            "false_blocks": false_blocks,
+            "losses": (expected_denials - observed_denials)
+                      + (expected_redactions - observed_redactions),
+        },
+        "e2e": {"count": e2e_snap["counts"].get("e2e", 0),
+                **{k: v for k, v in e2e_q.get("e2e", {}).items()},
+                "byKind": {k.split(":", 1)[1]: q
+                           for k, q in sorted(e2e_q.items())
+                           if k.startswith("kind:")}},
+        "stage_counts": stage_counts,
+        "hook_stats": hook_stats,
+        "sitrep": sitrep_line,
+        "elapsed_s": round(elapsed, 3),
+        "throughput_ops_s": round(len(ops) / max(elapsed, 1e-9), 1),
+    }
+    if mode == "wall":
+        # Real per-stage quantiles only exist under a real clock.
+        report["stages"] = {edge: snap["quantiles"]
+                            for edge, snap in edge_snaps.items()}
+    return report
+
+
+def _denied(obs: dict, op) -> int:
+    """Counts only denials of ops that EXPECT one — a false block of a
+    tool_ok op must surface as false_blocks, not inflate observed_denials
+    (compensating errors would zero out the losses gate)."""
+    d = obs.get("decision")
+    return 1 if (op.kind == "tool_denied" and d is not None and d.blocked) else 0
+
+
+def _redacted(obs: dict) -> int:
+    return 1 if obs.get("redacted") else 0
+
+
+def _false_block(obs: dict, op) -> int:
+    d = obs.get("decision")
+    return 1 if (op.kind == "tool_ok" and d is not None and d.blocked) else 0
+
+
+def slo_stage_records(report: dict) -> list:
+    """One machine-readable line per (edge, stage, quantile) — the same
+    pre-attributed-regression discipline as every other bench family."""
+    out = []
+    for edge, stages in (report.get("stages") or {}).items():
+        for stage, qd in stages.items():
+            rec = {"metric": "slo_stage_quantiles", "edge": edge,
+                   "stage": stage}
+            rec.update(qd)
+            out.append(rec)
+    return out
