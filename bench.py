@@ -253,28 +253,31 @@ def synth_cortex_messages(n: int = 2000, seed: int = 7) -> list:
     return out
 
 
-def bench_cortex_ingest(n_messages: int = 2000) -> dict:
-    """Cortex message-ingest throughput through the real gateway hot path
-    (message_received/message_sent hooks → thread/decision/commitment
-    trackers → per-message durable persist), all ten language packs active —
-    the per-message tax ISSUE 5 compiled. Also times the pattern-extraction
-    stage compiled vs interpreter IN-PROCESS, back to back, so the reported
-    speedup is load-matched even when the container is noisy."""
+def journal_stage_records(journal_quantiles: dict) -> list[dict]:
+    """One machine-readable quantile line per journal stage (ISSUE 7 —
+    enqueue / group_wait / commit / fsync / compact), PR-6 histogram
+    quantiles riding each line so a slow durable path arrives
+    pre-attributed to the group-commit stage that ate it."""
+    return [{"metric": "journal_stage_ms", "stage": name, "unit": "ms",
+             "value": qd.get("p50"), "p50": qd.get("p50"),
+             "p95": qd.get("p95"), "p99": qd.get("p99")}
+            for name, qd in (journal_quantiles or {}).items()]
+
+
+def _cortex_ingest_pass(msgs: list, journal_on: bool) -> tuple:
+    """One gateway ingest pass with the journal on or off; returns
+    (elapsed_s, stage_ms, journal_record_or_None, patterns)."""
     import tempfile
 
     from vainplex_openclaw_tpu.core import Gateway
     from vainplex_openclaw_tpu.cortex import CortexPlugin
-    from vainplex_openclaw_tpu.cortex.patterns import (
-        MergedPatterns, resolve_language_codes)
-    from vainplex_openclaw_tpu.cortex.thread_tracker import (
-        extract_signals, extract_signals_interp)
 
-    msgs = synth_cortex_messages(n_messages)
     ctx = {"agent_id": "main", "session_key": "agent:main"}
     with tempfile.TemporaryDirectory() as ws:
         gw = Gateway(config={"workspace": ws})
         plugin = CortexPlugin(workspace=ws, wall_timers=False)
-        gw.load(plugin, plugin_config={"enabled": True, "languages": "all"})
+        gw.load(plugin, plugin_config={"enabled": True, "languages": "all",
+                                       "storage": {"journal": journal_on}})
         gw.start()
         for content, _sender in msgs[:100]:  # warmup: imports, banks, index
             gw.message_received(content, ctx)
@@ -293,9 +296,53 @@ def bench_cortex_ingest(n_messages: int = 2000) -> dict:
         assert trackers.threads.threads, "ingest created no threads"
         assert trackers.decisions.decisions, "ingest recorded no decisions"
         assert trackers.commitments.commitments, "ingest found no commitments"
+        journal_rec = None
+        if journal_on:
+            journal = trackers.journal
+            assert journal is not None, "journal not wired despite config"
+            js = journal.stats()
+            snap = journal.timer.snapshot()
+            assert js["commits"] > 0, "journal never committed during bench"
+            journal_rec = {
+                "fsync": js["fsync"], "commits": js["commits"],
+                "committedRecords": js["committedRecords"],
+                "avgGroupSize": js["avgGroupSize"], "fsyncs": js["fsyncs"],
+                "coalesced": sum(s["coalesced"]
+                                 for s in js["streams"].values()),
+                "compactions": js["compactions"],
+                "quantiles": snap["quantiles"],
+            }
         patterns = plugin.patterns
         gw.stop()
-    rate = n_messages / dt
+    return dt, stage_ms, journal_rec, patterns
+
+
+def bench_cortex_ingest(n_messages: int = 2000) -> dict:
+    """Cortex message-ingest throughput through the real gateway hot path
+    (message_received/message_sent hooks → thread/decision/commitment
+    trackers → durable persist), all ten language packs active. ISSUE 7:
+    the headline is the journal (group-commit) path, A/B'd against the
+    legacy write-per-message oracle in INTERLEAVED passes on the same
+    hardware — journal_speedup is the durable-write Amdahl cap recovered.
+    Also times the pattern-extraction stage compiled vs interpreter
+    in-process (ISSUE 5) so that speedup stays load-matched too."""
+    from vainplex_openclaw_tpu.cortex.patterns import (
+        MergedPatterns, resolve_language_codes)
+    from vainplex_openclaw_tpu.cortex.thread_tracker import (
+        extract_signals, extract_signals_interp)
+
+    msgs = synth_cortex_messages(n_messages)
+    elapsed = {True: 0.0, False: 0.0}
+    stage_ms: dict = {}
+    journal_rec: Optional[dict] = None
+    patterns = None
+    for journal_on in (True, False, True, False):  # interleaved A/B
+        dt, stage, jrec, patterns = _cortex_ingest_pass(msgs, journal_on)
+        elapsed[journal_on] += dt
+        if journal_on:
+            stage_ms, journal_rec = stage, jrec
+    rate = 2 * n_messages / elapsed[True]
+    rate_off = 2 * n_messages / elapsed[False]
 
     texts = [content for content, _ in msgs]
     interp = MergedPatterns(resolve_language_codes("all"), compiled=False)
@@ -317,7 +364,12 @@ def bench_cortex_ingest(n_messages: int = 2000) -> dict:
         "value": round(rate, 1),
         "unit": "msg/s",
         "vs_baseline": round(rate / CORTEX_INGEST_BASELINE, 1),
+        "journal_off_msg_s": round(rate_off, 1),
+        "journal_speedup": round(rate / rate_off, 2),
         "stage_ms": stage_ms,
+        "journal": {k: v for k, v in (journal_rec or {}).items()
+                    if k != "quantiles"},
+        "journal_quantiles": (journal_rec or {}).get("quantiles") or {},
         "extract_us_per_msg": round(extract_us, 1),
         "extract_interp_us_per_msg": round(extract_interp_us, 1),
         "extract_speedup": round(extract_interp_us / extract_us, 1),
@@ -435,6 +487,37 @@ def bench_policy_eval(n: int = 5_000) -> dict:
     builtin rate limiter denies, so the steady state also exercises the
     trust-violation + audit deny path."""
     return _bench_policy_eval("policy_eval_latency", _bench_user_policies(), n)
+
+
+def bench_policy_eval_journal_ab(n: int = 4_000) -> dict:
+    """Governance enforcement latency A/B with the audit journal on vs off
+    (ISSUE 7): the journal replaces the buffered day-file flush with
+    group-committed wal appends on the same flush cadence, so the A/B
+    records what the shared durable path costs the verdict pipeline in both
+    modes. Interleaved passes; same ten regex-gated user policies as the
+    headline latency bench."""
+    elapsed = {True: 0.0, False: 0.0}
+    stats: dict = {}
+    for journal_on in (True, False, True, False):
+        rec = _bench_policy_eval(
+            "policy_eval_latency_journal_pass", _bench_user_policies(), n // 2,
+            plugin_config_extra={"storage": {"journal": journal_on}},
+            post=(lambda p: {"journal": p.engine.journal.stats()})
+            if journal_on else None)
+        elapsed[journal_on] += rec["value"]
+        if journal_on:
+            js = rec["journal"]
+            stats = {"fsync": js["fsync"], "commits": js["commits"],
+                     "avgGroupSize": js["avgGroupSize"],
+                     "compactions": js["compactions"],
+                     "spilled": js["spilled"]}
+    on_ms = elapsed[True] / 2
+    off_ms = elapsed[False] / 2
+    return {"metric": "policy_eval_latency_journal_ab",
+            "value": round(on_ms, 4), "unit": "ms",
+            "journal_off_ms": round(off_ms, 4),
+            "journal_speedup": round(off_ms / on_ms, 2),
+            "journal": stats}
 
 
 def bench_policy_eval_degraded(n: int = 3_000) -> dict:
@@ -1126,6 +1209,7 @@ if __name__ == "__main__":
         sys.exit(0)
     for fn in (bench_event_publish, bench_consumer_read, bench_policy_eval,
                bench_policy_eval_deny, bench_policy_eval_degraded,
+               bench_policy_eval_journal_ab,
                bench_knowledge_ingest, bench_knowledge_search,
                bench_cortex_ingest):
         try:
@@ -1136,6 +1220,8 @@ if __name__ == "__main__":
                     print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
             elif rec.get("metric") == "cortex_message_throughput":
                 for srec in cortex_stage_records(rec.get("stage_ms")):
+                    print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+                for srec in journal_stage_records(rec.get("journal_quantiles")):
                     print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
             elif rec.get("metric") == "policy_eval_latency":
                 # the deny variant's breakdown rides inline in its own record

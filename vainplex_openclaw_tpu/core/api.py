@@ -521,6 +521,14 @@ class PluginApi:
         knowing each plugin's status shape."""
         self._gateway._register_stage_timer(self.id, name, timer)
 
+    def register_journal(self, name: str, journal: Any) -> None:
+        """Publish a group-commit journal into the gateway's observability
+        registry (ISSUE 7): ``Gateway.get_status()["journal"]`` and sitrep's
+        journal collector read pending/group-size/fsync/compaction/replay
+        counters from one place. Plugins sharing a workspace journal all
+        register the same instance under the same name — idempotent."""
+        self._gateway._register_journal(self.id, name, journal)
+
     def get_gateway_status(self) -> dict:
         """Public view of ``Gateway.get_status()`` (ISSUE 4's degradation
         surface) so plugin status commands can report degraded/breaker state

@@ -105,6 +105,12 @@ class Gateway:
         # Observability registry (ISSUE 6): every serving edge publishes its
         # StageTimer here so sitrep/SLO surfaces read one place.
         self.stage_timers: dict[str, Any] = {}
+        # Journal registry (ISSUE 7): plugins publish their (shared)
+        # group-commit journals; get_status() exports pending/group/fsync/
+        # compaction/replay counters and sitrep's journal collector reads
+        # them. Multiple plugins sharing one workspace journal register the
+        # same name — last one wins, same instance either way.
+        self.journals: dict[str, Any] = {}
         # Admission control (ISSUE 6): None unless configured — seed
         # behavior is "never shed".
         self.admission = AdmissionController.from_config(
@@ -147,6 +153,9 @@ class Gateway:
     def _register_stage_timer(self, plugin_id: str, name: str, timer: Any) -> None:
         self.stage_timers[name] = timer
 
+    def _register_journal(self, plugin_id: str, name: str, journal: Any) -> None:
+        self.journals[name] = journal
+
     # ── lifecycle ────────────────────────────────────────────────────
 
     def _start_service(self, plugin_id: str, service: PluginService) -> None:
@@ -174,6 +183,16 @@ class Gateway:
                     _run(out)
             except Exception as exc:  # noqa: BLE001
                 self.logger.error(f"[gateway] service {plugin_id}/{service.id} failed to stop: {exc}")
+        # Journals close LAST (ISSUE 7): plugin stop paths above flush
+        # through them. Closing compacts + persists watermarks and releases
+        # the wal fd; a later get_journal() on the same workspace opens a
+        # fresh instance, and a straggler append falls back to its legacy
+        # write path (append() returns False on a closed journal).
+        for journal in self.journals.values():
+            try:
+                journal.close()
+            except Exception as exc:  # noqa: BLE001 — stop paths can't raise
+                self.logger.error(f"[gateway] journal close failed: {exc}")
         self._started = False
 
     # ── generic hook firing (the mock-api `_fire` equivalent) ────────
@@ -392,4 +411,5 @@ class Gateway:
             "hooks": hooks,
             "admission": (self.admission.stats() if self.admission is not None
                           else {"enabled": False}),
+            "journal": {name: j.stats() for name, j in self.journals.items()},
         }

@@ -13,7 +13,8 @@ import time
 from pathlib import Path
 from typing import Callable
 
-from .storage import is_file_older_than, iso_now, load_json, load_text, reboot_dir, save_text
+from .storage import (is_file_older_than, iso_now, journal_barrier, load_json,
+                      load_text, reboot_dir, save_text)
 
 PRIORITY_ORDER = {"high": 0, "medium": 1, "low": 2}
 PRIORITY_EMOJI = {"high": "🔴", "medium": "🟡", "low": "🟢"}
@@ -43,6 +44,7 @@ class BootContextGenerator:
         self.clock = clock
 
     def _threads_data(self) -> dict:
+        journal_barrier(self.workspace)  # make journaled state readable
         data = load_json(reboot_dir(self.workspace) / "threads.json")
         if isinstance(data, list):
             return {"threads": data}

@@ -49,9 +49,11 @@ def detect_commitments(text: str) -> list[str]:
 
 
 class CommitmentTracker:
+    STREAM = "cortex:commitments"
+
     def __init__(self, workspace: str | Path, config: dict, logger,
                  clock: Callable[[], float] = time.time, wall_timers: bool = True,
-                 timer: Optional[StageTimer] = None):
+                 timer: Optional[StageTimer] = None, journal=None):
         self.config = {"enabled": True, "overdueDays": 7, "maxCommitments": 100,
                        "debounceSeconds": 15, **(config or {})}
         self.logger = logger
@@ -59,6 +61,13 @@ class CommitmentTracker:
         self.timer = timer or StageTimer()
         self.path = reboot_dir(workspace) / "commitments.json"
         self.writeable = ensure_reboot_dir(workspace, logger)
+        # Shared group-commit journal (ISSUE 7). The 15 s debounce cadence
+        # stays either way; in journal mode a debounce fire appends the state
+        # to the wal and compacts it back to commitments.json (see _save_now).
+        # ``journal=None`` keeps the legacy debounced atomic write verbatim.
+        self.journal = journal
+        if journal is not None:
+            journal.register_snapshot(self.STREAM, self.path, indent=None)
         data = load_json(self.path)
         self.commitments: list[dict] = data.get("commitments") or []
         self._dirty = False
@@ -139,8 +148,20 @@ class CommitmentTracker:
         if not self.writeable:
             return
         t0 = time.perf_counter()
-        ok = save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
-                                   "commitments": self.commitments}, self.logger)
+        data = {"version": 1, "updated": iso_now(self.clock),
+                "commitments": self.commitments}
+        if self.journal is not None:
+            # Commitments keep the 15 s debounce cadence in journal mode
+            # (they were never the per-message bottleneck); a debounce fire
+            # journals the state AND compacts it, so every reader of
+            # commitments.json — including tests driving the debouncer
+            # directly — sees the file current right after the save.
+            ok = self.journal.append(self.STREAM, data)
+            ok = self.journal.compact(self.STREAM) and ok
+            if not ok:
+                ok = save_json(self.path, data, self.logger)
+        else:
+            ok = save_json(self.path, data, self.logger)
         self.timer.add("persist", (time.perf_counter() - t0) * 1000.0)
         if ok:
             # A failed save must stay dirty so the next flush retries it —
@@ -157,4 +178,6 @@ class CommitmentTracker:
         self._debouncer.flush()
         if self._dirty:
             self._save_now()
+        if self.journal is not None:
+            return self.journal.compact(self.STREAM)
         return True

@@ -24,9 +24,11 @@ _WHY_RE = re.compile(
 
 
 class DecisionTracker:
+    STREAM = "cortex:decisions"
+
     def __init__(self, workspace: str | Path, config: dict, patterns: MergedPatterns,
                  logger, clock: Callable[[], float] = time.time,
-                 timer: Optional[StageTimer] = None):
+                 timer: Optional[StageTimer] = None, journal=None):
         self.config = {"enabled": True, "dedupeWindowHours": 24, "maxDecisions": 200,
                        **(config or {})}
         self.patterns = patterns
@@ -35,6 +37,10 @@ class DecisionTracker:
         self.timer = timer or StageTimer()
         self.path = reboot_dir(workspace) / "decisions.json"
         self.writeable = ensure_reboot_dir(workspace, logger)
+        # Shared group-commit journal (ISSUE 7); None = legacy write path.
+        self.journal = journal
+        if journal is not None:
+            journal.register_snapshot(self.STREAM, self.path, indent=None)
         data = load_json(self.path)
         self.decisions: list[dict] = data.get("decisions") or []
 
@@ -132,10 +138,17 @@ class DecisionTracker:
         if not self.writeable:
             return
         t0 = time.perf_counter()
-        save_json(self.path, {"version": 1, "updated": iso_now(self.clock),
-                              "decisions": self.decisions}, self.logger)
+        data = {"version": 1, "updated": iso_now(self.clock),
+                "decisions": self.decisions}
+        if self.journal is not None:
+            if not self.journal.append(self.STREAM, data):
+                save_json(self.path, data, self.logger)
+        else:
+            save_json(self.path, data, self.logger)
         self.timer.add("persist", (time.perf_counter() - t0) * 1000.0)
 
     def flush(self) -> bool:
         self.persist()
+        if self.journal is not None:
+            return self.journal.compact(self.STREAM)
         return True

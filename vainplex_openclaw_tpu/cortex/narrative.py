@@ -7,7 +7,7 @@ import time
 from pathlib import Path
 from typing import Callable
 
-from .storage import iso_now, load_json, reboot_dir, save_text
+from .storage import iso_now, journal_barrier, load_json, reboot_dir, save_text
 
 
 class NarrativeGenerator:
@@ -18,6 +18,7 @@ class NarrativeGenerator:
         self.clock = clock
 
     def generate(self) -> str:
+        journal_barrier(self.workspace)
         rd = reboot_dir(self.workspace)
         threads_data = load_json(rd / "threads.json")
         decisions_data = load_json(rd / "decisions.json")

@@ -18,6 +18,7 @@ from typing import Callable, Optional
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand
+from ..storage.journal import get_journal, journal_settings
 from ..utils.stage_timer import StageTimer
 from .boot_context import BootContextGenerator
 from .commitment_tracker import CommitmentTracker
@@ -47,6 +48,11 @@ DEFAULTS = {
     "llmEnhance": {"enabled": False, "batchSize": 3},
     "registerTools": True,
     "traceAnalyzer": {"enabled": False},
+    # Group-commit write-ahead journal (ISSUE 7): per-message tracker
+    # persists append to the shared workspace journal instead of paying an
+    # atomic rename each message. ``storage.journal: false`` restores the
+    # legacy write-per-message path end-to-end (the durability oracle).
+    "storage": {"journal": True},
 }
 
 MANIFEST = PluginManifest(
@@ -80,6 +86,8 @@ MANIFEST = PluginManifest(
             "llmEnhance": enabled_section(
                 batchSize={"type": "integer", "minimum": 1}),
             "registerTools": {"type": "boolean"},
+            "storage": {"type": "object", "properties": {
+                "journal": {"type": ["boolean", "object"]}}},
             "traceAnalyzer": enabled_section(
                 languages={"type": "array", "items": {"type": "string"}},
                 fetchBatchSize={"type": "integer", "minimum": 1},
@@ -107,13 +115,23 @@ class _WorkspaceTrackers:
         # decisions/commitments/persist accumulate into a single breakdown
         # surfaced by status_text()/cortexstatus and bench.py cortex_stage_ms.
         self.timer = StageTimer()
+        # Shared per-workspace group-commit journal (ISSUE 7) — the same
+        # instance knowledge/governance/events use for this workspace, so
+        # one fsync covers every edge's records. None (escape hatch or an
+        # unopenable journal dir) keeps every tracker on its legacy path.
+        js = journal_settings(config)
+        self.journal = (get_journal(workspace, js, clock=clock,
+                                    wall=wall_timers, logger=logger)
+                        if js["enabled"] else None)
         self.threads = ThreadTracker(workspace, config["threads"], patterns, logger,
-                                     clock, timer=self.timer)
+                                     clock, timer=self.timer, journal=self.journal)
         self.decisions = DecisionTracker(workspace, config["decisions"], patterns, logger,
-                                         clock, timer=self.timer)
+                                         clock, timer=self.timer,
+                                         journal=self.journal)
         self.commitments = CommitmentTracker(workspace, config["commitments"], logger,
                                              clock, wall_timers=wall_timers,
-                                             timer=self.timer)
+                                             timer=self.timer,
+                                             journal=self.journal)
         self.pre_compaction = PreCompaction(workspace, config, logger, self.threads,
                                             self.decisions, self.commitments, clock)
         self.message_sent_fired = False
@@ -207,6 +225,15 @@ class CortexPlugin:
                 # attribute latency to the tenant that paid it.
                 self._api.register_stage_timer(f"cortex:{ws}",
                                                self._trackers[ws].timer)
+            journal = self._trackers[ws].journal
+            if (journal is not None and self._api is not None
+                    and hasattr(self._api, "register_journal")):
+                # Journal stats surface (ISSUE 7 satellite): pending/group/
+                # fsync/compaction/replay counters through Gateway.get_status
+                # and the sitrep journal collector; quantiles via the
+                # journal's own StageTimer.
+                self._api.register_journal(f"journal:{ws}", journal)
+                self._api.register_stage_timer(f"journal:{ws}", journal.timer)
         return self._trackers[ws]
 
     # ── hook handlers (every one fail-open) ──────────────────────────
@@ -316,6 +343,13 @@ class CortexPlugin:
                 lines.append(f"  {ws} stage ms: {snap['stages_ms']}")
                 p99 = {k: q["p99"] for k, q in snap["quantiles"].items()}
                 lines.append(f"  {ws} stage p99 ms: {p99}")
+            if trackers.journal is not None:
+                js = trackers.journal.stats()
+                lines.append(
+                    f"  {ws} journal: pending={js['pendingRecords']} "
+                    f"commits={js['commits']} avgGroup={js['avgGroupSize']} "
+                    f"fsyncs={js['fsyncs']} compactions={js['compactions']} "
+                    f"spilled={js['spilled']}")
         if self._api is not None:
             # Public degradation surface (ISSUE 4/5): also tells the operator
             # when the gateway is shedding cortex's own hooks.

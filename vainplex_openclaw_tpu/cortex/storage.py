@@ -12,11 +12,28 @@ from pathlib import Path
 from typing import Any, Optional
 
 from ..storage.atomic import read_json, write_json_atomic
+from ..storage.journal import peek_journal
 from ..storage.workspace import is_file_older_than, is_writable, reboot_dir
 from ..utils.ids import prng_uuid4
 
-__all__ = ["ensure_reboot_dir", "is_file_older_than", "load_json", "load_text",
-           "new_id", "reboot_dir", "save_json", "save_text"]
+__all__ = ["ensure_reboot_dir", "is_file_older_than", "journal_barrier",
+           "load_json", "load_text", "new_id", "reboot_dir", "save_json",
+           "save_text"]
+
+
+def journal_barrier(workspace: str | Path) -> None:
+    """Read barrier for file-mediated readers (ISSUE 7): when the workspace
+    persists through the group-commit journal, tracker state may still sit
+    in the wal — compacting first makes the JSON files current, so readers
+    (agent tools, boot context, narrative) keep their read-the-file
+    convention untouched. A no-op without a journal; compaction errors are
+    the journal's to count, never the reader's to crash on."""
+    j = peek_journal(workspace)
+    if j is not None:
+        try:
+            j.compact()
+        except Exception:  # noqa: BLE001 — readers must stay fail-open
+            pass
 
 
 def ensure_reboot_dir(workspace: str | Path, logger=None) -> bool:

@@ -111,9 +111,11 @@ def _sig_words(text: str) -> frozenset:
 
 
 class ThreadTracker:
+    STREAM = "cortex:threads"
+
     def __init__(self, workspace: str | Path, config: dict, patterns: MergedPatterns,
                  logger, clock: Callable[[], float] = time.time,
-                 timer: Optional[StageTimer] = None):
+                 timer: Optional[StageTimer] = None, journal=None):
         self.config = {"enabled": True, "pruneDays": 7, "maxThreads": 50, **(config or {})}
         self.patterns = patterns
         self.logger = logger
@@ -121,6 +123,15 @@ class ThreadTracker:
         self.timer = timer or StageTimer()
         self.path = reboot_dir(workspace) / "threads.json"
         self.writeable = ensure_reboot_dir(workspace, logger)
+        # Group-commit WAL (ISSUE 7): per-message persists append the full
+        # state to the shared journal instead of paying an atomic rename each
+        # message; registration completes any crash-interrupted compaction so
+        # the load below sees the journaled state. ``journal=None`` (the
+        # storage.journal:false escape hatch, and every direct construction
+        # in tests) keeps the legacy write-per-message path verbatim.
+        self.journal = journal
+        if journal is not None:
+            journal.register_snapshot(self.STREAM, self.path, indent=None)
         data = load_json(self.path)
         if isinstance(data, list):  # legacy format: bare array
             data = {"threads": data}
@@ -340,7 +351,7 @@ class ThreadTracker:
         if not self.writeable:
             return
         t0 = time.perf_counter()
-        ok = save_json(self.path, self._build_data(), self.logger)
+        ok = self._save(self._build_data())
         self.timer.add("persist", (time.perf_counter() - t0) * 1000.0)
         if not ok:
             self.writeable = False
@@ -348,7 +359,26 @@ class ThreadTracker:
         else:
             self.dirty = False
 
+    def _save(self, data: dict) -> bool:
+        if self.journal is not None:
+            # Journal enqueue: buffered now, group-committed within the
+            # bounded window, compacted back to threads.json on flush/size
+            # thresholds. A failed inline commit falls back to the legacy
+            # atomic write so the state never rides on a broken journal.
+            if self.journal.append(self.STREAM, data):
+                return True
+            return save_json(self.path, data, self.logger)
+        return save_json(self.path, data, self.logger)
+
     def flush(self) -> bool:
+        if self.journal is not None:
+            # Journal mode: compaction makes threads.json current even when
+            # nothing is dirty here (earlier appends may still sit in the
+            # wal) — flush is the read-your-writes barrier.
+            if self.dirty and self.writeable:
+                if self._save(self._build_data()):
+                    self.dirty = False
+            return self.journal.compact(self.STREAM)
         if not self.dirty:
             return True
         ok = save_json(self.path, self._build_data(), self.logger)

@@ -5,10 +5,11 @@ from __future__ import annotations
 
 from pathlib import Path
 
-from .storage import load_json, reboot_dir
+from .storage import journal_barrier, load_json, reboot_dir
 
 
 def _threads(workspace) -> list[dict]:
+    journal_barrier(workspace)
     data = load_json(reboot_dir(workspace) / "threads.json")
     if isinstance(data, list):
         return data
@@ -16,10 +17,12 @@ def _threads(workspace) -> list[dict]:
 
 
 def _decisions(workspace) -> list[dict]:
+    journal_barrier(workspace)
     return load_json(reboot_dir(workspace) / "decisions.json").get("decisions") or []
 
 
 def _commitments(workspace) -> list[dict]:
+    journal_barrier(workspace)
     return load_json(reboot_dir(workspace) / "commitments.json").get("commitments") or []
 
 

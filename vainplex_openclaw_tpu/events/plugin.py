@@ -13,6 +13,7 @@ from typing import Callable, Optional
 from ..core.api import PluginCommand, PluginService
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest
+from ..storage.journal import get_journal, journal_settings
 from .envelope import ClawEvent, build_envelope
 from .mappings import EXTRA_EMITTERS, HOOK_MAPPINGS, ExtraEmitter, HookMapping
 from .subjects import build_subject
@@ -27,6 +28,10 @@ DEFAULTS = {
     "fileRoot": None,  # required for transport=file
     "retention": {"maxMsgs": 100_000, "maxBytes": 256 * 1024 * 1024, "maxAgeS": None},
     "publishPriority": 10_000,  # after every other plugin has seen the hook
+    # storage.journal (ISSUE 7): file-transport publishes append to the
+    # shared group-commit workspace journal (compacted into the daily files
+    # on read barriers); false restores the per-event day-file append.
+    "storage": {"journal": True},
 }
 
 MANIFEST = PluginManifest(
@@ -47,6 +52,8 @@ MANIFEST = PluginManifest(
                 "maxBytes": {"type": "integer", "minimum": 1},
                 "maxAgeS": {"type": ["number", "null"]}}},
             "publishPriority": {"type": "integer"},
+            "storage": {"type": "object", "properties": {
+                "journal": {"type": ["boolean", "object"]}}},
         },
     },
     commands=("eventstatus",),
@@ -73,7 +80,11 @@ class EventStorePlugin:
             api.logger.info("disabled via config")
             return
 
-        self.transport = self._injected_transport or self._build_transport(api.logger)
+        self.transport = self._injected_transport or self._build_transport(api)
+        journal = getattr(self.transport, "journal", None)
+        if journal is not None and hasattr(api, "register_journal"):
+            workspace = api.config.get("workspace") or "."
+            api.register_journal(f"journal:{workspace}", journal)
 
         api.register_service(PluginService(id="eventstore", start=self._start, stop=self._stop))
         api.register_command(PluginCommand(name="eventstatus", description="Event store status",
@@ -87,7 +98,8 @@ class EventStorePlugin:
         for extra in EXTRA_EMITTERS:
             api.on(extra.hook_name, self._make_extra_handler(extra), priority=default_prio + 1)
 
-    def _build_transport(self, logger):
+    def _build_transport(self, api):
+        logger = api.logger
         kind = self.config.get("transport", "memory")
         r = self.config.get("retention", {})
         if kind == "nats":
@@ -100,7 +112,17 @@ class EventStorePlugin:
                 return t
             logger.warn("falling back to in-memory transport")
         if kind == "file" and self.config.get("fileRoot"):
-            return FileTransport(self.config["fileRoot"], clock=self.clock)
+            # Shared per-workspace group-commit journal (ISSUE 7); injected
+            # transports are never wrapped — their owner decides. wall=True:
+            # acked events must reach the wal within windowMs even on a
+            # quiet store (a plugin-built transport is production, not a
+            # seeded chaos rig — those inject their own journal).
+            js = journal_settings(self.config)
+            journal = (get_journal(api.config.get("workspace") or ".", js,
+                                   clock=self.clock, wall=True, logger=logger)
+                       if js["enabled"] else None)
+            return FileTransport(self.config["fileRoot"], clock=self.clock,
+                                 journal=journal)
         return MemoryTransport(
             max_msgs=r.get("maxMsgs", 100_000),
             max_bytes=r.get("maxBytes", 256 * 1024 * 1024),

@@ -29,8 +29,11 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterator, Optional, Protocol
 
+import os
+
 from ..resilience.faults import maybe_fail, write_with_faults
 from ..storage.atomic import daily_jsonl_name, jsonl_dumps, repair_torn_tail
+from ..storage.journal import dedup_against_tail
 from .envelope import ClawEvent
 from .subjects import build_subject
 
@@ -326,7 +329,10 @@ class FileTransport:
     shrunken file (rotation, truncation) is re-parsed from scratch.
     """
 
-    def __init__(self, root: str | Path, clock: Callable[[], float] = time.time):
+    STREAM = "events:log"
+
+    def __init__(self, root: str | Path, clock: Callable[[], float] = time.time,
+                 journal=None):
         self.root = Path(root)
         self.clock = clock
         self.stats = TransportStats()
@@ -334,8 +340,28 @@ class FileTransport:
         # True when the current day file may end mid-line: after a failed
         # append in THIS process, and at startup (a crashed previous writer
         # leaves a torn tail this process would otherwise merge its first
-        # record into). The first publish newline-isolates it.
+        # record into). The first append newline-isolates it.
         self._tail_dirty = True
+        # Persistent same-day append handle (ISSUE 7 satellite — the audit
+        # trail's PR-3 day-handle fast path, mirrored): reopening the day
+        # file per event cost an open+close round-trip per publish. The
+        # handle rolls with the day; the rotated/deleted-underneath check
+        # (stat+fstat) runs at most once per clock second.
+        self._day_fh = None
+        self._day_path: Optional[Path] = None
+        self._day_checked = -1  # whole clock second of the last inode check
+        self._day_meta: tuple = ("", None)
+        self.replay_deduped = 0
+        # Shared group-commit journal (ISSUE 7): publishes append to the wal
+        # and compact into the daily files on fetch/count barriers or the
+        # journal's own thresholds. Registration replays crash-stranded
+        # records into the day files BEFORE seq recovery reads them.
+        self.journal = journal
+        if journal is not None:
+            journal.register_append(
+                self.STREAM, self._journal_sink,
+                auto_compact=int(journal.settings.get("compactEveryRecords",
+                                                      512)))
         self._seq = self._recover_seq()
 
     def _recover_seq(self) -> int:
@@ -345,37 +371,124 @@ class FileTransport:
         seq = 0
         for f in self.root.glob("*.jsonl"):
             seq = max(seq, _last_seq_in_file(f))
+        if self.journal is not None:
+            # Records whose recovery-compaction failed are still pending in
+            # the journal; their event seqs must stay claimed.
+            for rec in self.journal.pending_payloads(self.STREAM):
+                try:
+                    seq = max(seq, int(rec.get("seq") or 0))
+                except (AttributeError, TypeError, ValueError):
+                    continue
         return seq
 
-    def publish(self, subject: str, event: ClawEvent) -> bool:
-        try:
-            self._seq += 1
-            event.seq = self._seq
-            path = self.root / daily_jsonl_name(self.clock())
-            rec = {"subject": subject, **event.to_dict()}
-            line = jsonl_dumps(rec) + "\n"
+    # ── day-file appends (shared by legacy publish + journal compaction) ─
+
+    def _close_day_handle(self) -> None:
+        if self._day_fh is not None and not self._day_fh.closed:
+            try:
+                self._day_fh.close()
+            except OSError:
+                pass
+        self._day_fh, self._day_path = None, None
+
+    def _day_handle(self, path: Path):
+        fh = self._day_fh
+        if fh is not None and not fh.closed and self._day_path == path:
+            # Whole-second memo: raw float clocks never compare equal twice,
+            # which would re-pay the stat+fstat pair on EVERY append.
+            now = int(self.clock())
+            if now != self._day_checked:
+                self._day_checked = now
+                try:
+                    disk = os.stat(path)
+                    held = os.fstat(fh.fileno())
+                    if (disk.st_dev, disk.st_ino) != (held.st_dev, held.st_ino):
+                        fh = None  # rotated: same name, different inode
+                except OSError:
+                    fh = None  # deleted/renamed: recreate like the seed did
+        if fh is None or fh.closed or self._day_path != path:
+            self._close_day_handle()
             try:
                 fh = path.open("a", encoding="utf-8")
             except FileNotFoundError:
                 path.parent.mkdir(parents=True, exist_ok=True)
                 fh = path.open("a", encoding="utf-8")
-            with fh:
-                if self._tail_dirty:
-                    if not repair_torn_tail(path):
-                        # Repair failed: appending now would concatenate this
-                        # record onto the torn tail and corrupt BOTH.
-                        raise OSError("torn tail unrepaired; append deferred")
-                    self._tail_dirty = False
-                write_with_faults("transport.publish", fh.write, line)
+            self._day_fh, self._day_path = fh, path
+            self._day_checked = int(self.clock())
+        return fh
+
+    def _append_text(self, path: Path, text: str, site: str) -> None:
+        fh = self._day_handle(path)
+        if self._tail_dirty:
+            if not repair_torn_tail(path):
+                # Repair failed: appending now would concatenate this
+                # record onto the torn tail and corrupt BOTH.
+                raise OSError("torn tail unrepaired; append deferred")
+            self._tail_dirty = False
+        write_with_faults(site, fh.write, text)
+        # Flush to the OS so fetch()'s separate read handle (and other
+        # processes) see the record — the per-publish close used to do this.
+        fh.flush()
+
+    def _journal_sink(self, batch: list, dedup: bool) -> None:
+        """Journal compaction: committed wal records → daily files, grouped
+        by the day each record was published under (meta ``d``)."""
+        by_day: dict[str, list] = {}
+        for rec in batch:
+            by_day.setdefault((rec[2] or {}).get("d")
+                              or daily_jsonl_name(self.clock()), []).append(rec)
+        try:
+            for day, records in by_day.items():
+                path = self.root / day
+                if dedup:
+                    records, dropped = dedup_against_tail(path, records)
+                    self.replay_deduped += dropped
+                    if not records:
+                        continue
+                self._append_text(path,
+                                  "".join(raw + "\n" for _q, raw, _m in records),
+                                  "transport.compact")
+        except OSError:
+            # A torn compaction write must be newline-isolated before the
+            # next append, and the handle may be dead — same discipline as a
+            # failed legacy publish. The journal retains the batch for retry.
+            self._tail_dirty = True
+            self._close_day_handle()
+            raise
+
+    def publish(self, subject: str, event: ClawEvent) -> bool:
+        try:
+            self._seq += 1
+            event.seq = self._seq
+            rec = {"subject": subject, **event.to_dict()}
+            if self.journal is not None:
+                # One meta dict per day — the journal memoizes its encoding
+                # by identity, so reusing the dict collapses a commit
+                # batch's meta encodes to one.
+                day = daily_jsonl_name(self.clock())
+                if self._day_meta[0] != day:
+                    self._day_meta = (day, {"d": day})
+                maybe_fail("transport.publish")
+                if not self.journal.append(self.STREAM, rec,
+                                           meta=self._day_meta[1]):
+                    raise OSError(self.journal.last_error
+                                  or "journal closed")
+                self.stats.published += 1
+                return True
+            line = jsonl_dumps(rec) + "\n"
+            self._append_text(self.root / daily_jsonl_name(self.clock()), line,
+                              "transport.publish")
             self.stats.published += 1
             return True
         except Exception as exc:  # noqa: BLE001
             self.stats.publish_failures += 1
             self.stats.last_error = str(exc)
             # The failed write may have landed a partial line; the next
-            # publish newline-isolates it so one torn record can't merge
-            # with (and corrupt) the record appended after it.
+            # append newline-isolates it so one torn record can't merge
+            # with (and corrupt) the record appended after it. The handle may
+            # sit on a half-written line or a dead fd — reopen next append.
             self._tail_dirty = True
+            self._close_day_handle()
             return False
 
     def _refresh_file(self, path: Path) -> Optional[_FileEntry]:
@@ -446,6 +559,11 @@ class FileTransport:
             path.rename(path.with_name(path.name + ".quarantined"))
         except OSError:
             return entry  # rename failed: keep serving the (empty) entry
+        if path == self._day_path:
+            # Our own append handle would keep writing to the quarantined
+            # inode — every later record silently lost (the per-publish
+            # reopen used to sidestep this; the persistent handle must not).
+            self._close_day_handle()
         self.stats.quarantined_files += 1
         self._index.pop(path, None)
         return None
@@ -489,8 +607,16 @@ class FileTransport:
             if parsed is not None:
                 yield parsed
 
+    def _journal_barrier(self) -> None:
+        """Readers see through the wal: compact pending records into the
+        day files before serving a fetch/count (failures are counted by the
+        journal and the reader serves what did land)."""
+        if self.journal is not None:
+            self.journal.compact(self.STREAM)
+
     def fetch(self, subject_filter: str = ">", start_seq: int = 0,
               batch: Optional[int] = None) -> Iterator[ClawEvent]:
+        self._journal_barrier()
         n = 0
         filt = _SubjectFilter(subject_filter)
         matches = filt.matches
@@ -516,13 +642,15 @@ class FileTransport:
         return self._seq
 
     def event_count(self) -> int:
+        self._journal_barrier()
         return sum(entry.count for _, entry in self._refresh_index())
 
     def healthy(self) -> bool:
         return True
 
     def drain(self) -> None:
-        pass
+        self._journal_barrier()
+        self._close_day_handle()
 
 
 def parse_nats_url(url: str) -> dict:

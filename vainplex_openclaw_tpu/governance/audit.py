@@ -19,6 +19,7 @@ from ..resilience.faults import maybe_fail, write_with_faults
 from ..utils.ids import prng_uuid4
 from ..storage.atomic import (append_jsonl, jsonl_dumps, read_jsonl,
                               repair_torn_tail)
+from ..storage.journal import dedup_against_tail
 from .types import MatchedPolicy
 from .util import ALTERNATION_UNSAFE
 
@@ -116,12 +117,26 @@ def create_redactor(patterns: list[str]):
 
 
 class AuditTrail:
+    STREAM = "governance:audit"
+
     def __init__(self, config: dict, workspace: str | Path, logger,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time, journal=None):
         self.config = config or {}
         self.audit_dir = Path(workspace) / "governance" / "audit"
         self.logger = logger
         self.clock = clock
+        # Shared group-commit journal (ISSUE 7). Records append to the wal
+        # per verdict and compact into the daily JSONL files on the SAME
+        # cadence the legacy path flushed (FLUSH_THRESHOLD, failure backoff,
+        # spill-to-cap) — flushFailures/spilled/buffered keep their exact
+        # legacy semantics, the day files stay the read path, and recovery
+        # replays crash-stranded records with tail dedup. ``journal=None``
+        # is the storage.journal:false escape hatch (legacy buffer+append).
+        # Registered at the END of __init__: registration may immediately
+        # replay crash-stranded records through _journal_sink.
+        self.journal = journal
+        self._journal_buffered = 0
+        self._day_meta: tuple = ("", None)
         self.redact = create_redactor(self.config.get("redactPatterns", []))
         # Optional deep scrubber (wired to the redaction subsystem's
         # credential-only engine): vault resolution re-injects REAL secrets
@@ -134,6 +149,7 @@ class AuditTrail:
                                                 MAX_BUFFERED_RECORDS))
         self.flush_failures = 0
         self.spilled = 0
+        self.replay_deduped = 0
         self.last_flush_error: Optional[str] = None
         # Flush gate with failure backoff: after a failed flush the next
         # attempt waits for FLUSH_THRESHOLD *more* records — re-encoding the
@@ -148,6 +164,8 @@ class AuditTrail:
         self._controls_cache: dict[tuple, list[str]] = {}
         self._day_fh = None
         self._day_name = ""
+        if journal is not None:
+            journal.register_append(self.STREAM, self._journal_sink)
 
     def _date_str(self, ts: float) -> str:
         day = int(ts // 86400)
@@ -199,13 +217,80 @@ class AuditTrail:
             "evaluationUs": evaluation_us,
             "controls": self._controls_for(matched, verdict),
         }
+        if self.journal is not None:
+            self.today_count += 1
+            # Day routed at record time (legacy grouped per flush batch):
+            # replayed records land in the same file a live flush would use.
+            # One meta dict per day — the journal memoizes its encoding by
+            # identity — and a local pending estimate (resynced on flush)
+            # spares the verdict path a lock round-trip per record.
+            day = self._date_str(now)
+            if self._day_meta[0] != day:
+                self._day_meta = (day, {"d": day})
+            if self.journal.append(self.STREAM, rec, meta=self._day_meta[1]):
+                self._journal_buffered += 1
+                if self._journal_buffered >= self._next_flush_len:
+                    self.flush()
+                return rec
+            # Journal closed (record NOT accepted): the record must not
+            # vanish — fall through to the legacy buffer.
+            self.today_count -= 1  # the legacy path re-counts below
         self.buffer.append(rec)
         self.today_count += 1
         if len(self.buffer) >= self._next_flush_len:
             self.flush()
         return rec
 
+    def _journal_sink(self, batch: list, dedup: bool) -> None:
+        """Journal compaction: append committed records to their day files.
+        ``dedup=True`` after a failed/crashed attempt — records already at a
+        target's tail are skipped (at-least-once, duplicates only across a
+        torn line that never fully landed)."""
+        by_day: dict[str, list] = {}
+        for rec in batch:
+            by_day.setdefault((rec[2] or {}).get("d") or
+                              self._date_str(self.clock()), []).append(rec)
+        for day, records in by_day.items():
+            path = self.audit_dir / f"{day}.jsonl"
+            if dedup:
+                records, dropped = dedup_against_tail(path, records)
+                self.replay_deduped += dropped
+                if not records:
+                    continue
+            self._append_day_text(day, "".join(raw + "\n"
+                                               for _q, raw, _m in records))
+
+    def _journal_flush_failed(self) -> None:
+        """Mirror of ``_flush_failed`` for journal compaction failures: same
+        counters, same bounded retention (spill-to-cap, oldest counted), same
+        threshold backoff — degradation must look identical either way."""
+        self.flush_failures += 1
+        self.last_flush_error = (self.journal.stream_error(self.STREAM)
+                                 or self.journal.last_error or "journal compact failed")
+        pending = self.journal.pending_count(self.STREAM)
+        self.logger.error(f"Audit flush failed (#{self.flush_failures}, "
+                          f"buffered={pending}): {self.last_flush_error}")
+        if self._day_fh is not None and not self._day_fh.closed:
+            try:
+                self._day_fh.close()
+            except OSError:
+                pass
+        self._day_fh, self._day_name = None, ""
+        self.spilled += self.journal.spill(self.STREAM, self.max_buffered)
+        self._next_flush_len = (self.journal.pending_count(self.STREAM)
+                                + FLUSH_THRESHOLD)
+
     def flush(self) -> None:
+        if self.journal is not None:
+            if self.journal.pending_count(self.STREAM) == 0:
+                self._journal_buffered = 0
+                return
+            if self.journal.compact(self.STREAM):
+                self._next_flush_len = FLUSH_THRESHOLD
+            else:
+                self._journal_flush_failed()
+            self._journal_buffered = self.journal.pending_count(self.STREAM)
+            return
         if not self.buffer:
             return
         try:
@@ -254,12 +339,18 @@ class AuditTrail:
         self._next_flush_len = len(self.buffer) + FLUSH_THRESHOLD
 
     def _append_day(self, day: str, records: list[dict]) -> None:
+        self._append_day_text(day,
+                              "".join(jsonl_dumps(rec) + "\n" for rec in records))
+
+    def _append_day_text(self, day: str, text: str) -> None:
         """Append via a persistent per-day handle: reopening the same daily
         file on every 100-record flush was a measurable slice of the audit
         stage. The handle rolls over when the day does, is re-opened when the
         file on disk was rotated/deleted out from under it (writing to an
         unlinked inode would silently lose audit records), and contents are
-        flushed to the OS before returning (query() reads the file back)."""
+        flushed to the OS before returning (query() reads the file back).
+        Shared by the legacy flush and the journal compaction sink, so both
+        modes pay the SAME ``audit.append`` fault site once per day-batch."""
         path = self.audit_dir / f"{day}.jsonl"
         fh = self._day_fh
         if fh is not None and not fh.closed and self._day_name == day:
@@ -287,8 +378,7 @@ class AuditTrail:
                 fh.close()
                 raise OSError("audit tail unrepaired; append deferred")
             self._day_fh, self._day_name = fh, day
-        write_with_faults("audit.append", fh.write,
-                          "".join(jsonl_dumps(rec) + "\n" for rec in records))
+        write_with_faults("audit.append", fh.write, text)
         fh.flush()
 
     def query(self, verdict: Optional[str] = None, agent_id: Optional[str] = None,
@@ -322,6 +412,13 @@ class AuditTrail:
                     pass
 
     def stats(self) -> dict:
-        return {"today": self.today_count, "buffered": len(self.buffer),
-                "spilled": self.spilled, "flushFailures": self.flush_failures,
-                "lastFlushError": self.last_flush_error}
+        buffered = len(self.buffer)
+        if self.journal is not None:
+            buffered += self.journal.pending_count(self.STREAM)
+        out = {"today": self.today_count, "buffered": buffered,
+               "spilled": self.spilled, "flushFailures": self.flush_failures,
+               "lastFlushError": self.last_flush_error}
+        if self.journal is not None:
+            out["journal"] = True
+            out["replayDeduped"] = self.replay_deduped
+        return out

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..storage.journal import get_journal, journal_settings
 from ..utils.stage_timer import StageTimer
 from .audit import AuditTrail
 from .cross_agent import CrossAgentManager
@@ -90,7 +91,17 @@ class GovernanceEngine:
         self.session_trust = SessionTrustManager(config.get("sessionTrust", {}),
                                                  self.trust_manager, clock=clock)
         self.cross_agent = CrossAgentManager(self.trust_manager, logger, clock=clock)
-        self.audit_trail = AuditTrail(config.get("audit", {}), workspace, logger, clock=clock)
+        # Shared per-workspace group-commit journal (ISSUE 7) for the audit
+        # trail. wall=False: the engine owns no timers and the audit trail
+        # drives compaction on its legacy flush thresholds, so chaos runs
+        # stay bit-reproducible (no background commit consuming fault steps).
+        js = journal_settings(config)
+        journal = (get_journal(workspace, js, clock=clock, wall=False,
+                               logger=logger)
+                   if js["enabled"] else None)
+        self.journal = journal
+        self.audit_trail = AuditTrail(config.get("audit", {}), workspace, logger,
+                                      clock=clock, journal=journal)
         self.stats = EngineStats()
         # Enforcement flags resolved once at load — config is immutable after
         # plugin registration, and the chained dict.gets sat on every call.
@@ -300,6 +311,8 @@ class GovernanceEngine:
             # Degradation must be *visible* (ISSUE 4): spilled/retained audit
             # records and flush failures ride every status read.
             "audit": self.audit_trail.stats(),
+            **({"journal": self.journal.stats()}
+               if self.journal is not None else {}),
         }
 
     def get_trust(self, agent_id: Optional[str] = None, session_key: Optional[str] = None):

@@ -59,6 +59,8 @@ MANIFEST = PluginManifest(
             "audit": enabled_section(
                 retentionDays={"type": "integer", "minimum": 0},
                 redactPatterns={"type": "array", "items": {"type": "string"}}),
+            "storage": {"type": "object", "properties": {
+                "journal": {"type": ["boolean", "object"]}}},
             "twoFa": enabled_section(),
             "validation": enabled_section(),
             "redaction": enabled_section(
@@ -92,6 +94,10 @@ DEFAULTS = {
     "trust": {"enabled": True},
     "sessionTrust": {"enabled": True},
     "audit": {"enabled": True, "retentionDays": 90, "redactPatterns": []},
+    # storage.journal (ISSUE 7): audit records ride the shared group-commit
+    # workspace journal (legacy flush cadence preserved); false restores the
+    # legacy buffer + day-file append path end-to-end.
+    "storage": {"journal": True},
     "twoFa": {"enabled": False},
     "validation": {"enabled": False, "facts": [], "factFiles": [],
                    "responseGate": {"enabled": False, "rules": []}},
@@ -133,6 +139,8 @@ class GovernancePlugin:
         self.logger = api.logger
         self.engine = GovernanceEngine(self.config, workspace, api.logger, clock=self.clock)
         self.engine.set_known_agents(extract_agent_ids(api.config))
+        if self.engine.journal is not None and hasattr(api, "register_journal"):
+            api.register_journal(f"journal:{workspace}", self.engine.journal)
 
         api.register_service(PluginService(
             id="governance-engine",

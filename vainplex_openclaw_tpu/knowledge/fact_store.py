@@ -76,12 +76,17 @@ class Fact:
 class FactStore:
     def __init__(self, workspace: str | Path, config: Optional[dict] = None,
                  logger=None, clock: Callable[[], float] = time.time,
-                 wall_timers: bool = True, timer: Optional[StageTimer] = None):
+                 wall_timers: bool = True, timer: Optional[StageTimer] = None,
+                 journal=None):
         self.config = {**DEFAULT_STORE_CONFIG, **(config or {})}
         self.logger = logger
         self.clock = clock
         self.timer = timer if timer is not None else StageTimer()
-        self.storage = AtomicStorage(Path(workspace) / "knowledge", wall=wall_timers)
+        # Shared workspace journal (ISSUE 7): the debounced facts.json save
+        # becomes a group-committed wal append; None keeps the legacy
+        # atomic-rename path (the storage.journal:false escape hatch).
+        self.storage = AtomicStorage(Path(workspace) / "knowledge", wall=wall_timers,
+                                     journal=journal, stream_prefix="knowledge")
         # Maintenance decay runs on a daemon thread while the gateway thread
         # ingests: iteration over self.facts and the index bookkeeping must
         # not interleave (RLock: add_fact's prune path re-enters).

@@ -14,6 +14,7 @@ from typing import Callable, Optional
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand, PluginService
+from ..storage.journal import get_journal, journal_settings
 from ..utils.stage_timer import StageTimer
 from .embeddings import create_embeddings
 from .entity_extractor import EntityExtractor
@@ -24,7 +25,9 @@ from .maintenance import Maintenance
 DEFAULTS = {
     "enabled": True,
     "workspace": None,
-    "storage": {"maxFacts": 2000, "writeDebounceMs": 2000},
+    # storage.journal (ISSUE 7): debounced facts.json saves ride the shared
+    # group-commit workspace journal; false restores the atomic-rename path.
+    "storage": {"maxFacts": 2000, "writeDebounceMs": 2000, "journal": True},
     "extraction": {"minImportance": 0.5, "mentionPredicate": "mentioned"},
     "llm": {"enabled": False, "batchSize": 3},
     "embeddings": {"backend": "local", "enabled": True,
@@ -43,7 +46,8 @@ MANIFEST = PluginManifest(
             "workspace": {"type": ["string", "null"]},
             "storage": {"type": "object", "properties": {
                 "maxFacts": {"type": "integer", "minimum": 1},
-                "writeDebounceMs": {"type": "integer", "minimum": 0}}},
+                "writeDebounceMs": {"type": "integer", "minimum": 0},
+                "journal": {"type": ["boolean", "object"]}}},
             "extraction": {"type": "object", "properties": {
                 "minImportance": {"type": "number", "minimum": 0, "maximum": 1},
                 "mentionPredicate": {"type": "string"}}},
@@ -95,10 +99,18 @@ class KnowledgeEnginePlugin:
         workspace = (self._workspace_override or self.config.get("workspace")
                      or api.config.get("workspace") or ".")
         self.extractor = EntityExtractor(api.logger, clock=self.clock)
+        # Shared per-workspace group-commit journal (ISSUE 7); falls back to
+        # the legacy debounced atomic write when disabled or unopenable.
+        js = journal_settings(self.config)
+        self.journal = (get_journal(workspace, js, clock=self.clock,
+                                    wall=self.wall_timers, logger=api.logger)
+                        if js["enabled"] else None)
+        if self.journal is not None and hasattr(api, "register_journal"):
+            api.register_journal(f"journal:{workspace}", self.journal)
         self.fact_store = FactStore(workspace, self.config.get("storage"),
                                     api.logger, clock=self.clock,
                                     wall_timers=self.wall_timers,
-                                    timer=self.timer)
+                                    timer=self.timer, journal=self.journal)
         kwargs = {"http_post": self.http_post} if self.http_post else {}
         self.embeddings = create_embeddings(self.config.get("embeddings"),
                                             api.logger, timer=self.timer,

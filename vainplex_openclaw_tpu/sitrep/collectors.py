@@ -7,7 +7,7 @@ collectors. Each runs through ``safe_collect`` so a broken collector
 degrades to an error entry, never a crashed sitrep.
 
 ISSUE 6 revives the deprecated reference plugin as the system's OWN
-observability plane with four ops built-ins:
+observability plane with ops built-ins (ISSUE 7 added ``journal``):
 
 - ``gateway`` — degraded plugins, tripped breakers, per-hook skip/error
   counters, admission-control shed counts (``Gateway.get_status``);
@@ -213,6 +213,52 @@ def collect_resilience(config: dict, ctx: dict) -> dict:
                         else f"{len(items)} surfaces clean")}
 
 
+def collect_journal(config: dict, ctx: dict) -> dict:
+    """Group-commit journal health (ISSUE 7): pending/uncompacted records,
+    commit group sizes, fsync + compaction counters, spill/replay/repair
+    visibility per registered journal. Warns on CURRENT backlog or any
+    counted loss/damage signal (spills, commit/compaction failures, replay
+    repairs) — a repaired torn tail must be seen, not silently absorbed."""
+    status_fn = ctx.get("gateway_status")
+    if status_fn is None:
+        return {"status": "skipped", "items": [], "summary": "no gateway wired"}
+    journals = (status_fn() or {}).get("journal") or {}
+    if not journals:
+        return {"status": "skipped", "items": [],
+                "summary": "no journals registered"}
+    items = []
+    worries = []
+    for name in sorted(journals):
+        s = journals[name]
+        replay = s.get("replay") or {}
+        items.append({"name": name, "fsync": s.get("fsync"),
+                      "pending": s.get("pendingRecords", 0),
+                      "uncompacted": s.get("uncompactedRecords", 0),
+                      "commits": s.get("commits", 0),
+                      "avgGroupSize": s.get("avgGroupSize", 0.0),
+                      "fsyncs": s.get("fsyncs", 0),
+                      "compactions": s.get("compactions", 0),
+                      "rotations": s.get("rotations", 0),
+                      "spilled": s.get("spilled", 0),
+                      "commitFailures": s.get("commitFailures", 0),
+                      "compactionFailures": s.get("compactionFailures", 0),
+                      "replay": replay,
+                      "walBytes": s.get("walBytes", 0),
+                      "lastError": s.get("lastError")})
+        for key in ("spilled", "commitFailures", "compactionFailures",
+                    "fsyncFailures"):
+            if s.get(key):
+                worries.append(f"{name}.{key}={s[key]}")
+        for key in ("torn_tails", "corrupt_lines", "read_errors"):
+            if replay.get(key):
+                worries.append(f"{name}.replay.{key}={replay[key]}")
+    total_pending = sum(i["pending"] + i["uncompacted"] for i in items)
+    return {"status": "warn" if worries else "ok", "items": items,
+            "summary": (", ".join(worries) if worries else
+                        f"{len(items)} journals clean, "
+                        f"{total_pending} records in flight")}
+
+
 def collect_slo(config: dict, ctx: dict) -> dict:
     """SLO-threshold rollup: p99 budgets (ms) from config against live
     stage quantiles. Keys: ``"edge:stage"`` beats ``"edge"`` beats
@@ -259,6 +305,7 @@ BUILTIN_COLLECTORS: dict[str, Callable] = {
     "gateway": collect_gateway,
     "stage_quantiles": collect_stage_quantiles,
     "resilience": collect_resilience,
+    "journal": collect_journal,
     "slo": collect_slo,
 }
 

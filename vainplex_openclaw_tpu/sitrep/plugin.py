@@ -27,15 +27,16 @@ DEFAULTS = {
         "gateway": {"enabled": True},
         "stage_quantiles": {"enabled": True},
         "resilience": {"enabled": True},
+        "journal": {"enabled": True},
         "slo": {"enabled": True},
     },
     "customCollectors": [],
 }
 
-# The four collectors /ops always renders, whatever the sitrep interval
+# The ops collectors /ops always renders, whatever the sitrep interval
 # config says — the live dashboard must not go dark because an operator
 # trimmed the periodic report.
-OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "slo")
+OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "journal", "slo")
 
 MANIFEST = PluginManifest(
     id="sitrep",
@@ -164,7 +165,7 @@ class SitrepPlugin:
     # ── /ops: the live dashboard (ISSUE 6) ───────────────────────────
 
     def ops_report(self) -> dict:
-        """Consolidated ops report: the four ops collectors forced on,
+        """Consolidated ops report: the ops collectors forced on,
         whatever the interval-sitrep config enables."""
         cfg = dict(self.config)
         collectors = dict(cfg.get("collectors", {}))

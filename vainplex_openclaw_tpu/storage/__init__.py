@@ -9,17 +9,31 @@ from .atomic import (
     read_jsonl,
     write_json_atomic,
 )
+from .journal import (
+    DEFAULT_JOURNAL_SETTINGS,
+    Journal,
+    dedup_against_tail,
+    get_journal,
+    journal_settings,
+    reset_journals,
+)
 from .workspace import is_file_older_than, is_writable, reboot_dir
 
 __all__ = [
     "AtomicStorage",
+    "DEFAULT_JOURNAL_SETTINGS",
     "Debouncer",
+    "Journal",
     "JsonlReadReport",
     "append_jsonl",
+    "dedup_against_tail",
+    "get_journal",
     "is_file_older_than",
     "is_writable",
+    "journal_settings",
     "read_json",
     "read_jsonl",
     "reboot_dir",
+    "reset_journals",
     "write_json_atomic",
 ]

@@ -284,20 +284,44 @@ class Debouncer:
 
 
 class AtomicStorage:
-    """Directory-rooted JSON store with per-key debounced persistence."""
+    """Directory-rooted JSON store with per-key debounced persistence.
 
-    def __init__(self, root: str | Path, wall: bool = True):
+    With a ``journal`` (ISSUE 7) every save becomes a group-committed wal
+    append instead of an atomic rename; ``flush_all``/``stop`` compact the
+    journaled state back to the JSON files, and ``load`` registers the
+    stream first so a crash-interrupted compaction completes before the
+    read. ``journal=None`` is the legacy path, byte-for-byte."""
+
+    def __init__(self, root: str | Path, wall: bool = True, journal=None,
+                 stream_prefix: Optional[str] = None):
         self.root = Path(root)
         self._wall = wall
         self._debouncers: dict[str, Debouncer] = {}
+        self._journal = journal
+        self._stream_prefix = stream_prefix or f"store:{self.root.name}"
+        self._streams: dict[str, str] = {}
 
     def path(self, name: str) -> Path:
         return self.root / name
 
+    def _stream(self, name: str) -> str:
+        stream = self._streams.get(name)
+        if stream is None:
+            stream = self._streams[name] = f"{self._stream_prefix}:{name}"
+            # indent=2: compaction must reproduce the exact bytes the legacy
+            # pretty-printed save wrote (the equivalence suites diff files).
+            self._journal.register_snapshot(stream, self.path(name), indent=2)
+        return stream
+
     def save(self, name: str, obj: Any) -> None:
+        if self._journal is not None:
+            if self._journal.append(self._stream(name), obj):
+                return
         write_json_atomic(self.path(name), obj)
 
     def load(self, name: str, default: Any = None) -> Any:
+        if self._journal is not None:
+            self._stream(name)  # registration completes pending compaction
         return read_json(self.path(name), default)
 
     def save_debounced(self, name: str, supplier: Callable[[], Any], delay_s: float = 15.0) -> None:
@@ -310,10 +334,16 @@ class AtomicStorage:
     def flush_all(self) -> None:
         for deb in self._debouncers.values():
             deb.flush()
+        if self._journal is not None:
+            for stream in self._streams.values():
+                self._journal.compact(stream)
 
     def stop(self) -> None:
         for deb in self._debouncers.values():
             deb.stop()
+        if self._journal is not None:
+            for stream in self._streams.values():
+                self._journal.compact(stream)
 
 
 def daily_jsonl_name(ts: Optional[float] = None) -> str:
