@@ -17,6 +17,7 @@ import pytest
 
 from fake_nats import FakeJetStreamState, install
 
+from vainplex_openclaw_tpu.analysis.witness import LockOrderWitness
 from vainplex_openclaw_tpu.core import Gateway
 from vainplex_openclaw_tpu.core.api import list_logger
 from vainplex_openclaw_tpu.events import EventStorePlugin, FileTransport, MemoryTransport
@@ -936,6 +937,16 @@ class TestEndToEndChaos:
         gw.load(ev, plugin_config={"enabled": True, "transport": "file",
                                    "fileRoot": str(root / "events")})
         gw.start()
+        # Runtime lock-order witness (ISSUE 8): wrap every lock the storm
+        # exercises — the engine's journal (when on), its StageTimer — so
+        # the chaos run also proves acquisition order stayed acyclic.
+        witness = LockOrderWitness()
+        if gov.engine.journal is not None:
+            witness.wrap_attr(gov.engine.journal, "_commit_lock",
+                              "Journal._commit_lock")
+            witness.wrap_attr(gov.engine.journal, "_buffer_lock",
+                              "Journal._buffer_lock")
+        witness.wrap_attr(gov.engine.timer, "_lock", "Engine.timer._lock")
         ctx = {"agent_id": "main", "session_key": "agent:main:s"}
 
         verdicts = []
@@ -975,6 +986,8 @@ class TestEndToEndChaos:
         assert status["stats"]["totalEvaluations"] == self.N_CALLS
 
         gw.stop()
+        # chaos runs also assert acyclic lock acquisition (ISSUE 8)
+        witness.assert_acyclic()
         return {
             "verdicts": verdicts,
             "fired": dict(plan.fired),

@@ -38,6 +38,7 @@ from vainplex_openclaw_tpu.storage.journal import (
     journal_settings,
     peek_journal,
 )
+from vainplex_openclaw_tpu.analysis.witness import LockOrderWitness
 from vainplex_openclaw_tpu.utils import ids
 
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
@@ -548,6 +549,15 @@ class TestJournalChaos:
         gw.load(ev, plugin_config={"enabled": True, "transport": "file",
                                    "fileRoot": str(root / "events")})
         gw.start()
+        # Runtime lock-order witness (ISSUE 8): the storm drives the shared
+        # journal from every edge — wrap its locks (and its StageTimer's)
+        # so the run also proves the acquisition order stayed acyclic, a
+        # schedule-independent property a lucky interleaving can't fake.
+        witness = LockOrderWitness()
+        shared = transport.journal
+        witness.wrap_attr(shared, "_commit_lock", "Journal._commit_lock")
+        witness.wrap_attr(shared, "_buffer_lock", "Journal._buffer_lock")
+        witness.wrap_attr(shared.timer, "_lock", "Journal.timer._lock")
         ctx = {"agent_id": "main", "session_key": "agent:main:s"}
         verdicts = []
         with installed(plan):
@@ -578,6 +588,8 @@ class TestJournalChaos:
         jstats = {name: s for name, s in status["journal"].items()}
         assert jstats, "journal stats missing from gateway status"
         gw.stop()
+        # chaos runs also assert acyclic lock acquisition (ISSUE 8)
+        witness.assert_acyclic()
 
         # crash-recover the cortex journal: fresh instances, same workspace
         j2 = Journal(root / "journal", {}, wall=False)

@@ -1,0 +1,127 @@
+"""graftlint: repo-wide concurrency + pattern-safety static analysis (ISSUE 8).
+
+Four passes, one gate:
+
+- :mod:`.locks` — lock-discipline checker over the declarative guarded-
+  state table (GL-LOCK-GUARD, GL-LOCK-BLOCKING);
+- :mod:`.lock_order` — static lock-acquisition graph + cycle detection
+  (GL-LOCK-ORDER), paired with the runtime :mod:`.witness` the chaos
+  suites arm;
+- :mod:`.redos` — catastrophic-backtracking screening (GL-REDOS), wired
+  into the governance policy planner and cortex pattern banks at compile
+  time and run here over the shipped default packs;
+- :mod:`.drift` — cross-file contract lints (GL-DRIFT-*).
+
+Run as ``python -m vainplex_openclaw_tpu.analysis`` (exit 1 on any
+non-baselined finding, 2 on crash) or import :func:`run_analysis` from
+tests. Suppressions live in ``analysis/baseline.json`` — one entry per
+finding key, each with a rationale (see docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from . import drift, lock_order, locks, redos
+from .findings import Finding, LintReport, apply_baseline, load_baseline
+from .witness import LockOrderWitness
+
+__all__ = [
+    "Finding", "LintReport", "LockOrderWitness", "run_analysis",
+    "collect_findings", "default_pack_findings", "load_baseline",
+]
+
+
+def default_pack_findings() -> list:
+    """GL-REDOS findings over the patterns the repo SHIPS: every cortex
+    language pack + base moods, and every regex the builtin governance
+    policies carry. This is the CI gate that keeps the default packs clean
+    — operator/user patterns are screened at their own compile time by the
+    planner/bank wiring instead."""
+    findings: list = []
+    from ..cortex.patterns import BASE_MOODS, PACKS
+    for pack in PACKS.values():
+        for attr in ("decision", "close", "wait", "topic"):
+            for pattern in getattr(pack, attr):
+                issue = redos.unsafe_report(pattern, pack.flags)
+                if issue:
+                    findings.append(Finding(
+                        "GL-REDOS", "vainplex_openclaw_tpu/cortex/patterns.py",
+                        1, f"builtin {pack.code}.{attr} pattern {pattern!r}: "
+                           f"{issue}",
+                        detail=f"pack:{pack.code}:{attr}:{pattern}"))
+        for mood, pattern in pack.moods.items():
+            issue = redos.unsafe_report(pattern, pack.flags)
+            if issue:
+                findings.append(Finding(
+                    "GL-REDOS", "vainplex_openclaw_tpu/cortex/patterns.py", 1,
+                    f"builtin {pack.code} mood {mood!r} pattern {pattern!r}: "
+                    f"{issue}",
+                    detail=f"pack:{pack.code}:mood:{mood}:{pattern}"))
+    for mood, pattern in BASE_MOODS.items():
+        issue = redos.unsafe_report(pattern)
+        if issue:
+            findings.append(Finding(
+                "GL-REDOS", "vainplex_openclaw_tpu/cortex/patterns.py", 1,
+                f"base mood {mood!r} pattern {pattern!r}: {issue}",
+                detail=f"base-mood:{mood}:{pattern}"))
+
+    from ..governance.policy_plan import iter_policy_patterns
+    for policy in _builtin_policies():
+        for pattern in iter_policy_patterns(policy):
+            issue = redos.unsafe_report(pattern)
+            if issue:
+                findings.append(Finding(
+                    "GL-REDOS",
+                    "vainplex_openclaw_tpu/governance/builtin_policies.py", 1,
+                    f"builtin policy {policy.get('id')} pattern {pattern!r}: "
+                    f"{issue}",
+                    detail=f"policy:{policy.get('id')}:{pattern}"))
+    return findings
+
+
+def _builtin_policies() -> list:
+    """EVERY builtin policy, through the canonical enumeration
+    (``get_builtin_policies``) with all features enabled. The enable-all
+    config is built by introspecting the enumerator's own
+    ``config.get("…")`` reads (every builder accepts a truthy non-dict and
+    falls back to its defaults), so a newly added builtin is screened the
+    day it lands — a hand-rolled key list here would let its regexes ship
+    unscreened while the CI 'packs clean' assertion kept passing."""
+    import inspect
+    import re as _re
+    from ..governance.builtin_policies import get_builtin_policies
+    keys = set(_re.findall(r'config\.get\("(\w+)"\)',
+                           inspect.getsource(get_builtin_policies)))
+    # Known builders, kept as a floor in case the enumerator's config
+    # plumbing is ever refactored away from config.get literals.
+    keys |= {"nightMode", "credentialGuard", "productionSafeguard",
+             "rateLimiter"}
+    return get_builtin_policies({k: True for k in sorted(keys)})
+
+
+def collect_findings(root: str | Path) -> tuple[list, int]:
+    """All four passes over ``root``; → (findings, files_scanned).
+    ``files_scanned`` is the lock-order pass's full-package file count —
+    the only pass that traverses the whole tree (the discipline pass
+    re-reads a subset of those files and drift checks contracts, not
+    files), so the CI-greppable ``files=`` number tracks real traversal
+    and catches a scan that stopped walking."""
+    findings: list = []
+    lock_f, _ = locks.run(root)
+    order_f, scanned = lock_order.run(root)
+    drift_f, _ = drift.run(root)
+    findings.extend(lock_f)
+    findings.extend(order_f)
+    findings.extend(drift_f)
+    findings.extend(default_pack_findings())
+    return findings, scanned
+
+
+def run_analysis(root: str | Path,
+                 baseline_path: Optional[str | Path] = None) -> LintReport:
+    findings, scanned = collect_findings(root)
+    report = LintReport(files_scanned=scanned)
+    apply_baseline(findings, load_baseline(baseline_path), report)
+    return report

@@ -1,0 +1,55 @@
+"""CLI: ``python -m vainplex_openclaw_tpu.analysis [--root R] [--json]``.
+
+Exit codes: 0 clean (baselined findings allowed), 1 active findings,
+2 analyzer crash — the CI job treats anything but 0 as a failure and the
+parse smoke additionally greps the summary line, so a crashing analyzer
+can never read as a passing gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="graftlint")
+    parser.add_argument("--root", default=".",
+                        help="repo root (default: cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: the checked-in one)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    args = parser.parse_args(argv)
+
+    root = Path(args.root)
+    if not (root / "vainplex_openclaw_tpu").is_dir():
+        print(f"graftlint: no package under {root}", file=sys.stderr)
+        return 2
+
+    from . import run_analysis
+    report = run_analysis(root, args.baseline)
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        for finding in report.active:
+            print(finding.render())
+        for finding, rationale in report.suppressed:
+            print(f"{finding.render()}  [baselined: {rationale}]",
+                  file=sys.stderr)
+        for key in report.stale_keys:
+            print(f"stale baseline entry (fixed? delete it): {key}",
+                  file=sys.stderr)
+        print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as exc:  # noqa: BLE001 — crash must exit 2, visibly
+        print(f"graftlint: analyzer crashed: {exc!r}", file=sys.stderr)
+        raise SystemExit(2)

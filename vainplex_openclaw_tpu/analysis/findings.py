@@ -1,0 +1,114 @@
+"""Finding model + suppression baseline for graftlint (ISSUE 8).
+
+Every pass reports :class:`Finding`s carrying a rule id, a repo-relative
+path, a line, and a STABLE key. Keys deliberately exclude line numbers —
+``rule::path::detail`` where ``detail`` names the symbol (``Journal.stats:
+_marks``, a lock-cycle signature, a pattern hash) — so a baseline entry
+survives unrelated edits to the file instead of rotting every PR.
+
+Suppressions live in ONE checked-in file (``analysis/baseline.json``): a
+list of ``{"key": ..., "rationale": ...}`` objects. A finding whose key is
+baselined is reported as suppressed, not active; an entry with an empty
+rationale is itself a finding (the baseline must explain every exception,
+or it degenerates into a mute button); an entry matching nothing is stale
+and reported as a warning so the baseline shrinks as code is fixed.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+BASELINE_NAME = "baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "GL-LOCK-GUARD"
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    detail: str = ""   # stable symbol-ish discriminator for the key
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.detail or self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class LintReport:
+    """The outcome of one graftlint run over a tree."""
+
+    files_scanned: int = 0
+    active: list = field(default_factory=list)       # findings not baselined
+    suppressed: list = field(default_factory=list)   # (finding, rationale)
+    stale_keys: list = field(default_factory=list)   # baseline entries unmatched
+
+    @property
+    def ok(self) -> bool:
+        return not self.active
+
+    def summary(self) -> str:
+        # The CI parse smoke greps this exact shape: a crashing analyzer
+        # prints no summary line and fails loud instead of passing silent.
+        return (f"graftlint: files={self.files_scanned} "
+                f"active={len(self.active)} "
+                f"suppressed={len(self.suppressed)} "
+                f"stale={len(self.stale_keys)}")
+
+    def to_dict(self) -> dict:
+        return {
+            "filesScanned": self.files_scanned,
+            "active": [vars(f) | {"key": f.key} for f in self.active],
+            "suppressed": [vars(f) | {"key": f.key, "rationale": r}
+                           for f, r in self.suppressed],
+            "staleKeys": list(self.stale_keys),
+            "ok": self.ok,
+        }
+
+
+def load_baseline(path: Optional[str | Path] = None) -> dict[str, str]:
+    """{key: rationale} from the checked-in baseline file. A malformed
+    baseline raises — a lint gate whose suppression file silently reads as
+    empty would fail the build on every baselined finding (loud, but
+    misleading); one that silently reads as 'everything suppressed' would
+    pass violations. Neither is acceptable."""
+    if path is None:
+        path = Path(__file__).parent / BASELINE_NAME
+    path = Path(path)
+    if not path.exists():
+        return {}
+    entries = json.loads(path.read_text(encoding="utf-8"))
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path} must be a JSON list")
+    out: dict[str, str] = {}
+    for e in entries:
+        if not isinstance(e, dict) or "key" not in e:
+            raise ValueError(f"baseline entry must carry a key: {e!r}")
+        out[str(e["key"])] = str(e.get("rationale", ""))
+    return out
+
+
+def apply_baseline(findings: list, baseline: dict[str, str],
+                   report: LintReport) -> None:
+    """Split findings into active/suppressed on ``report``; empty-rationale
+    suppressions surface as GL-BASELINE findings; unmatched keys as stale."""
+    seen: set[str] = set()
+    for f in findings:
+        rationale = baseline.get(f.key)
+        if rationale is None:
+            report.active.append(f)
+            continue
+        seen.add(f.key)
+        if not rationale.strip():
+            report.active.append(Finding(
+                "GL-BASELINE", f.path, f.line,
+                f"suppression for {f.key} has no rationale",
+                detail=f"no-rationale:{f.key}"))
+        report.suppressed.append((f, rationale))
+    report.stale_keys.extend(k for k in baseline if k not in seen)

@@ -1,0 +1,141 @@
+"""Runtime lock-order witness: the dynamic half of the lock-order pass.
+
+The static graph (:mod:`.lock_order`) sees lexical ``with`` nesting inside
+one class; it cannot see a StageTimer lock taken inside a FactStore
+critical section, or any order that only materializes through callbacks.
+The witness closes that gap at test time: wrap the locks of interest, run
+the real workload (the chaos suites already drive every serving edge
+concurrently), and every *acquisition while holding another wrapped lock*
+records a directed edge with the first observing thread and stack-free
+site info. ``cycles()`` then answers whether any two threads could have
+deadlocked on an inverted order — even if the storm happened to schedule
+around it this run. That is the point: a chaos run that never deadlocks
+proves little (deadlocks need unlucky timing); an acyclic witnessed order
+proves the *schedule-independent* property.
+
+Wrapped locks proxy ``acquire``/``release``/context-manager use, including
+the non-blocking probe form (``acquire(blocking=False)``) the journal's
+group-wait uses; re-entrant acquisition of the same wrapped lock (RLock)
+records no self-edge. Overhead is one thread-local list op per
+acquire/release plus a dict insert on first-seen edges — test-rig freight,
+not production freight; nothing in the package imports this module at
+serving time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class _WitnessedLock:
+    """Proxy recording acquisition order into its witness."""
+
+    __slots__ = ("_name", "_lock", "_witness")
+
+    def __init__(self, name: str, lock, witness: "LockOrderWitness"):
+        self._name = name
+        self._lock = lock
+        self._witness = witness
+
+    def acquire(self, *args, **kwargs):
+        got = self._lock.acquire(*args, **kwargs)
+        if got:
+            self._witness._note_acquire(self._name)
+        return got
+
+    def release(self):
+        self._witness._note_release(self._name)
+        return self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+
+class LockOrderWitness:
+    """Records per-thread acquisition stacks and the edge set they imply."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._edges: dict = {}   # (outer, inner) -> (thread_name, seq)
+        self._seq = 0
+        self._mutex = threading.Lock()
+
+    def wrap(self, name: str, lock) -> _WitnessedLock:
+        return _WitnessedLock(name, lock, self)
+
+    def wrap_attr(self, obj, attr: str, name: Optional[str] = None):
+        """Replace ``obj.attr`` with a witnessed proxy in place:
+        ``witness.wrap_attr(journal, "_commit_lock", "Journal._commit_lock")``."""
+        label = name or f"{type(obj).__name__}.{attr}"
+        wrapped = self.wrap(label, getattr(obj, attr))
+        setattr(obj, attr, wrapped)
+        return wrapped
+
+    # ── recording ────────────────────────────────────────────────────
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, name: str) -> None:
+        stack = self._stack()
+        if name in stack:
+            # Re-entrant acquire (RLock): the thread already OWNS this lock,
+            # so this acquire can never block — recording edges from the
+            # locks taken in between (A → B → A again) would manufacture a
+            # cycle out of a schedule that cannot deadlock.
+            stack.append(name)
+            return
+        if stack:
+            with self._mutex:
+                for h in stack:
+                    if (h, name) not in self._edges:
+                        self._seq += 1
+                        self._edges[(h, name)] = (
+                            threading.current_thread().name, self._seq)
+        stack.append(name)
+
+    def _note_release(self, name: str) -> None:
+        stack = self._stack()
+        # release() order is the caller's business; drop the NEWEST hold of
+        # this name (matching RLock semantics).
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # ── reporting ────────────────────────────────────────────────────
+
+    def edges(self) -> dict:
+        with self._mutex:
+            return dict(self._edges)
+
+    def cycles(self) -> list:
+        """Elementary cycles in the witnessed order graph (each as a node
+        list ``[a, b, …, a]``); empty list = acquisition order is a DAG.
+        Shares the DFS with the static pass (lock_order.elementary_cycles)
+        so the two halves can never drift on what counts as a cycle."""
+        from .lock_order import elementary_cycles
+        graph: dict = {}
+        for a, b in self.edges():
+            graph.setdefault(a, set()).add(b)
+        return elementary_cycles(graph)
+
+    def assert_acyclic(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            pretty = "; ".join(" -> ".join(c) for c in cycles)
+            raise AssertionError(
+                f"lock acquisition order has cycles: {pretty} "
+                f"(edges: {sorted(self.edges())})")

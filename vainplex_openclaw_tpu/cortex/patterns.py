@@ -15,6 +15,7 @@ import re
 from dataclasses import dataclass, field
 from typing import NamedTuple, Optional
 
+from ..analysis.redos import pattern_safe, unsafe_report
 from ..governance.util import ALTERNATION_UNSAFE
 
 MOODS = ("frustrated", "excited", "tense", "productive", "exploratory")
@@ -444,7 +445,13 @@ def _build_bank(members: list[re.Pattern]) -> PrefilterBank:
     unscreened = []
     for rx in members:
         lits = None
-        if not ALTERNATION_UNSAFE.search(rx.pattern):
+        # ReDoS-catastrophic members (ISSUE 8) are demoted to the
+        # interpreter path: never screened, always walked member-by-member
+        # exactly as extract_signals_interp would — identical matches, and
+        # the pattern stays out of the compiled dispatch (reported via
+        # MergedPatterns.unsafe / cortexstatus / sitrep).
+        if (not ALTERNATION_UNSAFE.search(rx.pattern)
+                and pattern_safe(rx.pattern, rx.flags)):
             try:
                 lits = _required_literals(_sre_parse.parse(rx.pattern, rx.flags))
             except Exception:  # noqa: BLE001 — a screen is an optimization only
@@ -521,6 +528,33 @@ class MergedPatterns:
         for pack in packs:
             for mood, pattern in pack.moods.items():
                 self.moods[mood].append(re.compile(pattern, pack.flags))
+
+        # ReDoS screen (ISSUE 8) over every member that will run per
+        # message, builtin or custom: unsafe entries are kept (dropping a
+        # pattern would change match results — the user's regex still fires
+        # on the inputs it was written for) but demoted out of the compiled
+        # banks by _build_bank and REPORTED — here, in cortexstatus, and on
+        # the sitrep ops pane — so a pathological custom pattern is a
+        # visible operational fact, not a latent stall.
+        self.unsafe: list[dict] = []
+        for cat in ("decision", "close", "wait", "topic"):
+            for rx in getattr(self, cat):
+                issue = unsafe_report(rx.pattern, rx.flags)
+                if issue:
+                    self.unsafe.append({"category": cat,
+                                        "pattern": rx.pattern, "issue": issue})
+        for mood, rxs in self.moods.items():
+            for rx in rxs:
+                issue = unsafe_report(rx.pattern, rx.flags)
+                if issue:
+                    self.unsafe.append({"category": f"mood:{mood}",
+                                        "pattern": rx.pattern, "issue": issue})
+        if self.unsafe and logger is not None:
+            for entry in self.unsafe:
+                logger.warn(
+                    f"pattern {entry['pattern']!r} ({entry['category']}) "
+                    f"screens ReDoS-unsafe ({entry['issue']}); demoted to "
+                    f"the interpreter path")
 
         self.compiled = bool(compiled)
         # Banks are built even when compiled=False (load-time cost only);

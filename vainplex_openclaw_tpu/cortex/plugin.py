@@ -102,6 +102,7 @@ MANIFEST = PluginManifest(
         },
     },
     commands=("cortexstatus", "trace-analyze"),
+    gateway_methods=("cortex.patternSafety",),
     hooks=("message_received", "message_sent", "agent_end", "session_start",
            "before_compaction", "gateway_stop"),
 )
@@ -190,6 +191,13 @@ class CortexPlugin:
         api.register_command(PluginCommand(
             name="cortexstatus", description="Cortex tracker status",
             handler=lambda ctx: {"text": self.status_text()}))
+        # ReDoS screening surface (ISSUE 8): the sitrep pattern_safety
+        # collector merges these with governance's planner reports so a
+        # demoted cortex custom pattern is visible on /ops, not only in
+        # cortexstatus.
+        api.register_gateway_method(
+            "cortex.patternSafety",
+            lambda: list(self.patterns.unsafe) if self.patterns else [])
 
         if self.config.get("registerTools", True) and hasattr(api, "register_tool"):
             register_cortex_tools(api, self._workspace_for)
@@ -330,6 +338,12 @@ class CortexPlugin:
 
     def status_text(self) -> str:
         lines = ["🧠 cortex:"]
+        if self.patterns is not None and self.patterns.unsafe:
+            lines.append(
+                f"  ⚠ {len(self.patterns.unsafe)} ReDoS-unsafe pattern(s) "
+                f"demoted to interpreter path: "
+                + ", ".join(f"{e['category']}:{e['pattern']!r}"
+                            for e in self.patterns.unsafe[:3]))
         if not self._trackers:
             lines.append("  (no workspaces active yet)")
         for ws, trackers in self._trackers.items():

@@ -298,6 +298,8 @@ class GovernanceEngine:
         # from the same lock round-trip, so ms and counts attribute the
         # same traffic even while verdicts land concurrently (ISSUE 6).
         snap = self.timer.snapshot()
+        pattern_reports = (self.planner.pattern_reports()
+                           if self.planner is not None else [])
         return {
             "enabled": self.config.get("enabled", True),
             "policyCount": self.policy_count(),
@@ -311,6 +313,12 @@ class GovernanceEngine:
             # Degradation must be *visible* (ISSUE 4): spilled/retained audit
             # records and flush failures ride every status read.
             "audit": self.audit_trail.stats(),
+            # ReDoS screening (ISSUE 8): patterns the planner demoted to the
+            # interpreter oracle. ``checked`` False = interpreter mode, no
+            # planner compiled anything, so there was nothing to screen.
+            "patternSafety": {"checked": self.planner is not None,
+                              "unsafePatterns": pattern_reports,
+                              "demoted": len(pattern_reports)},
             **({"journal": self.journal.stats()}
                if self.journal is not None else {}),
         }

@@ -28,8 +28,9 @@ tests).
 from __future__ import annotations
 
 import re
-from typing import Callable, Optional
+from typing import Callable, Iterator, Optional
 
+from ..analysis.redos import pattern_safe, unsafe_report
 from .conditions import create_condition_evaluators
 from .policy_evaluator import aggregate_matches, policy_specificity
 from .types import (
@@ -416,10 +417,53 @@ def _interp_fallback(c: Condition, time_windows: dict) -> ConditionFn:
     return fallback
 
 
+def iter_condition_patterns(c: Condition) -> Iterator[str]:
+    """Every regex-like string a condition can hand to ``re`` at eval time:
+    tool-param ``matches`` values and context ``messageContains``/
+    ``conversationContains`` items (regex-or-substring semantics — invalid
+    regexes degrade to substring probes and are harmless). ``sessionKey``
+    and name globs compile through ``glob_to_regex`` (escaped, bounded) and
+    are safe by construction."""
+    if not isinstance(c, dict):
+        return
+    params = c.get("params")
+    if isinstance(params, dict):
+        for matcher in params.values():
+            if isinstance(matcher, dict) and isinstance(matcher.get("matches"), str):
+                yield matcher["matches"]
+    for key in ("messageContains", "conversationContains"):
+        raw = c.get(key)
+        for pattern in (raw if isinstance(raw, list) else [raw] if raw else []):
+            if isinstance(pattern, str):
+                yield pattern
+    for sub in c.get("conditions") or ():
+        yield from iter_condition_patterns(sub)
+    inner = c.get("condition")
+    if inner:
+        yield from iter_condition_patterns(inner)
+
+
+def iter_policy_patterns(policy: Policy) -> Iterator[str]:
+    for rule in policy.get("rules") or ():
+        for c in rule.get("conditions") or ():
+            yield from iter_condition_patterns(c)
+
+
+def condition_unsafe(c: Condition) -> bool:
+    """True when any regex in the condition screens as ReDoS-catastrophic
+    (analysis.redos). Such conditions are DEMOTED: evaluated by the
+    interpreter oracle instead of compiled into closures or prefilter
+    banks, so the verdict is unchanged while the pattern stays out of the
+    per-request compiled path and visible in ``pattern_reports()``."""
+    return any(not pattern_safe(p) for p in iter_condition_patterns(c))
+
+
 def compile_condition(c: Condition, time_windows: dict) -> ConditionFn:
     compiler = _COMPILERS.get(c.get("type"))
     if compiler is None:
         return _never  # unknown type fails the rule (deny-safe), as interp
+    if condition_unsafe(c):
+        return _interp_fallback(c, time_windows)
     try:
         return compiler(c, time_windows)
     except Exception:  # noqa: BLE001 — malformed condition: let the oracle decide
@@ -482,7 +526,11 @@ def _rule_regex_requirements(rule: dict) -> dict[str, str]:
                     and "equals" not in matcher and "contains" not in matcher
                     and key not in out
                     and _compile_regex(matcher["matches"]) is not None
-                    and not ALTERNATION_UNSAFE.search(matcher["matches"])):
+                    and not ALTERNATION_UNSAFE.search(matcher["matches"])
+                    # A ReDoS-catastrophic member must never ride a combined
+                    # bank: the bank runs on EVERY evaluation, which is
+                    # exactly the amplification an attacker wants (ISSUE 8).
+                    and pattern_safe(matcher["matches"])):
                 out[key] = matcher["matches"]
     return out
 
@@ -609,13 +657,28 @@ class PolicyPlanner:
         self.time_windows = time_windows or {}
         self._compiled: dict[int, CompiledPolicy] = {}
         self._plans: dict[tuple, tuple] = {}
+        # ReDoS screening reports, filled as policies compile: each entry is
+        # {"policyId", "pattern", "issue"} — surfaced via
+        # engine.get_status()["patternSafety"] and the sitrep collector.
+        self._unsafe: list[dict] = []
 
     def _compile(self, policy: Policy) -> CompiledPolicy:
         cp = self._compiled.get(id(policy))
         if cp is None:
             cp = CompiledPolicy(policy, self.time_windows)
             self._compiled[id(policy)] = cp
+            for pattern in dict.fromkeys(iter_policy_patterns(policy)):
+                issue = unsafe_report(pattern)
+                if issue:
+                    self._unsafe.append({"policyId": policy.get("id", "?"),
+                                         "pattern": pattern, "issue": issue})
         return cp
+
+    def pattern_reports(self) -> list[dict]:
+        """Unsafe patterns found while compiling (conditions carrying them
+        run on the interpreter oracle — same verdicts, no compiled-path
+        amplification)."""
+        return list(self._unsafe)
 
     def _candidates(self, agent_id: str, hook: str) -> list[Policy]:
         # policy_loader.policies_for, inlined (agent-scoped ∪ unscoped,

@@ -125,11 +125,21 @@ class FactStore:
                     self.logger.info(f"Loaded {len(self.facts)} facts from storage")
             self.loaded = True
 
+    def _snapshot_payload(self) -> dict:
+        # The debounced supplier runs on the Debouncer's TIMER thread (or
+        # the atexit flush), not on the thread that called _commit — an
+        # unlocked iteration here races add/decay/prune mutating the dict
+        # mid-serialize ("dict changed size during iteration", or a
+        # torn fact list). Found by graftlint's deferred-closure rule
+        # (GL-LOCK-GUARD, ISSUE 8); the RLock makes the synchronous
+        # flush-under-lock path re-entrant and safe.
+        with self._facts_lock:
+            return {"version": 1, "updated": self._iso(),
+                    "facts": [f.to_dict() for f in self.facts.values()]}
+
     def _commit(self) -> None:
         self.storage.save_debounced(
-            "facts.json",
-            lambda: {"version": 1, "updated": self._iso(),
-                     "facts": [f.to_dict() for f in self.facts.values()]},
+            "facts.json", self._snapshot_payload,
             delay_s=self.config["writeDebounceMs"] / 1000.0)
 
     def flush(self) -> None:

@@ -295,6 +295,39 @@ def collect_slo(config: dict, ctx: dict) -> dict:
             "summary": f"{checked} SLOs checked, {len(breaches)} breached"}
 
 
+def collect_pattern_safety(config: dict, ctx: dict) -> dict:
+    """ReDoS screening rollup (ISSUE 8): patterns demoted to their
+    interpreter paths by EITHER screened surface — the governance planner
+    (policy regexes) or cortex MergedPatterns (builtin/custom message
+    patterns). Demotion preserves verdicts/matches, but a demoted pattern
+    is a loaded pathological regex an operator should replace — it warns
+    for as long as it is loaded (unlike lifetime counters, this IS a
+    current condition)."""
+    gov_fn = ctx.get("governance_status")
+    cortex_fn = ctx.get("cortex_pattern_safety")
+    if gov_fn is None and cortex_fn is None:
+        return {"status": "skipped", "items": [],
+                "summary": "no screened surface wired"}
+    items = []
+    checked = False
+    if gov_fn is not None:
+        ps = (gov_fn() or {}).get("patternSafety") or {}
+        checked = checked or bool(ps.get("checked"))
+        items += [{**e, "source": "governance"}
+                  for e in ps.get("unsafePatterns") or []]
+    if cortex_fn is not None:
+        checked = True
+        items += [{**e, "source": "cortex"} for e in cortex_fn() or []]
+    if not checked:
+        return {"status": "skipped", "items": [],
+                "summary": "interpreter mode: nothing compiled to screen"}
+    return {"status": "warn" if items else "ok",
+            "items": items,
+            "summary": (f"{len(items)} unsafe pattern(s) demoted to "
+                        f"interpreter path" if items
+                        else "all compiled patterns screened clean")}
+
+
 BUILTIN_COLLECTORS: dict[str, Callable] = {
     "systemd_timers": collect_systemd_timers,
     "nats": collect_nats,
@@ -307,6 +340,7 @@ BUILTIN_COLLECTORS: dict[str, Callable] = {
     "resilience": collect_resilience,
     "journal": collect_journal,
     "slo": collect_slo,
+    "pattern_safety": collect_pattern_safety,
 }
 
 

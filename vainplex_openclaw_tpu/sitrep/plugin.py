@@ -29,6 +29,8 @@ DEFAULTS = {
         "resilience": {"enabled": True},
         "journal": {"enabled": True},
         "slo": {"enabled": True},
+        # ReDoS screening rollup (ISSUE 8): reads governance status only.
+        "pattern_safety": {"enabled": True},
     },
     "customCollectors": [],
 }
@@ -36,7 +38,8 @@ DEFAULTS = {
 # The ops collectors /ops always renders, whatever the sitrep interval
 # config says — the live dashboard must not go dark because an operator
 # trimmed the periodic report.
-OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "journal", "slo")
+OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "journal",
+                  "slo", "pattern_safety")
 
 MANIFEST = PluginManifest(
     id="sitrep",
@@ -114,6 +117,9 @@ class SitrepPlugin:
                 return gov_memo[0]
 
             ctx["governance_status"] = governance_status
+        if "cortex.patternSafety" in gw.methods:
+            ctx["cortex_pattern_safety"] = (
+                lambda: gw.call_method("cortex.patternSafety"))
         # Ops plane (ISSUE 6): gateway degradation surface (through the
         # public PluginApi view) + every registered StageTimer,
         # snapshotted once per report generation — the stage_quantiles
@@ -210,6 +216,13 @@ class SitrepPlugin:
         for b in slo.get("items", [])[:10]:
             lines.append(f"    BREACH {b['edge']}/{b['stage']}: "
                          f"p99 {b['p99Ms']}ms > budget {b['budgetMs']}ms")
+        ps = results.get("pattern_safety", {})
+        lines.append(f"  {icon.get(ps.get('status'), '•')} pattern_safety: "
+                     f"{ps.get('summary', 'n/a')}")
+        for item in ps.get("items", [])[:5]:
+            where = item.get("policyId") or item.get("category") or "?"
+            lines.append(f"    DEMOTED {item.get('source', '?')}:{where}: "
+                         f"{item.get('pattern')!r} — {item.get('issue')}")
         sq = results.get("stage_quantiles", {})
         if sq.get("status") == "ok":
             lines.append(f"  📈 stages ({sq['summary']}):")

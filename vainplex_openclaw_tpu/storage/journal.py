@@ -314,14 +314,25 @@ class Journal:
         encoded with ``indent`` — ``None`` = the compact C-encoder bytes the
         legacy cortex persisters write). Completes any crash-interrupted
         compaction from recovered records before returning, so the caller's
-        subsequent file load sees the journaled state."""
-        st = self._streams.get(name)
-        if st is None:
-            st = self._streams[name] = _Stream(name, "snapshot")
-        st.path = Path(path)
-        st.indent = indent
-        self._adopt_recovered(st)
-        return st
+        subsequent file load sees the journaled state.
+
+        Registration holds the commit lock end to end and inserts into
+        ``_streams`` under the buffer lock (commit-before-buffer, the
+        package order): lazy registration happens on first save, which a
+        debounce timer thread can drive while another owner's commit is
+        draining ``_streams.values()`` — an unguarded dict insert there is
+        a "dict changed size during iteration" crash in the group-commit
+        path (found by graftlint GL-LOCK-GUARD, ISSUE 8)."""
+        with self._commit_lock:
+            st = self._streams.get(name)
+            if st is None:
+                st = _Stream(name, "snapshot")
+                with self._buffer_lock:
+                    self._streams[name] = st
+            st.path = Path(path)
+            st.indent = indent
+            self._adopt_recovered(st)
+            return st
 
     def register_append(self, name: str, sink: Callable,
                         auto_compact: Optional[int] = None) -> _Stream:
@@ -332,14 +343,18 @@ class Journal:
         the target's tail (``dedup_against_tail``). ``auto_compact`` (record
         count) lets the journal compact the stream inline once enough
         committed records accumulate; ``None`` leaves cadence entirely to
-        the owner (the audit trail mirrors its legacy flush thresholds)."""
-        st = self._streams.get(name)
-        if st is None:
-            st = self._streams[name] = _Stream(name, "append")
-        st.sink = sink
-        st.auto_compact = auto_compact
-        self._adopt_recovered(st)
-        return st
+        the owner (the audit trail mirrors its legacy flush thresholds).
+        Locking: same discipline as ``register_snapshot``."""
+        with self._commit_lock:
+            st = self._streams.get(name)
+            if st is None:
+                st = _Stream(name, "append")
+                with self._buffer_lock:
+                    self._streams[name] = st
+            st.sink = sink
+            st.auto_compact = auto_compact
+            self._adopt_recovered(st)
+            return st
 
     def _adopt_recovered(self, st: _Stream) -> None:
         recs = self._recovered.pop(st.name, None)
@@ -475,6 +490,15 @@ class Journal:
             self._commit_lock.acquire()
             self.timer.add("group_wait", (pc() - t0) * 1000.0)
         try:
+            # Re-check under the lock: a timer-fired commit can pass the
+            # entry check, then lose the commit lock to close(), which
+            # closes _fh before we run — writing would raise ValueError
+            # (not OSError) past the restore handler and drop the batch.
+            # close() sets _closed before it takes the lock to close _fh,
+            # so this check under the same lock is race-free; the pending
+            # records stay buffered for callers' legacy fallbacks.
+            if self._closed:
+                return False
             drained = self._drain_pending()
             if not drained:
                 return True
@@ -506,7 +530,10 @@ class Journal:
                     self._wal_tail_dirty = False
                 write_with_faults("journal.append", self._fh.write, data)
                 self._fh.flush()
-            except OSError as exc:
+            except (OSError, ValueError) as exc:
+                # ValueError = write on a closed handle (belt-and-braces:
+                # the _closed re-check above makes it unreachable, but a
+                # dropped-batch bug must not ride on that proof).
                 self.commit_failures += 1
                 self.last_error = str(exc)
                 self._wal_tail_dirty = True  # a prefix may have landed
@@ -745,8 +772,8 @@ class Journal:
             # there is nothing left worth persisting into.
             if self.root.exists():
                 self.compact()
-                if self._meta_dirty:
-                    with self._commit_lock:
+                with self._commit_lock:
+                    if self._meta_dirty:
                         self._write_meta()
         finally:
             self._closed = True
@@ -754,10 +781,14 @@ class Journal:
                 if self._timer_handle is not None:
                     self._timer_handle.cancel()
                     self._timer_handle = None
-            try:
-                self._fh.close()
-            except OSError:
-                pass
+            # Under the commit lock: a window-fire commit still in flight on
+            # the timer thread must finish its write before the handle dies
+            # beneath it (graftlint GL-LOCK-GUARD on _fh, ISSUE 8).
+            with self._commit_lock:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
             _LIVE_JOURNALS.discard(self)
 
     def stats(self) -> dict:
