@@ -1,0 +1,400 @@
+"""Cluster failover chaos (ISSUE 9): a worker killed mid-storm loses ZERO
+verdict-path ops, the recovered workspace state is bit-identical to a
+never-crashed oracle run, no stale-epoch write ever lands, and the whole
+storm is bit-reproducible per CHAOS_SEED. Plus: heartbeat-partition
+failover, real-process workers (spawn, ack, SIGKILL, failover), the slo
+harness ``--workers`` merge, and the sitrep cluster collector.
+
+``CHAOS_SEED`` (env) parameterizes the storms; CI runs seeds 0/1/2.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import pytest
+
+from vainplex_openclaw_tpu.analysis.witness import LockOrderWitness
+from vainplex_openclaw_tpu.cluster import ClusterSupervisor
+from vainplex_openclaw_tpu.cluster.ring import FENCE_FILE, LeaseTable
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                     installed)
+from vainplex_openclaw_tpu.slo.workload import generate_workload
+from vainplex_openclaw_tpu.storage.journal import Journal, reset_journals
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+BASE_T = 1_753_772_400.0
+N_OPS = 180
+TENANTS = 8
+
+# Deterministic journal settings for the exactly-once ack alignment: the
+# ONLY commit trigger is the worker's ack boundary (and explicit flushes),
+# so acked == committed == recovered, and redelivery covers exactly the
+# ops a crash rolled back.
+JOURNAL_CFG = {"maxBatchRecords": 1_000_000, "windowMs": 0.0}
+
+
+class SetClock:
+    def __init__(self, t: float = BASE_T):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def build_ops(seed: int, root: Path) -> list:
+    ops = generate_workload(seed, N_OPS, TENANTS)
+    return [{"i": op.index, "at": BASE_T + op.arrival,
+             "ws": str(root / "tenants" / f"tenant{op.tenant}"),
+             "wsKey": f"tenant{op.tenant}", "kind": op.kind,
+             "content": op.content, "ids": f"{seed}:{op.index}"}
+            for op in ops]
+
+
+def flush_cluster(sup) -> None:
+    """Make every live worker's tenant state files current (tracker flush =
+    journal compact per stream)."""
+    sup.drain()
+    for state in sup.workers().values():
+        if not state.alive:
+            continue
+        for trackers in list(state.handle.cortex._trackers.values()):
+            trackers.flush()
+
+
+def tenant_state(root: Path) -> dict:
+    """Root-normalized bytes of every tenant's tracker files."""
+    out = {}
+    for t in range(TENANTS):
+        for name in ("threads.json", "decisions.json", "commitments.json"):
+            path = (root / "tenants" / f"tenant{t}" / "memory" / "reboot"
+                    / name)
+            if path.exists():
+                out[f"tenant{t}/{name}"] = path.read_bytes().replace(
+                    str(root).encode(), b"ROOT")
+    return out
+
+
+def run_storm(root: Path, seed: int, kill_step=None,
+              heartbeat_steps=()) -> dict:
+    """One seeded storm through a 3-worker in-process cluster. Returns a
+    duration-free summary (bit-comparable across runs and roots)."""
+    reset_journals()
+    clock = SetClock()
+    results: dict[int, dict] = {}
+    sup = ClusterSupervisor(
+        root, {"workers": 3, "ackEveryOps": 6, "deterministicIds": True,
+               "heartbeatMissLimit": 2},
+        clock=clock, wall_timers=False, settable_clock=clock,
+        journal_cfg=JOURNAL_CFG, logger=list_logger(),
+        on_result=lambda op, obs: results.__setitem__(op.get("i"), obs))
+    witness = LockOrderWitness()
+    witness.wrap_attr(sup, "_lock", "ClusterSupervisor._lock")
+    witness.wrap_attr(sup.leases, "_lock", "LeaseTable._lock")
+    if sup.leases.journal is not None:
+        witness.wrap_attr(sup.leases.journal, "_commit_lock",
+                          "Journal._commit_lock")
+        witness.wrap_attr(sup.leases.journal, "_buffer_lock",
+                          "Journal._buffer_lock")
+    witness.wrap_attr(sup.timer, "_lock", "ClusterSupervisor.timer._lock")
+
+    ops = build_ops(seed, root)
+    specs = [
+        FaultSpec("cluster.route", steps=(37,)),
+        FaultSpec("journal.fsync", rate=0.05),
+        FaultSpec("journal.append", rate=0.02, mode="torn"),
+    ]
+    if kill_step is not None:
+        specs.append(FaultSpec("cluster.worker.crash", steps=(kill_step,)))
+    if heartbeat_steps:
+        specs.append(FaultSpec("cluster.heartbeat", steps=heartbeat_steps))
+    plan = FaultPlan(specs, seed=seed)
+    with installed(plan):
+        for op in ops:
+            sup.submit(op)
+            sup.tick()
+        flush_cluster(sup)
+    stats = sup.stats()
+    state = tenant_state(root)
+    summary = {
+        "results": {i: results.get(i) for i in range(N_OPS)},
+        "fired": dict(plan.fired),
+        "failovers": [{k: v for k, v in f.items() if k != "durationMs"}
+                      for f in stats["failovers"]],
+        "membership": stats["membership"],
+        "fencedRecords": stats["fencedRecords"],
+        "redelivered": stats["redelivered"],
+        "routeFaults": stats["routeFaults"],
+        "leases": {Path(ws).name: lease
+                   for ws, lease in stats["leases"].items()},
+        "state": state,
+    }
+    sup.stop()
+    witness.assert_acyclic()
+    reset_journals()
+    return summary
+
+
+def verdict_check(summary: dict, ops: list) -> None:
+    expected_denials = sum(1 for op in ops if op["kind"] == "tool_denied")
+    expected_redactions = sum(1 for op in ops if op["kind"] == "tool_secret")
+    results = summary["results"]
+    assert all(results[i] is not None for i in range(N_OPS)), \
+        "every op must produce a final observation (zero losses)"
+    observed_denials = sum(
+        1 for op in ops
+        if op["kind"] == "tool_denied" and results[op["i"]].get("blocked"))
+    false_blocks = sum(
+        1 for op in ops
+        if op["kind"] == "tool_ok" and results[op["i"]].get("blocked"))
+    observed_redactions = sum(
+        1 for op in ops
+        if op["kind"] == "tool_secret" and results[op["i"]].get("redacted"))
+    assert observed_denials == expected_denials
+    assert observed_redactions == expected_redactions
+    assert false_blocks == 0
+
+
+class TestWorkerKillStorm:
+    KILL_STEP = 90
+
+    def test_kill_mid_storm_zero_losses_state_matches_oracle(self, tmp_path):
+        killed = run_storm(tmp_path / "kill", CHAOS_SEED,
+                           kill_step=self.KILL_STEP)
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+
+        assert killed["fired"].get("cluster.worker.crash") == 1
+        assert len(killed["failovers"]) == 1
+        failover = killed["failovers"][0]
+        assert failover["workspacesMoved"] >= 1
+        assert killed["membership"]["dead"] == [failover["worker"]]
+        ops = build_ops(CHAOS_SEED, tmp_path / "kill")
+        verdict_check(killed, ops)
+
+        # no stale-epoch write ever landed
+        assert killed["fencedRecords"] == 0
+        # bit-identical recovered workspace state vs the never-crashed run
+        assert killed["state"].keys() == oracle["state"].keys()
+        for name in killed["state"]:
+            assert killed["state"][name] == oracle["state"][name], name
+        # moved workspaces got new epochs; untouched ones kept epoch 1
+        moved_epochs = [lease["epoch"]
+                        for lease in killed["leases"].values()]
+        assert max(moved_epochs) == 2
+        assert all(lease["epoch"] == 1
+                   for lease in oracle["leases"].values())
+
+    def test_storm_bit_identical_per_seed(self, tmp_path):
+        a = run_storm(tmp_path / "a", CHAOS_SEED, kill_step=self.KILL_STEP)
+        b = run_storm(tmp_path / "b", CHAOS_SEED, kill_step=self.KILL_STEP)
+        assert a == b
+        assert sum(a["fired"].values()) > 0, "the storm was real"
+
+    def test_different_seed_different_storm(self, tmp_path):
+        a = run_storm(tmp_path / "a", CHAOS_SEED, kill_step=self.KILL_STEP)
+        c = run_storm(tmp_path / "c", CHAOS_SEED + 17,
+                      kill_step=self.KILL_STEP)
+        assert a["fired"] != c["fired"] or a["results"] != c["results"]
+
+
+class TestHeartbeatPartition:
+    def test_heartbeat_loss_fails_over_and_state_survives(self, tmp_path):
+        # tick t probes (w0, w1, w2) in order → w1's probes are global
+        # heartbeat calls 3(t-1)+2. Suppress two consecutive probes around
+        # mid-storm; missLimit=2 fails w1 over while it is still RUNNING —
+        # the partition/zombie shape.
+        t = 40
+        steps = (3 * (t - 1) + 2, 3 * t + 2)
+        part = run_storm(tmp_path / "part", CHAOS_SEED,
+                         heartbeat_steps=steps)
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        assert part["fired"].get("cluster.heartbeat") == 2
+        assert len(part["failovers"]) == 1
+        assert part["failovers"][0]["worker"] == "w1"
+        ops = build_ops(CHAOS_SEED, tmp_path / "part")
+        verdict_check(part, ops)
+        # takeover barrier: state still converges to the oracle's bytes
+        for name in oracle["state"]:
+            assert part["state"][name] == oracle["state"][name], name
+
+    def test_zombie_write_after_partition_is_fenced(self, tmp_path):
+        """The e2e stale-writer race: after the partition failover, a
+        journal instance still holding the OLD epoch (what the partitioned
+        worker's process would own) tries to write — the commit is
+        rejected at the boundary, counted, and the new owner's files never
+        see it."""
+        t = 40
+        steps = (3 * (t - 1) + 2, 3 * t + 2)
+        summary = run_storm(tmp_path / "z", CHAOS_SEED,
+                            heartbeat_steps=steps)
+        moved = [name for name, lease in summary["leases"].items()
+                 if lease["epoch"] == 2]
+        assert moved, "partition failover moved at least one workspace"
+        ws = tmp_path / "z" / "tenants" / moved[0]
+        before = {p.name: p.read_bytes()
+                  for p in (ws / "memory" / "reboot").glob("*.json")}
+        zombie = Journal(ws / "journal", JOURNAL_CFG, wall=False)
+        zombie.register_snapshot(
+            "cortex:threads", ws / "memory" / "reboot" / "threads.json",
+            indent=None)
+        zombie.set_fence(ws / FENCE_FILE, 1)  # the PRE-failover epoch
+        zombie.append("cortex:threads", {"threads": ["ZOMBIE WRITE"]})
+        assert zombie.commit() is False
+        assert zombie.stats()["fencedRecords"] == 1
+        assert zombie.compact() is False
+        zombie.close()
+        after = {p.name: p.read_bytes()
+                 for p in (ws / "memory" / "reboot").glob("*.json")}
+        assert after == before  # nothing landed
+        assert LeaseTable.read_fence(ws)["epoch"] == 2
+        reset_journals()
+
+
+class TestProcessWorkers:
+    """Real multiprocessing workers: spawn, route, ack, SIGKILL, failover."""
+
+    def test_round_trip_kill_and_failover(self, tmp_path):
+        results: dict[int, dict] = {}
+        sup = ClusterSupervisor(
+            tmp_path, {"workers": 2, "ackEveryOps": 4,
+                       "heartbeatDeadlineS": 5.0},
+            worker_mode="process", journal_cfg={"fsync": "os"},
+            on_result=lambda op, obs: results.__setitem__(op.get("i"), obs))
+        try:
+            ops = build_ops(CHAOS_SEED, tmp_path)[:24]
+            for op in ops[:12]:
+                sup.submit(op)
+            sup.drain(timeout_s=60.0)
+            assert len(results) == 12
+
+            victim = sup.stats()["membership"]["live"][0]
+            sup.workers()[victim].handle.kill()
+            sup.tick()  # Process.is_alive() is the immediate signal
+            stats = sup.stats()
+            assert stats["membership"]["dead"] == [victim]
+            assert len(stats["failovers"]) == 1
+
+            for op in ops[12:]:
+                sup.submit(op)
+            sup.drain(timeout_s=60.0)
+            assert len(results) == 24, \
+                "ops after failover (incl. moved workspaces) all served"
+        finally:
+            sup.stop()
+
+
+class TestSloWorkersMode:
+    def test_cluster_report_merges_worker_stages(self, tmp_path):
+        from vainplex_openclaw_tpu.slo import run_slo_report
+
+        report = run_slo_report(seed=7, n_ops=120, tenants=4, mode="wall",
+                                workers=2)
+        reset_journals()
+        assert report["workers"] == 2
+        assert report["verdicts"]["losses"] == 0
+        assert report["verdicts"]["false_blocks"] == 0
+        # merged edges: per-worker governance timers folded into ONE edge
+        assert "governance" in report["stages"]
+        assert "cluster" in report["stages"]
+        gov_count = sum(report["stage_counts"]["governance"].values())
+        assert gov_count > 0
+        live = report["cluster"]["membership"]["live"]
+        assert sorted(live) == ["w0", "w1"]
+        assert report["sitrep"]["cluster"], "sitrep cluster line present"
+
+    def test_workers_requires_wall_mode(self):
+        from vainplex_openclaw_tpu.slo import run_slo_report
+
+        with pytest.raises(ValueError):
+            run_slo_report(n_ops=10, mode="sim", workers=2)
+
+
+class TestSitrepClusterCollector:
+    def _status(self, **over):
+        base = {
+            "workers": {"w0": {"alive": True,
+                               "breaker": {"state": "closed"}}},
+            "membership": {"live": ["w0"], "dead": []},
+            "leases": {"/x/tenant0": {"owner": "w0", "epoch": 1}},
+            "routed": 10, "redelivered": 0, "routeFaults": 0,
+            "inflight": 0, "fencedRecords": 0, "lastFailover": None,
+            "failovers": [], "routeLog": {"published": 10},
+        }
+        base.update(over)
+        return base
+
+    def test_skipped_without_cluster(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        out = collect_cluster({}, {})
+        assert out["status"] == "skipped"
+
+    def test_healthy_cluster_ok(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        out = collect_cluster({}, {"cluster_status": self._status})
+        assert out["status"] == "ok"
+        assert "1 live / 0 dead" in out["summary"]
+        assert out["items"][0]["leaseEpochs"] == {"/x/tenant0": 1}
+
+    def test_fencing_rejections_warn(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        out = collect_cluster({}, {"cluster_status":
+                                   lambda: self._status(fencedRecords=3)})
+        assert out["status"] == "warn"
+        assert "fencedRecords=3" in out["summary"]
+
+    def test_half_open_breaker_warns(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = self._status()
+        status["workers"]["w0"]["breaker"] = {"state": "half-open"}
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "warn"
+        assert "w0.breaker=half-open" in out["summary"]
+
+    def test_dead_worker_and_last_failover_in_summary(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = self._status(
+            membership={"live": ["w1"], "dead": ["w0"]},
+            lastFailover={"worker": "w0", "workspacesMoved": 3,
+                          "replayedRecords": 7, "durationMs": 41.2})
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "warn"
+        assert "last failover: w0 (3 ws, 7 replayed, 41.2ms)" in out["summary"]
+
+
+class TestEscapeHatch:
+    def test_no_cluster_config_keeps_timer_names_unprefixed(self):
+        from vainplex_openclaw_tpu.core import Gateway
+
+        gw = Gateway(config={})
+        gw._register_stage_timer("p", "governance", object())
+        assert "governance" in gw.stage_timers
+        pref = Gateway(config={"cluster": {"workerPrefix": "w3:"}})
+        pref._register_stage_timer("p", "governance", object())
+        assert "w3:governance" in pref.stage_timers
+
+    def test_stage_timer_state_absorb_roundtrip(self):
+        from vainplex_openclaw_tpu.utils.stage_timer import StageTimer
+
+        a, b, merged = StageTimer(), StageTimer(), StageTimer()
+        for ms in (0.5, 1.5, 2.5, 100.0):
+            a.add("route", ms)
+        for ms in (0.7, 3.0):
+            b.add("route", ms)
+            b.add("recover", ms * 10)
+        merged.absorb(a.state())
+        merged.absorb(b.state())
+        snap = merged.snapshot()
+        assert snap["counts"] == {"route": 6, "recover": 2}
+        assert snap["stages_ms"]["route"] == pytest.approx(108.2, abs=0.01)
+        # merged histogram == one timer fed all samples
+        one = StageTimer()
+        for ms in (0.5, 1.5, 2.5, 100.0, 0.7, 3.0):
+            one.add("route", ms)
+        assert merged.state()["hist"]["route"] == one.state()["hist"]["route"]

@@ -1,0 +1,257 @@
+"""Cluster sharding primitives (ISSUE 9): ring determinism + bounded
+movement (property tests), bounded-load placement, lease table epochs +
+persistence, and journal epoch fencing (the stale-writer race)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from vainplex_openclaw_tpu.cluster.ring import (FENCE_FILE, HashRing,
+                                                LeaseTable)
+from vainplex_openclaw_tpu.storage.atomic import read_json, write_json_atomic
+from vainplex_openclaw_tpu.storage.journal import FencedWriteError, Journal
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_700_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+KEYS = [f"tenant{i}" for i in range(200)]
+
+
+class TestRingDeterminism:
+    def test_same_assignment_across_instances_and_insertion_orders(self):
+        a = HashRing()
+        for w in ("w0", "w1", "w2", "w3"):
+            a.add(w)
+        b = HashRing()
+        for w in ("w3", "w1", "w0", "w2"):  # permuted insertion
+            b.add(w)
+        assert a.assignment(KEYS) == b.assignment(KEYS)
+
+    def test_assignment_is_pure_function_of_membership(self):
+        ring = HashRing()
+        for w in ("w0", "w1", "w2"):
+            ring.add(w)
+        first = ring.assignment(KEYS)
+        assert ring.assignment(KEYS) == first  # rerun: identical
+        ring.remove("w1")
+        ring.add("w1")  # remove+re-add restores the original assignment
+        assert ring.assignment(KEYS) == first
+
+    def test_sha_not_pythonhash(self):
+        # The coordinates must not depend on PYTHONHASHSEED: pin a few
+        # concrete ownerships so a platform/hash drift fails loudly.
+        ring = HashRing(vnodes=64)
+        for w in ("w0", "w1"):
+            ring.add(w)
+        assignment = ring.assignment(KEYS[:32])
+        assert set(assignment.values()) == {"w0", "w1"}  # both sides populated
+
+
+class TestBoundedMovement:
+    def test_removal_moves_only_departed_workers_keys(self):
+        ring = HashRing()
+        for w in ("w0", "w1", "w2", "w3"):
+            ring.add(w)
+        before = ring.assignment(KEYS)
+        ring.remove("w2")
+        after = ring.assignment(KEYS)
+        for key in KEYS:
+            if before[key] != "w2":
+                assert after[key] == before[key], key  # survivors untouched
+            else:
+                assert after[key] != "w2"
+
+    def test_addition_moves_only_keys_claimed_by_arrival(self):
+        ring = HashRing()
+        for w in ("w0", "w1", "w2"):
+            ring.add(w)
+        before = ring.assignment(KEYS)
+        ring.add("w9")
+        after = ring.assignment(KEYS)
+        moved = [k for k in KEYS if after[k] != before[k]]
+        assert moved, "a new worker must take some share"
+        assert all(after[k] == "w9" for k in moved)
+        # ~1/N of the keyspace, not a reshuffle
+        assert len(moved) < len(KEYS) * 0.5
+
+    def test_bounded_load_cap_respected_and_deterministic(self):
+        ring = HashRing()
+        for w in ("w0", "w1", "w2", "w3"):
+            ring.add(w)
+        loads: dict = {}
+        cap = 58  # 1.15 * 200/4
+        for key in KEYS:
+            owner = ring.owner(key, loads, cap)
+            loads[owner] = loads.get(owner, 0) + 1
+        assert max(loads.values()) <= cap
+        # same inputs → same placement
+        loads2: dict = {}
+        seq_a = []
+        for key in KEYS:
+            o = ring.owner(key, loads2, cap)
+            loads2[o] = loads2.get(o, 0) + 1
+            seq_a.append(o)
+        loads3: dict = {}
+        seq_b = []
+        for key in KEYS:
+            o = ring.owner(key, loads3, cap)
+            loads3[o] = loads3.get(o, 0) + 1
+            seq_b.append(o)
+        assert seq_a == seq_b
+
+    def test_all_at_cap_falls_back_to_raw_successor(self):
+        ring = HashRing()
+        ring.add("w0")
+        ring.add("w1")
+        assert ring.owner("k", {"w0": 5, "w1": 5}, 5) in ("w0", "w1")
+
+
+class TestLeaseTable:
+    def test_epochs_increment_and_fence_file_written(self, tmp_path):
+        clock = FakeClock()
+        table = LeaseTable(tmp_path / "cluster", clock=clock)
+        ws = str(tmp_path / "tenant0")
+        assert table.epoch(ws) == 0
+        assert table.grant(ws, "w0") == 1
+        assert table.grant(ws, "w1") == 2
+        assert table.owner(ws) == "w1"
+        fence = LeaseTable.read_fence(ws)
+        assert fence == {"epoch": 2, "owner": "w1", "grantedAt": clock.t}
+        table.close()
+
+    def test_leases_survive_reopen(self, tmp_path):
+        clock = FakeClock()
+        table = LeaseTable(tmp_path / "cluster", clock=clock)
+        ws_a, ws_b = str(tmp_path / "a"), str(tmp_path / "b")
+        table.grant(ws_a, "w0")
+        table.grant(ws_b, "w1")
+        table.grant(ws_a, "w1")  # epoch 2
+        table.close()
+        reopened = LeaseTable(tmp_path / "cluster", clock=clock)
+        assert reopened.epoch(ws_a) == 2
+        assert reopened.owner(ws_a) == "w1"
+        assert reopened.owner(ws_b) == "w1"
+        # epochs keep moving from the recovered base — fencing across
+        # supervisor restarts
+        assert reopened.grant(ws_a, "w0") == 3
+        reopened.close()
+
+    def test_owned_by(self, tmp_path):
+        table = LeaseTable(tmp_path / "cluster", clock=FakeClock())
+        table.grant(str(tmp_path / "x"), "w0")
+        table.grant(str(tmp_path / "y"), "w0")
+        table.grant(str(tmp_path / "z"), "w1")
+        assert table.owned_by("w0") == sorted(
+            [str(tmp_path / "x"), str(tmp_path / "y")])
+        table.close()
+
+
+class TestJournalFencing:
+    """The race the fence exists for: a stale-epoch writer (zombie) against
+    the new owner. The journal must reject the stale write, count it, and
+    never let it reach the wal or the legacy files."""
+
+    def _journal(self, ws, epoch):
+        j = Journal(ws / "journal", {"windowMs": 0.0})
+        j.register_snapshot("cortex:threads", ws / "threads.json",
+                            indent=None)
+        j.set_fence(ws / FENCE_FILE, epoch)
+        return j
+
+    def test_stale_epoch_commit_rejected_and_counted(self, tmp_path):
+        ws = tmp_path / "tenant0"
+        ws.mkdir()
+        write_json_atomic(ws / FENCE_FILE, {"epoch": 1, "owner": "w0"})
+        zombie = self._journal(ws, 1)
+        assert zombie.append("cortex:threads", {"threads": ["mine"]})
+        assert zombie.commit()  # epoch current: lands
+        assert zombie.compact()
+        owned = (ws / "threads.json").read_bytes()
+
+        # ownership moves: the new owner stamps epoch 2
+        write_json_atomic(ws / FENCE_FILE, {"epoch": 2, "owner": "w1"})
+        assert zombie.append("cortex:threads", {"threads": ["stale write"]})
+        assert zombie.commit() is False  # rejected at the boundary
+        stats = zombie.stats()
+        assert stats["fenced"] is True
+        assert stats["fencedRecords"] == 1
+        # nothing landed: wal tail unchanged, legacy file unchanged
+        assert (ws / "threads.json").read_bytes() == owned
+        wal = (ws / "journal" / "wal.000000.jsonl").read_text()
+        assert "stale write" not in wal
+
+    def test_fenced_journal_raises_not_falls_back(self, tmp_path):
+        ws = tmp_path / "tenant1"
+        ws.mkdir()
+        write_json_atomic(ws / FENCE_FILE, {"epoch": 5, "owner": "w1"})
+        zombie = self._journal(ws, 4)  # born stale
+        zombie.append("cortex:threads", {"threads": []})
+        assert zombie.commit() is False
+        # Once fenced, appends RAISE (OSError subclass): returning False
+        # would route the owner onto its legacy atomic-write path — the
+        # exact split-brain the fence closes.
+        with pytest.raises(FencedWriteError):
+            zombie.append("cortex:threads", {"threads": ["again"]})
+        assert isinstance(FencedWriteError("x"), OSError)
+
+    def test_fenced_close_writes_nothing(self, tmp_path):
+        ws = tmp_path / "tenant2"
+        ws.mkdir()
+        write_json_atomic(ws / FENCE_FILE, {"epoch": 1, "owner": "w0"})
+        zombie = self._journal(ws, 1)
+        zombie.append("cortex:threads", {"threads": ["pre"]})
+        zombie.commit()
+        zombie.compact()
+        meta_before = read_json(ws / "journal" / "journal.meta.json", None)
+        write_json_atomic(ws / FENCE_FILE, {"epoch": 2, "owner": "w1"})
+        zombie.append("cortex:threads", {"threads": ["late"]})
+        zombie.close()
+        assert read_json(ws / "journal" / "journal.meta.json",
+                         None) == meta_before
+        assert json.loads((ws / "threads.json").read_text()) == {
+            "threads": ["pre"]}
+
+    def test_no_fence_configured_is_zero_cost_noop(self, tmp_path):
+        ws = tmp_path / "tenant3"
+        j = Journal(ws / "journal", {"windowMs": 0.0})
+        j.register_snapshot("s", ws / "s.json", indent=None)
+        assert j.append("s", {"ok": 1})
+        assert j.commit()
+        stats = j.stats()
+        assert stats["fenced"] is False
+        assert stats["fencedRecords"] == 0
+        assert stats["fenceEpoch"] is None
+        j.close()
+
+    def test_missing_fence_file_means_unfenced(self, tmp_path):
+        ws = tmp_path / "tenant4"
+        ws.mkdir()
+        j = self._journal(ws, 1)  # fence armed but file never written
+        j.append("cortex:threads", {"threads": ["fresh"]})
+        assert j.commit()
+        j.close()
+        assert json.loads((ws / "threads.json").read_text()) == {
+            "threads": ["fresh"]}
+
+    def test_abandon_drops_buffered_keeps_committed(self, tmp_path):
+        ws = tmp_path / "tenant5"
+        j = Journal(ws / "journal", {"windowMs": 0.0})
+        j.register_snapshot("s", ws / "s.json", indent=None)
+        j.append("s", {"v": "committed"})
+        j.commit()
+        j.append("s", {"v": "buffered-only"})
+        j.abandon()  # kill -9 semantics: no commit, no compaction
+        assert j.append("s", {"v": "late"}) is False  # closed
+        recovered = Journal(ws / "journal", {"windowMs": 0.0})
+        recovered.register_snapshot("s", ws / "s.json", indent=None)
+        assert json.loads((ws / "s.json").read_text()) == {"v": "committed"}
+        assert recovered.stats()["replay"]["records"] == 1
+        recovered.close()

@@ -224,8 +224,8 @@ class TestGenerateAndRotate:
         assert report["health"] == "healthy"
         assert set(report["collectors"]) == {
             "systemd_timers", "nats", "goals", "threads", "errors", "calendar",
-            "gateway", "stage_quantiles", "resilience", "journal", "slo",
-            "pattern_safety"}
+            "gateway", "stage_quantiles", "resilience", "journal", "cluster",
+            "slo", "pattern_safety"}
         assert all(r["status"] == "skipped" for r in report["collectors"].values())
         assert report["generatedAt"].endswith("Z")
 

@@ -54,6 +54,9 @@ CONFIG_SITES: tuple = (
     ("vainplex_openclaw_tpu/knowledge/fact_store.py",
      ("DEFAULT_STORE_CONFIG",), ("config", "self.config"),
      None),
+    ("vainplex_openclaw_tpu/cluster/supervisor.py",
+     ("CLUSTER_DEFAULTS",), ("cfg", "self.cfg"),
+     None),
 )
 
 

@@ -88,12 +88,18 @@ GUARDED: tuple = (
             "_buffer_lock": ("_pending_records", "_appends_since_commit",
                              "_timer_handle", "_streams"),
             "_commit_lock": ("_marks", "_fh", "_wal_bytes", "_gen",
-                             "_meta_dirty", "_wal_tail_dirty"),
+                             "_meta_dirty", "_wal_tail_dirty",
+                             "_fenced", "fence_rejected", "fence_path",
+                             "fence_epoch"),
         },
         # _streams: registration writes race _drain_pending's iteration;
         # point reads (dict probe) are GIL-atomic and stay unflagged.
         # _wal_bytes/_gen: stats() reads are documented torn-tolerant.
-        write_only=("_streams", "_wal_bytes", "_gen"),
+        # fence state (ISSUE 9): written only under the commit lock
+        # (set_fence / the commit-time check); append's fast-path read of
+        # _fenced and stats()' counter reads are torn-tolerant scalars.
+        write_only=("_streams", "_wal_bytes", "_gen",
+                    "_fenced", "fence_rejected", "fence_path", "fence_epoch"),
         holders={
             "_open": ("_commit_lock",),
             "_adopt_recovered": ("_commit_lock",),
@@ -159,6 +165,24 @@ GUARDED: tuple = (
     GuardSpec(
         module="vainplex_openclaw_tpu/storage/atomic.py", cls="Debouncer",
         locks={"_lock": ("_timer", "_pending")},
+        hot=("_lock",),
+    ),
+    # Cluster classes (ISSUE 9): the supervisor's bookkeeping is read by
+    # sitrep/status threads while the dispatch path mutates it, and the
+    # lease table is the fencing source of truth — both hot (delivery and
+    # lease grants must never convoy behind blocking work under the lock;
+    # journal/fence I/O happens outside the critical sections).
+    GuardSpec(
+        module="vainplex_openclaw_tpu/cluster/supervisor.py",
+        cls="ClusterSupervisor",
+        locks={"_lock": ("_workers", "_acked", "_inflight", "_backlog",
+                         "_failovers", "routed", "redelivered",
+                         "route_faults")},
+        hot=("_lock",),
+    ),
+    GuardSpec(
+        module="vainplex_openclaw_tpu/cluster/ring.py", cls="LeaseTable",
+        locks={"_lock": ("_leases",)},
         hot=("_lock",),
     ),
 )

@@ -1,0 +1,25 @@
+"""Workspace-sharded multi-worker gateway with lease-fenced failover
+(ISSUE 9, ROADMAP open item 2).
+
+``cluster.enabled: false`` (the default everywhere) keeps the single-process
+gateway path byte-for-byte untouched; this package is pure opt-in scale-out
+infrastructure. See docs/cluster.md for the design walkthrough.
+"""
+
+from .ring import FENCE_FILE, HashRing, LeaseTable
+from .supervisor import CLUSTER_DEFAULTS, ClusterSupervisor
+from .worker import (InProcessWorker, ProcessWorker, WorkerCrashed,
+                     build_worker_gateway, dispatch_op)
+
+__all__ = [
+    "CLUSTER_DEFAULTS",
+    "ClusterSupervisor",
+    "FENCE_FILE",
+    "HashRing",
+    "InProcessWorker",
+    "LeaseTable",
+    "ProcessWorker",
+    "WorkerCrashed",
+    "build_worker_gateway",
+    "dispatch_op",
+]

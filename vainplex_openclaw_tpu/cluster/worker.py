@@ -1,0 +1,439 @@
+"""Cluster workers: the real Gateway, sharded by workspace.
+
+A worker is a full serving stack — governance enforcement + redaction over
+its own root, cortex conversation intelligence per tenant workspace — fed
+ops by the supervisor and answering with verdict observations. Two shapes
+share one contract:
+
+- :class:`InProcessWorker` — the worker pipeline in the supervisor's
+  process. This is the deterministic shape: a settable virtual clock, per-op
+  id seeding, and seeded fault sites (``cluster.worker.crash``,
+  ``cluster.heartbeat``) make a worker-kill storm bit-reproducible, which is
+  what lets the chaos suite compare a crashed-and-recovered cluster against
+  a never-crashed oracle byte for byte.
+- :class:`ProcessWorker` — a real ``multiprocessing.Process`` (stdlib only,
+  same discipline as the rest of the repo) speaking over queues: ops in,
+  results/acks/heartbeats out. This is the shape the scaling bench runs; a
+  ``kill()`` here is a real SIGKILL and failover detection rides
+  ``Process.is_alive`` + the heartbeat deadline.
+
+**The ack protocol is the durability boundary.** A worker acks a batch of
+route-log sequence numbers only after group-committing every workspace
+journal it touched since the previous ack. The supervisor replays
+everything past the acked watermark to the new owner after a failover, and
+a crash loses only journal-*buffered* (never committed, never acked)
+records — so redelivery is effectively exactly-once: the recovered state
+contains an op's effects iff that op was acked, and exactly the un-acked
+ops are redelivered.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from ..resilience.faults import FaultError, maybe_fail
+from ..storage.journal import peek_journal
+from ..utils import ids
+from .ring import FENCE_FILE
+
+# One literal per fault site so the package-level registry scan
+# (graftlint GL-DRIFT-FAULTSITE) knows the cluster's injection points:
+#   cluster.worker.crash — worker dies at a seeded delivery step
+#   cluster.heartbeat    — a heartbeat probe is lost (partition)
+#   cluster.recover      — workspace recovery on the new owner fails once
+#   cluster.route        — transient routing fault in the supervisor
+#   cluster.lease        — lease/fence persistence fault (ring.py)
+
+
+class WorkerCrashed(RuntimeError):
+    """Raised by a dead worker handle; the supervisor's failover trigger."""
+
+
+def dispatch_op(gw, kind: str, content: str, ctx: dict) -> dict:
+    """Run one workload op through a gateway; returns verdict-path
+    observations. Shared by the SLO harness and the cluster workers — one
+    implementation, so the single-process and sharded paths can never
+    disagree about what an op *is*."""
+    if kind == "msg_in":
+        gw.message_received(content, ctx)
+        return {}
+    if kind == "msg_out":
+        gw.message_sent(content, ctx)
+        return {}
+    if kind == "tool_ok" or kind == "tool_denied":
+        decision, _ = gw.run_tool("read", {"path": content},
+                                  lambda p: f"contents of {content}", ctx)
+        return {"blocked": decision.blocked}
+    # tool_secret: result must come back redacted (NEVER_SHED path)
+    out = gw.tool_result_persist("exec", content, ctx)
+    return {"redacted": isinstance(out, str) and "[REDACTED" in out}
+
+
+def build_worker_gateway(worker_root: str | Path, worker_id: str,
+                         clock: Callable[[], float] = time.time,
+                         wall_timers: bool = True,
+                         journal_cfg: Any = True, logger=None):
+    """The standard worker profile: governance (credential guard +
+    redaction, audit at the worker root) + cortex (per-tenant trackers over
+    the shared workspace journals). Stage-timer keys carry the worker's
+    prefix so merged cluster views stay attributable."""
+    from ..core import Gateway
+    from ..cortex import CortexPlugin
+    from ..governance import GovernancePlugin
+
+    root = Path(worker_root)
+    root.mkdir(parents=True, exist_ok=True)
+    config = {"workspace": str(root), "agents": [{"id": worker_id}],
+              "cluster": {"workerPrefix": f"{worker_id}:"}}
+    kwargs = {} if clock is time.time else {"clock": clock}
+    gw = Gateway(config=config, logger=logger, **kwargs)
+    gov = GovernancePlugin(workspace=str(root), **kwargs)
+    gw.load(gov, plugin_config={
+        "redaction": {"enabled": True},
+        "builtinPolicies": {"credentialGuard": True,
+                            "rateLimiter": {"maxPerMinute": 10_000_000}},
+        "storage": {"journal": journal_cfg},
+    })
+    cortex = CortexPlugin(wall_timers=wall_timers, **kwargs)
+    gw.load(cortex, plugin_config={"languages": "all",
+                                   "traceAnalyzer": {"enabled": False},
+                                   "registerTools": False,
+                                   "storage": {"journal": journal_cfg}})
+    gw.start()
+    return gw, cortex, gov
+
+
+class InProcessWorker:
+    """Deterministic in-process worker (chaos storms, slo --workers)."""
+
+    sync = True
+
+    def __init__(self, worker_id: str, root: str | Path,
+                 clock: Callable[[], float] = time.time,
+                 ack_every: int = 16, wall_timers: bool = True,
+                 deterministic_ids: bool = False,
+                 settable_clock: Any = None,
+                 journal_cfg: Any = True, logger=None):
+        self.worker_id = worker_id
+        self.root = Path(root)
+        self.clock = clock
+        self.ack_every = max(1, int(ack_every))
+        self.deterministic_ids = deterministic_ids
+        self._settable_clock = settable_clock
+        self.shard: dict[str, int] = {}  # ws -> lease epoch
+        self.alive = True
+        self.delivered = 0
+        self.acked = 0
+        self._since_ack: list[int] = []   # route-log seqs awaiting ack
+        self._touched: set[str] = set()   # workspaces dirty since last ack
+        self.gw, self.cortex, self.gov = build_worker_gateway(
+            self.root, worker_id, clock=clock, wall_timers=wall_timers,
+            journal_cfg=journal_cfg, logger=logger)
+
+    # ── shard management ─────────────────────────────────────────────
+
+    def add_workspace(self, ws: str, epoch: int) -> dict:
+        """Own ``ws`` at lease ``epoch``: recover state by journal replay
+        (tracker construction opens the workspace journal, which replays
+        wal segments and completes crashed compactions BEFORE the tracker's
+        load — the PR-7 contract), then arm the fence so this worker's own
+        writes die cleanly if the lease ever moves on. Traffic for ``ws``
+        must not be delivered before this returns."""
+        maybe_fail("cluster.recover")
+        # Takeover barrier: if a previous owner's journal instance is still
+        # open in this process (partition-style failover — the worker was
+        # presumed dead, not actually dead), adopt it at the new epoch,
+        # DISCARD its un-acked buffer (the supervisor redelivers those ops
+        # — committing them here would double-apply), and compact the
+        # committed records so the files the trackers load reflect exactly
+        # the acked prefix. A genuinely crashed owner's journal is
+        # abandoned/closed instead, and the fresh open below replays its
+        # wal — same end state, two routes.
+        stale = peek_journal(ws)
+        if stale is not None:
+            try:
+                stale.set_fence(Path(ws) / FENCE_FILE, epoch)
+                stale.drop_pending()
+                stale.compact()
+            except OSError:
+                pass  # failed compaction: recovery replay covers it
+        trackers = self.cortex.trackers({"workspace": ws})
+        journal = trackers.journal
+        replay = {}
+        if journal is not None:
+            replay = dict(journal.stats()["replay"])
+            journal.set_fence(Path(ws) / FENCE_FILE, epoch)
+        self.shard[ws] = epoch
+        return replay
+
+    def drop_workspace(self, ws: str) -> None:
+        self.shard.pop(ws, None)
+
+    # ── delivery / ack ───────────────────────────────────────────────
+
+    def deliver(self, seq: int, op: dict) -> tuple[dict, Optional[list]]:
+        """Process one op; returns ``(obs, acked_seqs_or_None)``. The crash
+        fault site fires at delivery entry — between ops, where a real
+        kill -9 would land — and converts this handle into a corpse: state
+        buffered since the last ack is gone (journals abandoned, exactly as
+        an OS would drop a dead process's memory)."""
+        try:
+            maybe_fail("cluster.worker.crash")
+        except FaultError as exc:
+            self.crash()
+            raise WorkerCrashed(str(exc)) from exc
+        if not self.alive:
+            raise WorkerCrashed(f"{self.worker_id} is dead")
+        if self._settable_clock is not None and "at" in op:
+            self._settable_clock.t = op["at"]
+        if self.deterministic_ids and "ids" in op:
+            ids._ID_RNG.seed(op["ids"])
+        ws = op["ws"]
+        ctx = {"workspace": ws, "agent_id": self.worker_id,
+               "session_key": f"agent:{self.worker_id}:cluster"}
+        obs = dispatch_op(self.gw, op["kind"], op["content"], ctx)
+        self.delivered += 1
+        self._touched.add(ws)
+        self._since_ack.append(seq)
+        if len(self._since_ack) >= self.ack_every:
+            return obs, self._ack()
+        return obs, None
+
+    def _ack(self) -> list:
+        """Group-commit every touched journal, then release the seqs. The
+        commit is what makes the ack honest: an acked op's effects are on
+        disk (per the fsync policy), so failover never needs to replay it.
+        A failed commit (transient write fault — retained and retried — or
+        a fenced/closed journal) therefore acks NOTHING: releasing seqs
+        whose records were dropped would advance the supervisor's watermark
+        past ops that never became durable, turning redelivery into loss."""
+        ok = True
+        for ws in sorted(self._touched):
+            journal = peek_journal(ws)
+            if journal is not None:
+                ok = journal.commit() and ok
+        root_journal = peek_journal(self.root)
+        if root_journal is not None:
+            ok = root_journal.commit() and ok  # worker-own audit/events
+        if not ok:
+            return []  # seqs + touched set retained; next boundary retries
+        self._touched.clear()
+        acked, self._since_ack = self._since_ack, []
+        self.acked += len(acked)
+        return acked
+
+    def flush(self) -> list:
+        return self._ack()
+
+    # ── liveness ─────────────────────────────────────────────────────
+
+    def heartbeat(self) -> float:
+        maybe_fail("cluster.heartbeat")
+        if not self.alive:
+            raise WorkerCrashed(f"{self.worker_id} is dead")
+        return self.clock()
+
+    def crash(self) -> None:
+        """Die like a process: abandon every journal (buffered records drop,
+        committed wal stays for the next owner's replay), keep the gateway
+        object only as a corpse. Nothing is flushed, stopped, or compacted."""
+        if not self.alive:
+            return
+        self.alive = False
+        for ws in list(self.shard) + [str(self.root)]:
+            journal = peek_journal(ws)
+            if journal is not None:
+                journal.abandon()
+        self._since_ack = []
+        self._touched.clear()
+
+    kill = crash
+
+    def stop(self) -> None:
+        if not self.alive:
+            return
+        self._ack()
+        self.gw.stop()
+        self.alive = False
+
+    # ── observability ────────────────────────────────────────────────
+
+    def stage_states(self) -> dict:
+        """Raw mergeable StageTimer states, keyed with the worker prefix."""
+        return {name: timer.state()
+                for name, timer in self.gw.stage_timers.items()}
+
+    def stats(self) -> dict:
+        fenced = 0
+        for ws in self.shard:
+            journal = peek_journal(ws)
+            if journal is not None:
+                fenced += journal.fence_rejected
+        return {"workerId": self.worker_id, "alive": self.alive,
+                "kind": "inproc", "workspaces": len(self.shard),
+                "delivered": self.delivered, "acked": self.acked,
+                "unacked": len(self._since_ack),
+                "fencedRecords": fenced}
+
+
+# ── real-process worker (the scaling bench shape) ────────────────────
+
+
+def mp_context():
+    """The safest usable multiprocessing context. Prefer ``spawn``: the
+    supervisor process carries threads (journal timers, queue feeders,
+    logging) and a ``fork`` taken while one of them holds a lock deadlocks
+    the child — observed intermittently on this very bench. Spawn requires
+    a re-importable ``__main__`` (it re-runs the main module in the child
+    under the ``__mp_main__`` guard); interactive/stdin mains don't have
+    one, so those fall back to fork, which is safe there exactly because a
+    fresh interactive interpreter hasn't started the thread zoo yet."""
+    import multiprocessing as mp
+    import sys
+
+    main = sys.modules.get("__main__")
+    main_file = getattr(main, "__file__", None)
+    if main_file and os.path.exists(main_file):
+        return mp.get_context("spawn")
+    return mp.get_context("fork")
+
+
+def _process_worker_main(worker_id: str, root: str, ack_every: int,
+                         hb_interval_s: float, journal_cfg, in_q,
+                         out_q) -> None:
+    """Child entry point: build the worker profile, loop on the op queue.
+    Every outbound message doubles as a heartbeat (the supervisor stamps
+    ``last_hb`` on anything it drains); an idle child beats explicitly."""
+    import queue as _queue
+
+    worker = InProcessWorker(worker_id, root, ack_every=ack_every,
+                             wall_timers=True, journal_cfg=journal_cfg)
+    out_q.put(("hb", worker_id, time.time()))
+    while True:
+        try:
+            msg = in_q.get(timeout=hb_interval_s)
+        except _queue.Empty:
+            out_q.put(("hb", worker_id, time.time()))
+            continue
+        kind = msg[0]
+        if kind == "ws":
+            _k, ws, epoch = msg
+            try:
+                replay = worker.add_workspace(ws, epoch)
+                out_q.put(("recovered", worker_id, ws, replay))
+            except OSError as exc:
+                out_q.put(("recover_failed", worker_id, ws, str(exc)))
+        elif kind == "op":
+            _k, seq, op = msg
+            try:
+                obs, acked = worker.deliver(seq, op)
+            except WorkerCrashed:
+                break
+            out_q.put(("res", worker_id, op.get("i"), obs, seq))
+            if acked:
+                out_q.put(("ack", worker_id, acked))
+        elif kind == "flush":
+            out_q.put(("ack", worker_id, worker.flush()))
+        elif kind == "stop":
+            acked = worker.flush()
+            out_q.put(("ack", worker_id, acked))
+            out_q.put(("stats", worker_id, worker.stats(),
+                       worker.stage_states()))
+            worker.stop()
+            break
+
+
+class ProcessWorker:
+    """Worker in its own OS process; the contract of :class:`InProcessWorker`
+    flipped asynchronous: ``deliver`` enqueues, results/acks/heartbeats
+    arrive on the supervisor's shared result queue."""
+
+    sync = False
+
+    def __init__(self, worker_id: str, root: str | Path, out_q,
+                 ack_every: int = 16, hb_interval_s: float = 0.25,
+                 journal_cfg: Any = True):
+        # The worker module imports in ~0.3s with no jax, so spawn's
+        # re-import cost (see mp_context) is noise next to gateway build.
+        ctx = mp_context()
+        self.worker_id = worker_id
+        self.root = Path(root)
+        self._in_q = ctx.Queue()
+        self._out_q = out_q
+        self.proc = ctx.Process(
+            target=_process_worker_main,
+            args=(worker_id, str(root), ack_every, hb_interval_s,
+                  journal_cfg, self._in_q, out_q),
+            daemon=True, name=f"cluster-{worker_id}")
+        self.proc.start()
+        self.shard: dict[str, int] = {}
+        self.delivered = 0
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.is_alive()
+
+    def add_workspace(self, ws: str, epoch: int) -> dict:
+        self.shard[ws] = epoch
+        self._in_q.put(("ws", ws, epoch))
+        return {}
+
+    def drop_workspace(self, ws: str) -> None:
+        self.shard.pop(ws, None)
+
+    def deliver(self, seq: int, op: dict) -> tuple[Optional[dict], None]:
+        if not self.proc.is_alive():
+            raise WorkerCrashed(f"{self.worker_id} process is dead")
+        self._in_q.put(("op", seq, op))
+        self.delivered += 1
+        return None, None  # results arrive on the shared queue
+
+    def flush(self) -> list:
+        self._in_q.put(("flush",))
+        return []
+
+    def heartbeat(self) -> float:
+        """Liveness only — real heartbeats arrive on the result queue; a
+        dead process is the immediate signal."""
+        if not self.proc.is_alive():
+            raise WorkerCrashed(f"{self.worker_id} process is dead")
+        return time.time()
+
+    def kill(self) -> None:
+        """Real SIGKILL — the bench's failover clock starts here."""
+        if self.proc.is_alive():
+            os.kill(self.proc.pid, 9)
+        self.proc.join(timeout=5.0)
+
+    def request_stop(self) -> None:
+        """Phase one of shutdown: ask the child to flush and exit. The
+        caller must keep draining the shared result queue until the child
+        exits — its final stats message can be larger than the pipe buffer,
+        and an undrained pipe wedges the child's queue feeder thread,
+        turning a clean exit into a join timeout."""
+        if self.proc.is_alive():
+            self._in_q.put(("stop",))
+
+    def finish_stop(self, timeout_s: float = 10.0) -> None:
+        self.proc.join(timeout=timeout_s)
+        if self.proc.is_alive():
+            self.proc.terminate()
+            self.proc.join(timeout=5.0)
+
+    def stop(self) -> None:
+        self.request_stop()
+        self.finish_stop(timeout_s=30.0)
+
+    def stage_states(self) -> dict:
+        # Shipped via the ("stats", …) message at stop; the supervisor
+        # stores it here when it drains the message.
+        return getattr(self, "_final_stage_states", {})
+
+    def stats(self) -> dict:
+        return {"workerId": self.worker_id, "alive": self.alive,
+                "kind": "process", "workspaces": len(self.shard),
+                "delivered": self.delivered, "acked": None,
+                "unacked": None, "fencedRecords": None}

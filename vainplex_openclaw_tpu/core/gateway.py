@@ -103,7 +103,12 @@ class Gateway:
         self.methods: dict[str, Callable[..., Any]] = {}
         self.tools: dict[str, dict] = {}
         # Observability registry (ISSUE 6): every serving edge publishes its
-        # StageTimer here so sitrep/SLO surfaces read one place.
+        # StageTimer here so sitrep/SLO surfaces read one place. In cluster
+        # mode (ISSUE 9) every key is prefixed with the worker's id so the
+        # supervisor's merged view can tell which worker's governance edge a
+        # quantile belongs to — and strip the prefix to merge across workers.
+        self.worker_prefix = str(
+            ((self.config.get("cluster") or {}).get("workerPrefix")) or "")
         self.stage_timers: dict[str, Any] = {}
         # Journal registry (ISSUE 7): plugins publish their (shared)
         # group-commit journals; get_status() exports pending/group/fsync/
@@ -151,7 +156,7 @@ class Gateway:
         self.tools[tool["name"]] = tool
 
     def _register_stage_timer(self, plugin_id: str, name: str, timer: Any) -> None:
-        self.stage_timers[name] = timer
+        self.stage_timers[self.worker_prefix + name] = timer
 
     def _register_journal(self, plugin_id: str, name: str, journal: Any) -> None:
         self.journals[name] = journal

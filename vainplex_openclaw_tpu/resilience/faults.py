@@ -3,8 +3,12 @@
 Recovery code that is only exercised when production actually breaks is
 hoped-for, not tested. This registry turns every interesting I/O edge into a
 named *fault site* — ``"transport.publish"``, ``"audit.append"``,
-``"file.rename"``, ``"checkpoint.rename"``, … — that consults the installed
-:class:`FaultPlan` before doing the real work. A plan decides failures from
+``"file.rename"``, ``"checkpoint.rename"``, and the cluster sites
+(ISSUE 9: ``"cluster.worker.crash"`` kills a worker at a seeded delivery
+step, ``"cluster.heartbeat"`` loses a liveness probe (partition),
+``"cluster.route"`` fails a dispatch, ``"cluster.recover"`` /
+``"cluster.lease"`` fault the failover path itself) — that consults the
+installed :class:`FaultPlan` before doing the real work. A plan decides failures from
 ``(seed, site, per-site call index)`` only, so a chaos run is bit-reproducible:
 same seed → same faults on the same calls, regardless of interleaving across
 sites.

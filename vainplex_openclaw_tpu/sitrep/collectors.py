@@ -259,6 +259,57 @@ def collect_journal(config: dict, ctx: dict) -> dict:
                         f"{total_pending} records in flight")}
 
 
+def collect_cluster(config: dict, ctx: dict) -> dict:
+    """Sharded-gateway health (ISSUE 9): membership, per-worker liveness/
+    breaker state/heartbeat misses, lease epochs, and the last failover
+    (duration, workspaces moved, replayed records, redeliveries). Warns on
+    any fencing rejection (a zombie tried to write — the fence held, but an
+    operator should know a partitioned worker is still running) and on any
+    worker not closed (dead, OR a breaker half-open/open: a worker being
+    probed is a current condition, not history)."""
+    status_fn = ctx.get("cluster_status")
+    if status_fn is None:
+        return {"status": "skipped", "items": [],
+                "summary": "no cluster wired (single-process gateway)"}
+    s = status_fn()
+    workers = s.get("workers") or {}
+    membership = s.get("membership") or {}
+    dead = membership.get("dead") or []
+    fenced = s.get("fencedRecords") or 0
+    unhealthy = [wid for wid, row in workers.items()
+                 if (row.get("breaker") or {}).get("state", "closed")
+                 != "closed"]
+    last = s.get("lastFailover")
+    worries = []
+    if fenced:
+        worries.append(f"fencedRecords={fenced}")
+    for wid in unhealthy:
+        worries.append(
+            f"{wid}.breaker={(workers[wid].get('breaker') or {}).get('state')}")
+    if dead:
+        worries.append(f"dead={dead}")
+    epochs = {ws: lease.get("epoch")
+              for ws, lease in (s.get("leases") or {}).items()}
+    items = [{"membership": membership, "workers": workers,
+              "leaseEpochs": epochs, "lastFailover": last,
+              "routed": s.get("routed"), "redelivered": s.get("redelivered"),
+              "routeFaults": s.get("routeFaults"),
+              "inflight": s.get("inflight"),
+              "fencedRecords": fenced, "routeLog": s.get("routeLog")}]
+    live = membership.get("live") or []
+    summary = (f"{len(live)} live / {len(dead)} dead workers, "
+               f"{len(epochs)} leases, routed={s.get('routed', 0)}")
+    if last:
+        summary += (f", last failover: {last.get('worker')} "
+                    f"({last.get('workspacesMoved')} ws, "
+                    f"{last.get('replayedRecords')} replayed, "
+                    f"{last.get('durationMs')}ms)")
+    if worries:
+        summary += " — " + ", ".join(worries)
+    return {"status": "warn" if worries else "ok", "items": items,
+            "summary": summary}
+
+
 def collect_slo(config: dict, ctx: dict) -> dict:
     """SLO-threshold rollup: p99 budgets (ms) from config against live
     stage quantiles. Keys: ``"edge:stage"`` beats ``"edge"`` beats
@@ -339,6 +390,7 @@ BUILTIN_COLLECTORS: dict[str, Callable] = {
     "stage_quantiles": collect_stage_quantiles,
     "resilience": collect_resilience,
     "journal": collect_journal,
+    "cluster": collect_cluster,
     "slo": collect_slo,
     "pattern_safety": collect_pattern_safety,
 }

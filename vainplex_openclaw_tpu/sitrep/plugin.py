@@ -28,6 +28,9 @@ DEFAULTS = {
         "stage_quantiles": {"enabled": True},
         "resilience": {"enabled": True},
         "journal": {"enabled": True},
+        # Sharded-gateway health (ISSUE 9): skipped unless a cluster
+        # supervisor registered ``cluster.status`` on this gateway.
+        "cluster": {"enabled": True},
         "slo": {"enabled": True},
         # ReDoS screening rollup (ISSUE 8): reads governance status only.
         "pattern_safety": {"enabled": True},
@@ -39,7 +42,7 @@ DEFAULTS = {
 # config says — the live dashboard must not go dark because an operator
 # trimmed the periodic report.
 OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "journal",
-                  "slo", "pattern_safety")
+                  "cluster", "slo", "pattern_safety")
 
 MANIFEST = PluginManifest(
     id="sitrep",
@@ -120,6 +123,9 @@ class SitrepPlugin:
         if "cortex.patternSafety" in gw.methods:
             ctx["cortex_pattern_safety"] = (
                 lambda: gw.call_method("cortex.patternSafety"))
+        if "cluster.status" in gw.methods:
+            # Registered by ClusterSupervisor.attach_gateway (ISSUE 9).
+            ctx["cluster_status"] = lambda: gw.call_method("cluster.status")
         # Ops plane (ISSUE 6): gateway degradation surface (through the
         # public PluginApi view) + every registered StageTimer,
         # snapshotted once per report generation — the stage_quantiles
@@ -210,6 +216,10 @@ class SitrepPlugin:
         res = results.get("resilience", {})
         lines.append(f"  {icon.get(res.get('status'), '•')} resilience: "
                      f"{res.get('summary', 'n/a')}")
+        cl = results.get("cluster", {})
+        if cl.get("status") != "skipped":
+            lines.append(f"  {icon.get(cl.get('status'), '•')} cluster: "
+                         f"{cl.get('summary', 'n/a')}")
         slo = results.get("slo", {})
         lines.append(f"  {icon.get(slo.get('status'), '•')} slo: "
                      f"{slo.get('summary', 'n/a')}")

@@ -105,20 +105,12 @@ def _tenant_ctx(root: Path, tenant: int) -> dict:
 
 
 def _dispatch(gw, op, ctx) -> dict:
-    """Run one op through the gateway; returns verdict-path observations."""
-    if op.kind == "msg_in":
-        gw.message_received(op.content, ctx)
-        return {}
-    if op.kind == "msg_out":
-        gw.message_sent(op.content, ctx)
-        return {}
-    if op.kind == "tool_ok" or op.kind == "tool_denied":
-        decision, _ = gw.run_tool("read", {"path": op.content},
-                                  lambda p: f"contents of {op.content}", ctx)
-        return {"decision": decision}
-    # tool_secret: result must come back redacted (NEVER_SHED path)
-    out = gw.tool_result_persist("exec", op.content, ctx)
-    return {"redacted": isinstance(out, str) and "[REDACTED" in out}
+    """Run one op through the gateway; returns verdict-path observations.
+    Delegates to the cluster's shared op dispatcher (ISSUE 9) so the
+    single-process and sharded paths execute the identical pipeline."""
+    from ..cluster.worker import dispatch_op
+
+    return dispatch_op(gw, op.kind, op.content, ctx)
 
 
 def _normalize_edge(name: str, root: Path) -> str:
@@ -152,9 +144,17 @@ def _calibrate(ops, tenants: int, watermark: int) -> float:
 
 def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
                    saturation: float = 1.0, mode: str = "wall",
-                   admission: bool = True, watermark: int = 32) -> dict:
+                   admission: bool = True, watermark: int = 32,
+                   workers: int = 0) -> dict:
     """The ``bench.py slo_report`` entry point. Returns one JSON-ready
-    record; see module docstring for the wall/sim contract."""
+    record; see module docstring for the wall/sim contract.
+
+    ``workers > 0`` (ISSUE 9) runs the SAME workload through a
+    workspace-sharded cluster of in-process workers instead of one gateway:
+    per-worker stage timers are merged bucket-wise (not just the
+    supervisor's process — the satellite fix), and the report gains a
+    ``cluster`` section with membership/lease/failover state. Wall mode
+    only: the cluster path has no virtual-clock service model."""
     from .workload import generate_workload, workload_digest
 
     if mode not in ("wall", "sim"):
@@ -165,6 +165,11 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
         raise ValueError(f"saturation must be > 0, got {saturation}")
     if tenants < 1:
         raise ValueError(f"tenants must be >= 1, got {tenants}")
+    if workers:
+        if mode != "wall":
+            raise ValueError("workers mode requires mode='wall'")
+        return _run_cluster_report(seed, n_ops, tenants, saturation,
+                                   int(workers), watermark)
     ops = generate_workload(seed, n_ops, tenants)
     digest = workload_digest(ops)
 
@@ -316,12 +321,135 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
     return report
 
 
+def _run_cluster_report(seed: int, n_ops: int, tenants: int,
+                        saturation: float, workers: int,
+                        watermark: int) -> dict:
+    """The ``workers > 0`` branch: same seeded workload, offered open-loop
+    at ``saturation`` × single-process capacity, routed through a real
+    :class:`..cluster.ClusterSupervisor` over in-process workers. Verdict
+    accounting keys by op index so an op redelivered after a failover
+    counts once, with its final observation."""
+    from ..cluster import ClusterSupervisor
+    from .workload import generate_workload, workload_digest
+
+    ops = generate_workload(seed, n_ops, tenants)
+    digest = workload_digest(ops)
+    capacity = _calibrate(ops, tenants, watermark)
+    rate = capacity * saturation
+
+    e2e = StageTimer()
+    expected_denials = sum(1 for op in ops if op.kind == "tool_denied")
+    expected_redactions = sum(1 for op in ops if op.kind == "tool_secret")
+    results: dict[int, dict] = {}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = Path(tmp)
+        sup = ClusterSupervisor(
+            root, {"workers": workers}, wall_timers=True,
+            on_result=lambda op, obs: results.__setitem__(op.get("i"), obs))
+        # Supervisor-side gateway: hosts sitrep so /ops renders the cluster
+        # collector exactly as a deployment would see it.
+        from ..core import Gateway
+        from ..sitrep import SitrepPlugin
+
+        gw = Gateway(config={"workspace": str(root)})
+        sitrep = SitrepPlugin(workspace=str(root), wall_timers=False)
+        gw.load(sitrep, plugin_config={"intervalMinutes": 0})
+        sup.attach_gateway(gw)
+        gw.start()
+
+        arrivals = [op.arrival / rate for op in ops]
+        t0 = time.perf_counter()
+        for i, op in enumerate(ops):
+            sched = t0 + arrivals[i]
+            now = time.perf_counter()
+            while now < sched:
+                time.sleep(min(sched - now, 0.0005))
+                now = time.perf_counter()
+            sup.submit({"i": op.index, "ws": str(root / f"tenant{op.tenant}"),
+                        "wsKey": f"tenant{op.tenant}", "kind": op.kind,
+                        "content": op.content})
+            lat_ms = (time.perf_counter() - sched) * 1000.0
+            e2e.add("e2e", lat_ms)
+            e2e.add(f"kind:{op.kind}", lat_ms)
+            if i % 50 == 0:
+                sup.tick()
+        sup.drain()
+        elapsed = time.perf_counter() - t0
+
+        observed_denials = observed_redactions = false_blocks = 0
+        for op in ops:
+            obs = results.get(op.index, {})
+            observed_denials += _denied(obs, op)
+            observed_redactions += _redacted(obs)
+            false_blocks += _false_block(obs, op)
+
+        edge_snaps = {_normalize_edge(name, root): snap
+                      for name, snap in sup.stage_snapshots(qs=_QS).items()}
+        hook_stats: dict[str, dict] = {}
+        for state in sup.workers().values():
+            for hook, st in state.handle.gw.get_status()["hooks"].items():
+                row = hook_stats.setdefault(
+                    hook, {"fired": 0, "errors": 0, "skipped": 0})
+                for k in row:
+                    row[k] += st.get(k, 0)
+
+        sitrep_report = sitrep.generate()
+        cluster_stats = sup.stats()
+        cluster_stats["leases"] = {
+            _normalize_edge(ws, root): lease
+            for ws, lease in cluster_stats["leases"].items()}
+        sup.stop()
+        gw.stop()
+
+    e2e_snap = e2e.snapshot(qs=_QS)
+    e2e_q = e2e_snap["quantiles"]
+    return {
+        "metric": "slo_report",
+        "seed": seed,
+        "mode": "wall",
+        "workers": workers,
+        "saturation": saturation,
+        "tenants": tenants,
+        "admission": {"enabled": False,
+                      "note": "cluster mode: per-worker gateways, no "
+                              "supervisor-side admission yet"},
+        "capacity_ops_s": round(capacity, 1),
+        "offered_ops_s": round(rate, 1),
+        "workload": digest,
+        "verdicts": {
+            "expected_denials": expected_denials,
+            "observed_denials": observed_denials,
+            "expected_redactions": expected_redactions,
+            "observed_redactions": observed_redactions,
+            "false_blocks": false_blocks,
+            "losses": (expected_denials - observed_denials)
+                      + (expected_redactions - observed_redactions),
+        },
+        "e2e": {"count": e2e_snap["counts"].get("e2e", 0),
+                **{k: v for k, v in e2e_q.get("e2e", {}).items()},
+                "byKind": {k.split(":", 1)[1]: q
+                           for k, q in sorted(e2e_q.items())
+                           if k.startswith("kind:")}},
+        "stage_counts": {edge: snap["counts"]
+                         for edge, snap in sorted(edge_snaps.items())},
+        "stages": {edge: snap["quantiles"]
+                   for edge, snap in sorted(edge_snaps.items())},
+        "hook_stats": dict(sorted(hook_stats.items())),
+        "cluster": cluster_stats,
+        "sitrep": {"health": sitrep_report["health"],
+                   "cluster": ((sitrep_report["collectors"].get("cluster")
+                                or {}).get("summary"))},
+        "elapsed_s": round(elapsed, 3),
+        "throughput_ops_s": round(len(ops) / max(elapsed, 1e-9), 1),
+    }
+
+
 def _denied(obs: dict, op) -> int:
     """Counts only denials of ops that EXPECT one — a false block of a
     tool_ok op must surface as false_blocks, not inflate observed_denials
     (compensating errors would zero out the losses gate)."""
-    d = obs.get("decision")
-    return 1 if (op.kind == "tool_denied" and d is not None and d.blocked) else 0
+    return 1 if (op.kind == "tool_denied" and obs.get("blocked") is True) else 0
 
 
 def _redacted(obs: dict) -> int:
@@ -329,8 +457,7 @@ def _redacted(obs: dict) -> int:
 
 
 def _false_block(obs: dict, op) -> int:
-    d = obs.get("decision")
-    return 1 if (op.kind == "tool_ok" and d is not None and d.blocked) else 0
+    return 1 if (op.kind == "tool_ok" and obs.get("blocked") is True) else 0
 
 
 def slo_stage_records(report: dict) -> list:

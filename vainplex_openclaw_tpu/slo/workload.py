@@ -134,10 +134,17 @@ def _message(rng: random.Random, lang: str, i: int) -> str:
 
 
 def generate_workload(seed: int = 0, n_ops: int = 2000,
-                      tenants: int = 4) -> list:
-    """Deterministic op list, sorted by unit-rate arrival time."""
+                      tenants: int = 4, uniform_tenants: bool = False) -> list:
+    """Deterministic op list, sorted by unit-rate arrival time.
+
+    ``uniform_tenants`` flattens the zipf tenant skew (the cluster scaling
+    bench uses it: with many uniform workspaces, measured efficiency
+    attributes to ring balance and routing overhead rather than to one
+    deliberately-heavy tenant that no sharding could split). Draw count is
+    identical either way, so default workloads are byte-for-byte unchanged."""
     rng = random.Random(f"slo:{seed}")
-    weights = [1.0 / (i + 1) ** 1.1 for i in range(tenants)]  # skewed
+    weights = ([1.0] * tenants if uniform_tenants
+               else [1.0 / (i + 1) ** 1.1 for i in range(tenants)])  # skewed
     total_w = sum(weights)
     ops: list[Op] = []
     t = 0.0

@@ -74,6 +74,15 @@ DEFAULT_JOURNAL_SETTINGS = {
 _META_NAME = "journal.meta.json"
 
 
+class FencedWriteError(OSError):
+    """A write stamped with a stale lease epoch (ISSUE 9). Subclasses
+    OSError so owner persist paths treat it like any other failed write —
+    state stays dirty/retrying — but, critically, ``append()`` RAISES it
+    rather than returning False: False means "journal closed, use your
+    legacy path", and routing a fenced zombie into the legacy atomic-rename
+    write would reopen the exact split-brain window the fence closes."""
+
+
 def journal_settings(config: Optional[dict],
                      default_enabled: bool = True) -> dict:
     """Resolve a plugin config's ``storage.journal`` section (bool or dict)
@@ -246,6 +255,13 @@ class Journal:
         self._wal_bytes = 0
         self._wal_tail_dirty = False
         self._meta_dirty = False
+        # Lease fencing (ISSUE 9): None outside cluster mode — the check is
+        # a single attribute read on the commit path, zero cost for every
+        # single-process consumer. ``set_fence`` arms it.
+        self.fence_path: Optional[Path] = None
+        self.fence_epoch: Optional[int] = None
+        self.fence_rejected = 0
+        self._fenced = False
         self._open()
         _LIVE_JOURNALS.add(self)
 
@@ -305,6 +321,42 @@ class Journal:
             self._wal_bytes = path.stat().st_size
         except OSError:
             self._wal_bytes = 0
+
+    # ── lease fencing (ISSUE 9) ──────────────────────────────────────
+
+    def set_fence(self, path: str | Path, epoch: int) -> None:
+        """Arm epoch fencing: this journal instance writes on behalf of
+        lease ``epoch``; ``path`` is the workspace's fence file, rewritten
+        (atomically, durably) by the cluster supervisor each time ownership
+        moves. Every commit re-reads it BEFORE touching the wal, so a
+        zombie owner — a worker the supervisor failed over away from but
+        that is still running — has its batches dropped-and-counted at the
+        journal boundary instead of interleaving writes with the new
+        owner's. The check is commit-time, not append-time: appends only
+        buffer, and the commit is the instant a record would otherwise
+        become durable."""
+        with self._commit_lock:
+            self.fence_path = Path(path)
+            self.fence_epoch = int(epoch)
+            self._fenced = False
+
+    def _fence_ok(self) -> bool:
+        """Commit-lock held. True while this instance's epoch is current.
+        A missing/unreadable fence file reads as "no newer owner": the
+        supervisor writes the fence before the new owner opens the
+        workspace, so absence means ownership never moved."""
+        if self.fence_epoch is None:
+            return True
+        current = read_json(self.fence_path, None)
+        if not isinstance(current, dict):
+            return True
+        try:
+            return int(current.get("epoch", 0)) <= self.fence_epoch
+        except (TypeError, ValueError):
+            return True
+
+    def fenced(self) -> bool:
+        return self._fenced
 
     # ── stream registration ──────────────────────────────────────────
 
@@ -389,6 +441,12 @@ class Journal:
         still holds."""
         if self._closed:
             return False  # callers fall back to their legacy write path
+        if self._fenced:
+            # Torn-tolerant scalar read; the authoritative check ran under
+            # the commit lock. Raising (not returning False) keeps the
+            # caller OFF its legacy write path — see FencedWriteError.
+            raise FencedWriteError(
+                f"journal fenced: lease epoch {self.fence_epoch} is stale")
         st = self._streams[name]
         pc = time.perf_counter
         t0 = pc()
@@ -499,6 +557,23 @@ class Journal:
             # records stay buffered for callers' legacy fallbacks.
             if self._closed:
                 return False
+            if self.fence_epoch is not None and not self._fence_ok():
+                # Ownership moved while records sat in the buffer: drop the
+                # whole batch, counted, and latch — nothing stamped with
+                # this instance's stale epoch may ever reach the wal or the
+                # legacy files (the new owner already replayed/owns both).
+                self._fenced = True
+                drained = self._drain_pending()
+                dropped = sum(1 if st.kind == "snapshot" else len(recs)
+                              for st, recs in drained)
+                self.fence_rejected += dropped
+                self.last_error = (f"fenced: {dropped} stale-epoch record(s) "
+                                   f"rejected at commit")
+                if self.logger is not None:
+                    self.logger.warn(
+                        f"journal FENCED (epoch {self.fence_epoch} stale): "
+                        f"{dropped} record(s) rejected, writes disabled")
+                return False
             drained = self._drain_pending()
             if not drained:
                 return True
@@ -595,6 +670,12 @@ class Journal:
         ok = True
         pc = time.perf_counter
         with self._commit_lock:
+            if self._fenced:
+                # A fenced instance must not touch the legacy files either:
+                # its committed-but-uncompacted records were already
+                # replayed by the new owner at open, and compacting them
+                # here would race the new owner's own compactions.
+                return False
             for st in targets:
                 if st.kind == "snapshot":
                     if st.unc is None:
@@ -770,10 +851,14 @@ class Journal:
             # A deleted workspace (TemporaryDirectory cleanup beat us to it)
             # must not be resurrected by a final compaction/meta write —
             # there is nothing left worth persisting into.
-            if self.root.exists():
+            if self.root.exists() and not self._fenced:
+                # A fenced instance skips the farewell compaction AND the
+                # meta write: the new owner holds both files now. The
+                # fence may also be DISCOVERED by this very compaction's
+                # commit — hence the re-check before touching meta.
                 self.compact()
                 with self._commit_lock:
-                    if self._meta_dirty:
+                    if self._meta_dirty and not self._fenced:
                         self._write_meta()
         finally:
             self._closed = True
@@ -790,6 +875,39 @@ class Journal:
                 except OSError:
                     pass
             _LIVE_JOURNALS.discard(self)
+
+    def drop_pending(self) -> int:
+        """Discard every buffered (uncommitted) record WITHOUT committing —
+        the cluster takeover barrier (ISSUE 9). A partition-style failover
+        leaves the old owner's un-acked effects in this buffer; the
+        supervisor redelivers those ops to the new owner, so committing
+        them at takeover would double-apply. Committed records are not
+        touched (compact them after). Returns the number discarded."""
+        with self._commit_lock:
+            drained = self._drain_pending()
+            return sum(1 if st.kind == "snapshot" else len(recs)
+                       for st, recs in drained)
+
+    def abandon(self) -> None:
+        """Simulate process death (cluster failover tests, ISSUE 9): drop
+        every buffered record, release the wal fd, write NOTHING — no final
+        commit, no compaction, no meta. What the next opener recovers is
+        exactly what a kill -9 would have left: the committed wal prefix.
+        The registry treats an abandoned journal as closed, so the next
+        ``get_journal`` on the workspace opens a fresh instance and replays."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._buffer_lock:
+            if self._timer_handle is not None:
+                self._timer_handle.cancel()
+                self._timer_handle = None
+        with self._commit_lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        _LIVE_JOURNALS.discard(self)
 
     def stats(self) -> dict:
         with self._buffer_lock:
@@ -827,6 +945,9 @@ class Journal:
             "compactionFailures": sum(s["compactionFailures"]
                                       for s in streams.values()),
             "rotations": self.rotations,
+            "fenced": self._fenced,
+            "fenceEpoch": self.fence_epoch,
+            "fencedRecords": self.fence_rejected,
             "walBytes": self._wal_bytes,
             "segment": self._gen,
             "lastError": self.last_error,

@@ -192,6 +192,34 @@ class StageTimer:
             hists = {k: list(h) for k, h in self._hist.items()}
         return {k: estimate_quantiles(h, qs, precision) for k, h in hists.items()}
 
+    def state(self) -> dict:
+        """Mergeable (and picklable) raw state: accumulated ms, counts and
+        the log2 histograms themselves. This is the cross-worker aggregation
+        seam (ISSUE 9): a cluster worker ships ``state()`` over a pipe and
+        the supervisor ``absorb()``s it — histograms add bucket-wise, so the
+        merged quantiles are exactly what one timer observing all workers'
+        samples would have estimated (unlike merging the already-estimated
+        per-worker quantiles, which has no defensible semantics)."""
+        with self._lock:
+            return {"ms": dict(self._ms), "counts": dict(self._counts),
+                    "hist": {k: list(h) for k, h in self._hist.items()}}
+
+    def absorb(self, state: dict) -> None:
+        """Merge another timer's ``state()`` into this one (bucket-wise)."""
+        ms, counts, hist = state["ms"], state["counts"], state["hist"]
+        with self._lock:
+            for k, v in ms.items():
+                self._ms[k] = self._ms.get(k, 0.0) + v
+            for k, v in counts.items():
+                self._counts[k] = self._counts.get(k, 0) + v
+            for k, h in hist.items():
+                mine = self._hist.get(k)
+                if mine is None:
+                    self._hist[k] = list(h)
+                else:
+                    for i, c in enumerate(h):
+                        mine[i] += c
+
     def snapshot(self, precision: int = 2,
                  qs: Iterable[float] = DEFAULT_QUANTILES) -> dict:
         """Consistent one-lock view for status surfaces:
