@@ -73,12 +73,24 @@ def bench_trace_analyzer(n_chains: int = 400) -> dict:
         TraceAnalyzer({"languages": ["en", "de"]}, tmp, list_logger(),
                       source=MemoryTraceSource(raws)).run()
 
+    # RetraceWitness (ISSUE 10): the warmup run above compiled every jit
+    # bucket this corpus touches; the measured run must compile ZERO new
+    # programs — a retrace here is one-time XLA cost billed as throughput.
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+    from vainplex_openclaw_tpu.ops.similarity import TRACE_COUNTS
+
+    witness = RetraceWitness()
+    witness.attach_counter("jaccard", lambda: TRACE_COUNTS["jaccard"])
+    witness.attach_counter("levenshtein", lambda: TRACE_COUNTS["levenshtein"])
+    witness.baseline()
+
     with tempfile.TemporaryDirectory() as tmp:
         analyzer = TraceAnalyzer({"languages": ["en", "de"]}, tmp, list_logger(),
                                  source=MemoryTraceSource(raws))
         t0 = time.perf_counter()
         report = analyzer.run()
         dt = time.perf_counter() - t0
+    witness.assert_no_retrace()
 
     stats = report["runStats"]
     assert stats["events"] == len(raws), "pipeline must process every event"
@@ -93,6 +105,7 @@ def bench_trace_analyzer(n_chains: int = 400) -> dict:
         "value": round(events_per_minute, 0),
         "unit": "events/min",
         "vs_baseline": round(events_per_minute / baseline, 1),
+        "retraces": 0,  # witnessed: assert_no_retrace above
         "stage_ms": stage_ms,
     }
 
@@ -163,12 +176,20 @@ def bench_knowledge_search(n_facts: int = 256, n_queries: int = 32,
     emb.sync(facts)  # pays model restore + bucket compile once
     for i in range(4):  # warm the query-bucket (batch-1) compile
         emb.search(f"warmup question {i}", k=k)
+    # RetraceWitness (ISSUE 10): every timed query is batch-1 — the warm
+    # bucket — so the measured loop must trace zero new programs.
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+
+    witness = RetraceWitness()
+    witness.attach_counter("embed_forward", lambda: emb.trace_count)
+    witness.baseline()
     queries = [f"which service emits deploy signal {i}" for i in range(n_queries)]
     t0 = time.perf_counter()
     for q in queries:
         results = emb.search(q, k=k)
     dt_ms = (time.perf_counter() - t0) * 1000.0 / n_queries
     assert results, "warm index must return results"
+    witness.assert_no_retrace("embed_forward")
     t0 = time.perf_counter()
     for q in queries:  # same queries again: LRU hits, no embed
         emb.search(q, k=k)
@@ -177,6 +198,7 @@ def bench_knowledge_search(n_facts: int = 256, n_queries: int = 32,
             "unit": "ms",
             "vs_baseline": round(KNOWLEDGE_SEARCH_BASELINE_MS / dt_ms, 2),
             "cached_ms": round(cached_ms, 3), "index_size": emb.count(),
+            "retraces": 0,  # witnessed: assert_no_retrace above
             "stage_ms": emb.timer.stages_ms()}
 
 
@@ -988,9 +1010,17 @@ def _timed_encoder_scan(cfg, batch: int, steps: int,
         return final
 
     jax.block_until_ready(run(stacked))  # compile + warmup
+    # RetraceWitness (ISSUE 10): the measured call is shape-identical to
+    # the warmup — a retrace here bills a full XLA compile as throughput.
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+
+    witness = RetraceWitness()
+    witness.probe("encoder_scan", run)
+    witness.baseline()
     t0 = time.perf_counter()
     jax.block_until_ready(run(stacked))
     dt = time.perf_counter() - t0
+    witness.assert_no_retrace("encoder_scan")
     return dt / steps
 
 

@@ -1,0 +1,700 @@
+"""tracelint suite (ISSUE 10): fixture corpus pinning every JAX-rule
+verdict (GL-TRACE-*, GL-RETRACE-*, GL-SHARD-*), the RetraceWitness, and
+the JIT_TABLE contract itself.
+
+Same discipline as the graftlint corpus (tests/test_analysis_lint.py):
+each rule family gets a known-good and a known-bad snippet, so a refactor
+that blinds a pass — or one that starts flagging idioms the repo depends
+on — fails here before it reaches the CI gate. Regression pins for the
+REAL findings the first repo-wide run surfaced live in
+test_analysis_lint.py::TestJaxRegressionsFromLint.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from vainplex_openclaw_tpu.analysis import retrace, sharding, tracing
+from vainplex_openclaw_tpu.analysis.jit_table import JIT_TABLE, JitEntry
+from vainplex_openclaw_tpu.analysis.witness import RetraceWitness
+
+REPO_ROOT = __import__("pathlib").Path(__file__).resolve().parent.parent
+
+ENTRY = JitEntry(module="fixture.py", jit_fns=("f",), static=("cfg",))
+
+
+def fixture(body: str) -> str:
+    return textwrap.dedent(body)
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ── GL-TRACE-* fixture corpus ────────────────────────────────────────
+
+
+class TestTraceHostsync:
+    def test_float_on_traced_flagged(self):
+        src = fixture("""
+            def f(x, cfg):
+                return float(x) * 2
+            """)
+        assert rules_of(tracing.check_source(src, "fixture.py", [ENTRY])) \
+            == ["GL-TRACE-HOSTSYNC"]
+
+    def test_item_and_tolist_flagged(self):
+        src = fixture("""
+            def f(x, cfg):
+                a = x.sum()
+                return a.item(), x.tolist()
+            """)
+        found = tracing.check_source(src, "fixture.py", [ENTRY])
+        assert rules_of(found) == ["GL-TRACE-HOSTSYNC"] * 2
+
+    def test_np_asarray_on_traced_flagged(self):
+        src = fixture("""
+            import numpy as np
+            def f(x, cfg):
+                return np.asarray(x)
+            """)
+        found = tracing.check_source(src, "fixture.py", [ENTRY])
+        assert rules_of(found) == ["GL-TRACE-HOSTSYNC"]
+
+    def test_shape_derived_int_clean(self):
+        # .shape is static under jit — int(x.shape[0]) is legal
+        src = fixture("""
+            def f(x, cfg):
+                n = int(x.shape[0])
+                return x * n
+            """)
+        assert tracing.check_source(src, "fixture.py", [ENTRY]) == []
+
+    def test_float_on_static_clean(self):
+        src = fixture("""
+            def f(x, cfg):
+                return x * float(cfg.scale)
+            """)
+        assert tracing.check_source(src, "fixture.py", [ENTRY]) == []
+
+
+class TestTraceControlflow:
+    def test_if_on_traced_flagged(self):
+        src = fixture("""
+            def f(x, cfg):
+                if x > 0:
+                    return x
+                return -x
+            """)
+        assert rules_of(tracing.check_source(src, "fixture.py", [ENTRY])) \
+            == ["GL-TRACE-CONTROLFLOW"]
+
+    def test_while_and_assert_flagged(self):
+        src = fixture("""
+            def f(x, cfg):
+                while x.sum() > 1:
+                    x = x * 0.5
+                assert x.min() >= 0
+                return x
+            """)
+        found = tracing.check_source(src, "fixture.py", [ENTRY])
+        assert rules_of(found) == ["GL-TRACE-CONTROLFLOW"] * 2
+
+    def test_if_on_static_clean(self):
+        src = fixture("""
+            def f(x, cfg):
+                if cfg.scan_blocks:
+                    return x * 2
+                return x
+            """)
+        assert tracing.check_source(src, "fixture.py", [ENTRY]) == []
+
+    def test_is_none_and_membership_clean(self):
+        # pytree-structure checks, legal on traced containers
+        src = fixture("""
+            def f(params, cfg):
+                if params is None:
+                    return 0
+                if "moe" in params:
+                    return params["moe"]
+                return params["mlp"]
+            """)
+        entry = JitEntry(module="fixture.py", jit_fns=("f",), static=("cfg",))
+        assert tracing.check_source(src, "fixture.py", [entry]) == []
+
+    def test_pytree_loop_clean(self):
+        # iterating a pytree's structure is not value-dependent control flow
+        src = fixture("""
+            def f(params, cfg):
+                acc = 0
+                for p in params["blocks"]:
+                    acc = acc + p["w"]
+                return acc
+            """)
+        assert tracing.check_source(src, "fixture.py", [ENTRY]) == []
+
+
+class TestTraceImpure:
+    def test_time_and_np_random_flagged(self):
+        src = fixture("""
+            import time
+            import numpy as np
+            def f(x, cfg):
+                t = time.time()
+                noise = np.random.rand(4)
+                return x + t + noise
+            """)
+        found = tracing.check_source(src, "fixture.py", [ENTRY])
+        assert rules_of(found) == ["GL-TRACE-IMPURE"] * 2
+
+    def test_jax_random_clean(self):
+        src = fixture("""
+            import jax
+            def f(key, cfg):
+                return jax.random.normal(key, (4,))
+            """)
+        assert tracing.check_source(src, "fixture.py", [ENTRY]) == []
+
+    def test_trace_counter_bump_clean(self):
+        # the deliberate TRACE_COUNTS idiom must not read as impure
+        src = fixture("""
+            TRACE_COUNTS = {"f": 0}
+            def f(x, cfg):
+                TRACE_COUNTS["f"] += 1
+                return x * 2
+            """)
+        assert tracing.check_source(src, "fixture.py", [ENTRY]) == []
+
+
+class TestTraceTableGuard:
+    def test_unresolved_jit_fn_is_a_finding(self):
+        """Analyzer-goes-blind guard: a table row naming a vanished
+        function must surface, not silently scan nothing."""
+        entry = JitEntry(module="fixture.py", jit_fns=("vanished_fn",))
+        found = tracing.check_source("def other():\n    pass\n",
+                                     "fixture.py", [entry])
+        assert rules_of(found) == ["GL-TRACE-TABLE"]
+
+    def test_call_graph_expansion_reaches_helpers(self):
+        # a helper only reachable from the jitted root is still scanned
+        src = fixture("""
+            def helper(x):
+                return float(x)
+            def f(x, cfg):
+                return helper(x)
+            """)
+        found = tracing.check_source(src, "fixture.py", [ENTRY])
+        assert rules_of(found) == ["GL-TRACE-HOSTSYNC"]
+        assert "helper" in found[0].message
+
+    def test_nested_lazy_builder_function_resolves(self):
+        # dotted roots under an `if _jit is None:` guard must resolve
+        src = fixture("""
+            _jit = None
+            def build():
+                global _jit
+                if _jit is None:
+                    def inner(x):
+                        return bool(x)
+                    _jit = inner
+                return _jit
+            """)
+        entry = JitEntry(module="fixture.py", jit_fns=("build.inner",))
+        found = tracing.check_source(src, "fixture.py", [entry])
+        assert rules_of(found) == ["GL-TRACE-HOSTSYNC"]
+
+
+# ── GL-RETRACE-* fixture corpus ──────────────────────────────────────
+
+
+def _fake_repo(tmp_path, source: str, name: str = "mod.py"):
+    pkg = tmp_path / "vainplex_openclaw_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / name).write_text(fixture(source))
+    return f"vainplex_openclaw_tpu/{name}"
+
+
+class TestRetraceConstruction:
+    def test_jit_in_plain_function_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            import jax
+            def serve(x):
+                fn = jax.jit(lambda a: a * 2)
+                return fn(x)
+            """)
+        table = (JitEntry(module=rel, jit_fns=()),)
+        found = retrace.check_jit_construction(tmp_path, table)
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+        assert "serve" in found[0].message
+
+    def test_partial_shard_map_decorator_in_function_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            from functools import partial
+            from jax import shard_map
+            def apply(params, x, mesh):
+                @partial(shard_map, mesh=mesh)
+                def run(p, x):
+                    return x
+                return run(params, x)
+            """)
+        table = (JitEntry(module=rel, jit_fns=()),)
+        found = retrace.check_jit_construction(tmp_path, table)
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+
+    def test_bare_jit_decorator_on_nested_def_flagged(self, tmp_path):
+        # @jax.jit has no Call node — the decorator walk must apply the
+        # same nesting check as the call form (review catch)
+        rel = _fake_repo(tmp_path, """
+            import jax
+            def forward_request(x):
+                @jax.jit
+                def f(y):
+                    return y * 2
+                return f(x)
+            """)
+        table = (JitEntry(module=rel, jit_fns=()),)
+        found = retrace.check_jit_construction(tmp_path, table)
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+        assert "forward_request" in found[0].message
+
+    def test_bare_jit_decorator_in_builder_clean(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            import jax
+            from functools import lru_cache
+            @lru_cache(maxsize=4)
+            def build(n):
+                @jax.jit
+                def f(y):
+                    return y * n
+                return f
+            """)
+        table = (JitEntry(module=rel, jit_fns=()),)
+        assert retrace.check_jit_construction(tmp_path, table) == []
+
+    def test_lru_cache_builder_clean(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            from functools import lru_cache
+            import jax
+            @lru_cache(maxsize=8)
+            def build(cfg):
+                return jax.jit(lambda a: a * 2)
+            """)
+        table = (JitEntry(module=rel, jit_fns=()),)
+        assert retrace.check_jit_construction(tmp_path, table) == []
+
+    def test_declared_builder_clean(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            import jax
+            _jit = None
+            def build():
+                global _jit
+                if _jit is None:
+                    _jit = jax.jit(lambda a: a)
+                return _jit
+            """)
+        table = (JitEntry(module=rel, jit_fns=(), builders=("build",)),)
+        assert retrace.check_jit_construction(tmp_path, table) == []
+
+    def test_undeclared_jit_module_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            import jax
+            @jax.jit
+            def hot(x):
+                return x * 2
+            """)
+        found = retrace.check_jit_construction(tmp_path, table=())
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+        assert "no JIT_TABLE entry" in found[0].message
+        _ = rel
+
+
+class TestRetraceCallSites:
+    TABLE_SRC = """
+        import jax
+        @jax.jit
+        def hot(x):
+            return x * 2
+        """
+
+    def _table(self, rel, fixed_callers=()):
+        return (JitEntry(module=rel, jit_fns=("hot",), entry_names=("hot",),
+                         shape_policy="fixed", rationale="fixture",
+                         fixed_callers=fixed_callers),)
+
+    def test_unbucketed_caller_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, self.TABLE_SRC)
+        caller = _fake_repo(tmp_path, """
+            from .mod import hot
+            def serve(batch):
+                return hot(batch)
+            """, "caller.py")
+        found = retrace.check_call_sites(tmp_path, self._table(rel))
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+        assert "serve" in found[0].message
+        _ = caller
+
+    def test_bucketed_caller_clean(self, tmp_path):
+        rel = _fake_repo(tmp_path, self.TABLE_SRC)
+        _fake_repo(tmp_path, """
+            from .mod import hot
+            from .shapes import pad_rows, pow2_bucket
+            def serve(batch):
+                return hot(pad_rows(batch, pow2_bucket(len(batch))))
+            """, "caller.py")
+        assert retrace.check_call_sites(tmp_path, self._table(rel)) == []
+
+    def test_declared_fixed_caller_clean_and_stale_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, self.TABLE_SRC)
+        caller = _fake_repo(tmp_path, """
+            from .mod import hot
+            def serve(batch):
+                return hot(batch)
+            """, "caller.py")
+        table = self._table(rel, fixed_callers=(
+            (caller, "serve", "batch is always exactly 1"),))
+        assert retrace.check_call_sites(tmp_path, table) == []
+        # a declaration matching nothing is stale — mirror stale-baseline
+        table = self._table(rel, fixed_callers=(
+            (caller, "serve", "ok"), (caller, "gone_fn", "typo'd")))
+        found = retrace.check_call_sites(tmp_path, table)
+        assert len(found) == 1 and "stale" in found[0].message
+
+    def test_wrapper_without_bucket_guard_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, """
+            import jax
+            _impl = jax.jit(lambda a: a)
+            def wrapper(batch):
+                return _impl(batch)
+            """)
+        table = (JitEntry(module=rel, jit_fns=(), wrapper="wrapper",
+                          shape_policy="bucketed"),)
+        found = retrace.check_table(tmp_path, table)
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+        assert "pow2_bucket" in found[0].message
+
+    def test_fixed_entry_without_rationale_flagged(self, tmp_path):
+        rel = _fake_repo(tmp_path, self.TABLE_SRC)
+        table = (JitEntry(module=rel, jit_fns=("hot",),
+                          shape_policy="fixed", rationale=""),)
+        found = retrace.check_table(tmp_path, table)
+        assert [f.rule for f in found] == ["GL-RETRACE-UNBUCKETED"]
+
+
+class TestRetraceDtype:
+    def test_np_sqrt_on_scalar_flagged(self):
+        src = fixture("""
+            import numpy as np
+            def init(shape):
+                return 1.0 / np.sqrt(shape[0])
+            """)
+        found = retrace.check_dtype_source(src, "m.py")
+        assert [f.rule for f in found] == ["GL-RETRACE-DTYPE"]
+
+    def test_math_sqrt_and_wrapped_clean(self):
+        src = fixture("""
+            import math
+            import numpy as np
+            def init(shape, d):
+                a = 1.0 / math.sqrt(shape[0])
+                b = float(np.sqrt(d))
+                c = np.float32(np.sqrt(d))
+                return a, b, c
+            """)
+        assert retrace.check_dtype_source(src, "m.py") == []
+
+    def test_narrowed_name_clean(self):
+        # the fixed PR-2 embeddings idiom: np.sqrt of an explicit float32
+        src = fixture("""
+            import numpy as np
+            def mix(weight):
+                w = np.float32(weight)
+                return np.sqrt(w), np.sqrt(np.float32(1.0) - w)
+            """)
+        assert retrace.check_dtype_source(src, "m.py") == []
+
+    def test_np_sqrt_on_array_variable_clean(self):
+        # names bound from non-narrowing calls are arrays — f32 in, f32
+        # out; the rule must not force a math.sqrt rewrite on them
+        src = fixture("""
+            import numpy as np
+            def norm(n):
+                arr = np.zeros((n, 4), dtype=np.float32)
+                return np.sqrt(arr)
+            """)
+        assert retrace.check_dtype_source(src, "m.py") == []
+
+    def test_dtypeless_float_ctor_flagged(self):
+        src = fixture("""
+            import numpy as np
+            def alloc(n):
+                bad = np.zeros((n, 4))
+                good = np.zeros((n, 4), dtype=np.float32)
+                positional = np.zeros((n, 4), np.float32)
+                return bad, good, positional
+            """)
+        found = retrace.check_dtype_source(src, "m.py")
+        assert len(found) == 1 and "float64" in found[0].message
+
+
+# ── GL-SHARD-* fixture corpus ────────────────────────────────────────
+
+
+class TestShardAxis:
+    AXES = {"dp", "tp", "sp"}
+
+    def test_unknown_axis_flagged(self):
+        src = fixture("""
+            from jax.sharding import PartitionSpec as P
+            SPEC = P("dp", "pd")
+            """)
+        found = sharding.check_axis_source(src, "m.py", self.AXES)
+        assert [f.rule for f in found] == ["GL-SHARD-AXIS"]
+        assert "'pd'" in found[0].message
+
+    def test_known_axes_and_none_clean(self):
+        src = fixture("""
+            from jax.sharding import PartitionSpec as P
+            A = P("dp", None, "sp", None)
+            B = P()
+            C = P(("dp", "tp"))
+            """)
+        assert sharding.check_axis_source(src, "m.py", self.AXES) == []
+
+    def test_default_axis_param_flagged(self):
+        src = fixture("""
+            from jax.sharding import PartitionSpec as P
+            def run(x, *, ep_axis="ep"):
+                return P(ep_axis)
+            """)
+        found = sharding.check_axis_source(src, "m.py", self.AXES)
+        assert [f.rule for f in found] == ["GL-SHARD-AXIS"]
+        assert "ep_axis" in found[0].message
+
+    def test_repo_registers_all_five_axes(self):
+        axes = sharding.registered_axes(REPO_ROOT)
+        assert {"dp", "tp", "sp", "pp", "ep"} <= axes
+
+
+class TestShardDonate:
+    def test_read_after_donate_flagged(self):
+        src = fixture("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, batch):
+                return state
+            def loop(state, batches):
+                out = step(state, batches[0])
+                return state.params
+            """)
+        found = sharding.check_donation_source(src, "m.py")
+        assert [f.rule for f in found] == ["GL-SHARD-DONATE"]
+        assert "read again" in found[0].message
+
+    def test_rebind_then_read_clean(self):
+        src = fixture("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, batch):
+                return state, 0.0
+            def loop(state, batches):
+                for b in batches:
+                    state, loss = step(state, b)
+                return state.params
+            """)
+        assert sharding.check_donation_source(src, "m.py") == []
+
+    def test_aliased_donation_flagged(self):
+        src = fixture("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, other):
+                return state
+            def loop(state):
+                fresh = step(state, state)
+                return fresh
+            """)
+        found = sharding.check_donation_source(src, "m.py")
+        assert any("aliased" in f.message for f in found)
+
+
+class TestShardRules:
+    def test_duplicate_and_shadowed_flagged(self):
+        src = fixture("""
+            from jax.sharding import PartitionSpec as P
+            RULES = [("w1", P("tp")), ("w1", P()), ("big_w2", P("tp")),
+                     ("w2", P()), ("xw2x", P("tp"))]
+            """)
+        found = sharding.check_rule_tables_source(src, "m.py")
+        details = {f.detail.split(":")[0] for f in found}
+        assert "dup" in details           # second "w1" can never win
+        assert "shadow" in details        # "xw2x" is dead behind "w2"
+
+    def test_clean_table_and_bad_regex(self):
+        clean = fixture("""
+            from jax.sharding import PartitionSpec as P
+            RULES = [("'q'", P(None, "tp")), ("'o'", P("tp", None))]
+            """)
+        assert sharding.check_rule_tables_source(clean, "m.py") == []
+        bad = fixture("""
+            from jax.sharding import PartitionSpec as P
+            RULES = [(r"w1(", P("tp"))]
+            """)
+        found = sharding.check_rule_tables_source(bad, "m.py")
+        assert [f.rule for f in found] == ["GL-SHARD-RULE"]
+
+    def test_runtime_validator_dead_and_shadowed(self):
+        P = object()
+        rules = [("w1", P), ("w1_extra", P), ("gate", P)]
+        paths = ["['blocks'][0]['w1']", "['blocks'][0]['w1_extra']"]
+        problems = sharding.validate_rule_table(rules, paths)
+        # "w1_extra" matches paths but "w1" always wins; "gate" matches none
+        assert len(problems) == 2
+        assert any("never wins" in p for p in problems)
+        assert any("zero param paths" in p for p in problems)
+        assert sharding.validate_rule_table(
+            [("w1", P)], ["['w1']"]) == []
+
+    def test_repo_moe_rules_live_on_real_params(self):
+        """The item-4 precondition on today's tables: moe_sharding_rules
+        must win on every real MoE param path."""
+        import jax
+
+        from vainplex_openclaw_tpu.models.moe import (
+            MoEConfig, init_moe_params, moe_sharding_rules)
+
+        params = init_moe_params(jax.random.PRNGKey(0), MoEConfig())
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        paths = [jax.tree_util.keystr(p) for p, _ in flat]
+        assert sharding.validate_rule_table(
+            moe_sharding_rules("ep"), paths) == []
+
+
+# ── RetraceWitness ───────────────────────────────────────────────────
+
+
+class TestRetraceWitness:
+    def test_wrap_trace_counts_once_per_jit_shape(self):
+        import jax
+
+        w = RetraceWitness()
+
+        def impl(x):
+            return x * 2
+
+        jitted = jax.jit(w.wrap_trace("impl", impl))
+        a = np.ones((4, 2), np.float32)
+        jitted(a); jitted(a); jitted(a)         # one shape → one trace
+        assert w.traces("impl") == 1
+        jitted(np.ones((8, 2), np.float32))     # new shape → one more
+        assert w.traces("impl") == 2
+        assert all(c == 1 for c in w.signatures("impl").values())
+
+    def test_assert_budget_raises_on_growth(self):
+        import jax
+
+        w = RetraceWitness()
+        jitted = jax.jit(w.wrap_trace("impl", lambda x: x + 1))
+        jitted(np.ones(4, np.float32))
+        w.baseline()
+        w.assert_no_retrace()                   # no new traces: fine
+        jitted(np.ones(8, np.float32))          # retrace
+        with pytest.raises(AssertionError, match="retrace budget"):
+            w.assert_no_retrace()
+        w.baseline()
+        jitted(np.ones(16, np.float32))
+        w.assert_budget(1)                      # explicit budget of one
+
+    def test_probe_tracks_cache_size(self):
+        import jax
+
+        w = RetraceWitness()
+        jitted = jax.jit(lambda x: x * 3)
+        jitted(np.ones(4, np.float32))
+        w.probe("fn", jitted)
+        w.baseline()
+        jitted(np.ones(4, np.float32))
+        w.assert_no_retrace("fn")
+        jitted(np.ones(8, np.float32))
+        with pytest.raises(AssertionError):
+            w.assert_no_retrace("fn")
+
+    def test_attach_counter_absorbs_trace_counts(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        w = RetraceWitness()
+        w.attach_counter("jaccard", lambda: sim.TRACE_COUNTS["jaccard"])
+        rng = np.random.default_rng(0)
+        sets = [{"k": int(v)} for v in rng.integers(0, 50, size=128)]
+        sim.jaccard_matrix(sets[:70], use_jax=True)   # prime bucket 128
+        w.baseline()
+        for n in (65, 97, 128):                       # same bucket
+            sim.jaccard_matrix(sets[:n], use_jax=True)
+        w.assert_no_retrace("jaccard")
+
+    def test_wrap_module_fn_is_undoable(self):
+        from vainplex_openclaw_tpu.ops import similarity as sim
+
+        w = RetraceWitness()
+        original = sim.multi_hot_rows
+        undo = w.wrap_module_fn(sim, "multi_hot_rows")
+        assert sim.multi_hot_rows is not original
+        sim.multi_hot_rows([(0, 1)], dim=8)
+        assert w.traces("multi_hot_rows") == 1
+        undo()
+        assert sim.multi_hot_rows is original
+
+    def test_probe_refuses_unprobeable(self):
+        w = RetraceWitness()
+        with pytest.raises(TypeError):
+            w.probe("nope", lambda x: x)
+
+    def test_assert_on_uninstrumented_name_raises(self):
+        # a typo'd pin must error, not pass unconditionally forever
+        w = RetraceWitness()
+        w.attach_counter("real", lambda: 0)
+        w.assert_no_retrace("real")
+        with pytest.raises(KeyError, match="never instrumented"):
+            w.assert_no_retrace("tpyo")
+
+
+# ── repo-wide gates for the new passes ───────────────────────────────
+
+
+class TestJaxRepoGate:
+    def test_tracing_pass_clean(self):
+        findings, scanned = tracing.run(REPO_ROOT)
+        assert scanned >= 9
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_retrace_pass_clean(self):
+        findings, scanned = retrace.run(REPO_ROOT)
+        assert scanned == len(JIT_TABLE)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_sharding_pass_clean(self):
+        findings, scanned = sharding.run(REPO_ROOT)
+        assert scanned > 100
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_table_covers_the_known_jit_modules(self):
+        modules = {e.module for e in JIT_TABLE}
+        for must in ("vainplex_openclaw_tpu/ops/similarity.py",
+                     "vainplex_openclaw_tpu/ops/flash_attention.py",
+                     "vainplex_openclaw_tpu/models/encoder.py",
+                     "vainplex_openclaw_tpu/models/train.py",
+                     "vainplex_openclaw_tpu/models/long_context.py",
+                     "vainplex_openclaw_tpu/parallel/ring_attention.py",
+                     "vainplex_openclaw_tpu/parallel/pipeline.py",
+                     "vainplex_openclaw_tpu/knowledge/embeddings.py"):
+            assert must in modules, f"JIT_TABLE lost {must}"
+
+    def test_every_fixed_entry_has_rationale(self):
+        for e in JIT_TABLE:
+            if e.shape_policy == "fixed":
+                assert e.rationale.strip(), e.module
+            for _, _, rationale in e.fixed_callers:
+                assert str(rationale).strip(), e.module

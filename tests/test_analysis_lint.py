@@ -513,6 +513,30 @@ class TestDrift:
         found = drift_mod.check_config_keys(tmp_path)
         assert len(found) == 1 and "'beta'" in found[0].message
 
+    def test_ci_record_key_drift_detected(self, tmp_path):
+        """ISSUE-10 satellite: a record FIELD the CI's embedded python
+        asserts must exist as an emitted key (dict literal / subscript
+        store) — an old name surviving only in a docstring must not mask
+        the rename."""
+        (tmp_path / ".github" / "workflows").mkdir(parents=True)
+        (tmp_path / ".github" / "workflows" / "ci.yml").write_text(
+            "      - run: |\n"
+            "          python - <<'EOF'\n"
+            "          import bench\n"
+            "          rec = bench.bench_thing()\n"
+            "          assert rec[\"real_field\"] > 0\n"
+            "          assert rec[\"ghost_field\"] > 0\n"
+            "          fo = rec[\"real_field\"]\n"
+            "          EOF\n")
+        (tmp_path / "bench.py").write_text(
+            '"""prose mentioning ghost_field must not count as a key"""\n'
+            'def bench_thing():\n'
+            '    return {"metric": "m", "real_field": 1}\n')
+        found = drift_mod.check_bench_ci(tmp_path)
+        details = {f.detail for f in found}
+        assert "key:ghost_field" in details
+        assert "key:real_field" not in details
+
     def test_ci_metric_drift_detected(self, tmp_path):
         (tmp_path / ".github" / "workflows").mkdir(parents=True)
         (tmp_path / ".github" / "workflows" / "ci.yml").write_text(
@@ -772,3 +796,115 @@ class TestRegressionsFromLint:
             th.join(5)
         j.close()
         assert not errors
+
+
+class TestJaxRegressionsFromLint:
+    """The true positives the ISSUE-10 JAX passes surfaced on their first
+    repo-wide run, pinned so they stay fixed (the PR-8 playbook):
+
+    1. cortex/trace_analyzer/classifier.local_triage fed the encoder an
+       UNBUCKETED batch — one XLA compile per distinct finding count on a
+       serving path (GL-RETRACE-UNBUCKETED). Now pow2-bucketed.
+    2. np.sqrt on Python scalars produced STRONG float64 scales in
+       encoder/moe/flash/ring init+attention math (GL-RETRACE-DTYPE, the
+       PR-2 bug class): silent f64 promotion the moment x64 is on.
+    3. forward_long / ring_attention / pipeline_apply rebuilt their
+       shard_map closure per call — a fresh compile cache every request
+       (GL-RETRACE-UNBUCKETED). Now lru_cache-memoized jitted builders.
+    """
+
+    def _findings(self, n):
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import (
+            FailureSignal,
+        )
+        return [FailureSignal(signal="doom_loop", severity="medium",
+                              chain_id=f"c{i}", agent="a", session="s",
+                              ts=0.0, summary=f"tool x failed attempt {i}",
+                              evidence=[])
+                for i in range(n)]
+
+    def test_local_triage_same_bucket_no_retrace(self):
+        from vainplex_openclaw_tpu.analysis import RetraceWitness
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.classifier import (
+            local_triage,
+        )
+        from vainplex_openclaw_tpu.models import encoder
+
+        witness = RetraceWitness()
+        witness.probe("forward", encoder.forward)
+        local_triage(self._findings(5))          # warm the 8 bucket
+        witness.baseline()
+        for n in (5, 6, 7, 8):                   # all land in bucket 8
+            decisions = local_triage(self._findings(n))
+            assert len(decisions) == n
+        witness.assert_no_retrace("forward")
+        local_triage(self._findings(9))          # bucket 16: ONE compile
+        witness.assert_budget(1, "forward")
+
+    def test_local_triage_padding_rows_do_not_change_decisions(self):
+        """Semantic half of the bucketing fix: zero-token padding rows
+        must not perturb the real rows' keep decisions."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.classifier import (
+            local_triage,
+        )
+
+        # 5 findings pad to bucket 8; 8 findings fill their bucket exactly.
+        # The first five decisions must agree between the two batchings.
+        five = local_triage(self._findings(5), min_severity="critical")
+        eight = local_triage(self._findings(8), min_severity="critical")
+        assert five == eight[:5]
+
+    def test_init_params_stay_float32_under_x64(self):
+        """GL-RETRACE-DTYPE pin: before the math.sqrt fix, np.sqrt's
+        strong float64 scale upcast every init leaf to f64 the moment
+        jax_enable_x64 was on (verified failing on the pre-fix tree)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        from vainplex_openclaw_tpu.models import EncoderConfig, init_params
+        from vainplex_openclaw_tpu.models.moe import (
+            MoEConfig, init_moe_params,
+        )
+
+        cfg = EncoderConfig(vocab_size=64, seq_len=8, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32)
+        with enable_x64():
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            moe = init_moe_params(jax.random.PRNGKey(1), MoEConfig(16, 32, 2))
+        leaves = (jax.tree_util.tree_leaves(params)
+                  + jax.tree_util.tree_leaves(moe))
+        assert leaves
+        for leaf in leaves:
+            assert leaf.dtype == jnp.float32, leaf.dtype
+
+    def test_forward_long_runner_memoized(self):
+        """GL-RETRACE-UNBUCKETED pin: equal (cfg, mesh, axes) must reuse
+        ONE jitted shard_map runner instead of rebuilding per call."""
+        from vainplex_openclaw_tpu.models import EncoderConfig
+        from vainplex_openclaw_tpu.models.long_context import _build_run
+        from vainplex_openclaw_tpu.parallel import make_mesh
+
+        cfg = EncoderConfig(vocab_size=64, seq_len=8, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32)
+        mesh_a = make_mesh(1, axes=("dp", "sp"))
+        try:
+            run_a = _build_run(cfg, mesh_a, "dp", "sp")
+        except TypeError as exc:  # pre-0.8 shard_map lacks check_vma
+            pytest.skip(f"shard_map signature mismatch on this jax: {exc}")
+        mesh_b = make_mesh(1, axes=("dp", "sp"))  # equal, not identical
+        assert _build_run(cfg, mesh_b, "dp", "sp") is run_a
+        assert _build_run(cfg, mesh_a, "sp", "dp") is not run_a
+
+    def test_ring_and_pipeline_builders_memoized(self):
+        from vainplex_openclaw_tpu.parallel import make_mesh
+        from vainplex_openclaw_tpu.parallel.ring_attention import _build_ring
+
+        mesh = make_mesh(1, axes=("dp", "sp"))
+        try:
+            r1 = _build_ring(mesh, "dp", "sp", False, "dense")
+        except TypeError as exc:
+            pytest.skip(f"shard_map signature mismatch on this jax: {exc}")
+        mesh_b = make_mesh(1, axes=("dp", "sp"))
+        assert _build_ring(mesh_b, "dp", "sp", False, "dense") is r1
+        assert _build_ring(mesh, "dp", "sp", True, "dense") is not r1

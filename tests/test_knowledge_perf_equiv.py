@@ -521,6 +521,23 @@ class TestEmbedBucketing:
         assert embedder.trace_count == before, \
             "same-bucket embed batches must hit the jit cache"
 
+    def test_witnessed_same_bucket_no_retrace(self, embedder):
+        """The same pin expressed through the reusable RetraceWitness
+        (ISSUE 10), so this equivalence suite arms the same instrument
+        bench.py and the tracelint regression pins do."""
+        from vainplex_openclaw_tpu.analysis import RetraceWitness
+
+        reset_arena(embedder)
+        witness = RetraceWitness()
+        witness.attach_counter("embed_forward", lambda: embedder.trace_count)
+        embedder._embed(["prime the 8-bucket"] * 8)
+        witness.baseline()
+        for n in (5, 6, 7, 8):
+            embedder._embed([f"text {i}" for i in range(n)])
+        witness.assert_no_retrace("embed_forward")
+        embedder._embed(["overflow"] * 9)   # bucket 16: exactly one compile
+        witness.assert_budget(1, "embed_forward")
+
     def test_bucketed_batch_matches_singleton_rows(self, embedder):
         """Zero-row padding must be semantics-free at model precision: a
         text embedded inside a padded batch equals the same text embedded

@@ -1,6 +1,7 @@
-"""graftlint: repo-wide concurrency + pattern-safety static analysis (ISSUE 8).
+"""graftlint: repo-wide concurrency + pattern-safety + JAX compilation
+static analysis (ISSUE 8, ISSUE 10).
 
-Four passes, one gate:
+Seven passes, one gate:
 
 - :mod:`.locks` — lock-discipline checker over the declarative guarded-
   state table (GL-LOCK-GUARD, GL-LOCK-BLOCKING);
@@ -10,7 +11,14 @@ Four passes, one gate:
 - :mod:`.redos` — catastrophic-backtracking screening (GL-REDOS), wired
   into the governance policy planner and cortex pattern banks at compile
   time and run here over the shipped default packs;
-- :mod:`.drift` — cross-file contract lints (GL-DRIFT-*).
+- :mod:`.drift` — cross-file contract lints (GL-DRIFT-*);
+- :mod:`.tracing` — trace-safety over the :mod:`.jit_table` entries
+  (GL-TRACE-HOSTSYNC / -CONTROLFLOW / -IMPURE / -TABLE);
+- :mod:`.retrace` — recompilation hazards (GL-RETRACE-UNBUCKETED,
+  GL-RETRACE-DTYPE), paired with the runtime
+  :class:`~.witness.RetraceWitness` the bench/equivalence suites arm;
+- :mod:`.sharding` — mesh/PartitionSpec contracts (GL-SHARD-AXIS,
+  GL-SHARD-DONATE, GL-SHARD-RULE).
 
 Run as ``python -m vainplex_openclaw_tpu.analysis`` (exit 1 on any
 non-baselined finding, 2 on crash) or import :func:`run_analysis` from
@@ -23,12 +31,14 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Optional
 
-from . import drift, lock_order, locks, redos
+from . import drift, lock_order, locks, redos, retrace, sharding, tracing
 from .findings import Finding, LintReport, apply_baseline, load_baseline
-from .witness import LockOrderWitness
+from .jit_table import JIT_TABLE, JitEntry
+from .witness import LockOrderWitness, RetraceWitness
 
 __all__ = [
-    "Finding", "LintReport", "LockOrderWitness", "run_analysis",
+    "Finding", "LintReport", "LockOrderWitness", "RetraceWitness",
+    "JIT_TABLE", "JitEntry", "run_analysis",
     "collect_findings", "default_pack_findings", "load_baseline",
 ]
 
@@ -102,19 +112,25 @@ def _builtin_policies() -> list:
 
 
 def collect_findings(root: str | Path) -> tuple[list, int]:
-    """All four passes over ``root``; → (findings, files_scanned).
-    ``files_scanned`` is the lock-order pass's full-package file count —
-    the only pass that traverses the whole tree (the discipline pass
-    re-reads a subset of those files and drift checks contracts, not
-    files), so the CI-greppable ``files=`` number tracks real traversal
-    and catches a scan that stopped walking."""
+    """All seven passes over ``root``; → (findings, files_scanned).
+    ``files_scanned`` stays pinned to the lock-order pass's full-package
+    file count: the retrace/sharding passes traverse the package too, but
+    reporting ONE canonical traversal keeps the CI-greppable ``files=``
+    number stable and still catches a scan that stopped walking (every
+    whole-tree pass globs the same package)."""
     findings: list = []
     lock_f, _ = locks.run(root)
     order_f, scanned = lock_order.run(root)
     drift_f, _ = drift.run(root)
+    trace_f, _ = tracing.run(root)
+    retrace_f, _ = retrace.run(root)
+    shard_f, _ = sharding.run(root)
     findings.extend(lock_f)
     findings.extend(order_f)
     findings.extend(drift_f)
+    findings.extend(trace_f)
+    findings.extend(retrace_f)
+    findings.extend(shard_f)
     findings.extend(default_pack_findings())
     return findings, scanned
 

@@ -265,6 +265,49 @@ def _scan_config_reads(scan_root, module, defaults_names, receivers, keys,
 
 _CI_METRIC_RE = re.compile(r'\["metric"\]\s*==\s*"(\w+)"')
 _CI_BENCH_FN_RE = re.compile(r"bench\.(\w+)\(")
+# raw-YAML form: block lines and the terminator carry the run: | indent
+_HEREDOC_RE = re.compile(r"python +- +<<'?EOF'?\n(.*?)\n[ \t]*EOF[ \t]*\n",
+                         re.S)
+
+
+def _ci_asserted_record_keys(ci_text: str) -> list:
+    """(key, block_index) pairs for every ``rec["field"]`` read the CI's
+    embedded python performs on a value returned by a ``bench.*`` call
+    (one subscript level deep: ``fo = rec["failover_recovery_ms"]`` makes
+    ``fo`` a record too). These are the contract the parse smoke asserts;
+    a renamed bench record field must fail the LINT, not just the smoke
+    (PR-9 satellite: cluster_scaling / slo_report --workers)."""
+    import textwrap
+
+    out = []
+    for bi, block in enumerate(_HEREDOC_RE.findall(ci_text)):
+        try:
+            tree = ast.parse(textwrap.dedent(block))
+        except SyntaxError:
+            continue
+        records: set = set()
+        for _ in range(3):  # tiny fixpoint: records beget records
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                val = node.value
+                is_rec = (isinstance(val, ast.Call)
+                          and _call_name(val.func).startswith("bench")) or (
+                    isinstance(val, ast.Subscript)
+                    and isinstance(val.value, ast.Name)
+                    and val.value.id in records)
+                if is_rec:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            records.add(t.id)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in records
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                out.append((node.slice.value, bi))
+    return out
 
 
 def check_bench_ci(root: str | Path, ci_path: str = ".github/workflows/ci.yml",
@@ -277,13 +320,35 @@ def check_bench_ci(root: str | Path, ci_path: str = ".github/workflows/ci.yml",
     ci_text = ci_file.read_text(encoding="utf-8")
     metrics: set = set()
     functions: set = set()
+    record_keys: set = set()
     # Metric names may be emitted by bench.py itself or by the harness
-    # modules it delegates to (slo_report lives in slo/harness.py).
+    # modules it delegates to (slo_report lives in slo/harness.py; the
+    # cluster_scaling record is assembled over cluster/ machinery).
     scan = [bench_file] + sorted(
-        (root / "vainplex_openclaw_tpu" / "slo").glob("*.py"))
+        (root / "vainplex_openclaw_tpu" / "slo").glob("*.py")) + sorted(
+        (root / "vainplex_openclaw_tpu" / "cluster").glob("*.py")) + sorted(
+        (root / "vainplex_openclaw_tpu" / "utils").glob("*.py"))
     for src in scan:
         tree = ast.parse(src.read_text(encoding="utf-8"))
         for node in ast.walk(tree):
+            # Record fields are dict-literal keys, ``rec["k"] = …`` stores,
+            # or ``dict(k=…)`` kwargs — NOT every string constant: a renamed
+            # field whose old name survives in a docstring or log message
+            # must still fail the lint, not hide behind the prose.
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        record_keys.add(k.value)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.slice, ast.Constant)
+                            and isinstance(t.slice.value, str)):
+                        record_keys.add(t.slice.value)
+            elif isinstance(node, ast.Call) and _call_name(node.func) == "dict":
+                for kw in node.keywords:
+                    if kw.arg:
+                        record_keys.add(kw.arg)
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if src == bench_file:
                     functions.add(node.name)
@@ -319,6 +384,15 @@ def check_bench_ci(root: str | Path, ci_path: str = ".github/workflows/ci.yml",
             "GL-DRIFT-BENCH", ci_path, 1,
             f"CI calls bench.{fn}() which bench.py does not define",
             detail=f"fn:{fn}"))
+    missing = {k for k, _ in _ci_asserted_record_keys(ci_text)
+               if k not in record_keys}
+    for key in sorted(missing):
+        findings.append(Finding(
+            "GL-DRIFT-BENCH", ci_path, 1,
+            f"CI parse smoke reads record field {key!r} but no bench/"
+            f"harness source ever emits that key — the smoke can only "
+            f"KeyError (or silently skip)",
+            detail=f"key:{key}"))
     return findings
 
 

@@ -1,0 +1,184 @@
+"""JIT_TABLE: the declarative registry of jitted entry points (ISSUE 10).
+
+graftlint's lock passes are driven by the guarded-state table in
+:mod:`.locks`; the three JAX passes (:mod:`.tracing`, :mod:`.retrace`,
+:mod:`.sharding`) are driven by this table. One :class:`JitEntry` per
+compilation root: which functions' Python bodies run at trace time, which
+parameters are static (never traced), how the entry keeps its shape space
+bounded (``bucketed`` through ``pow2_bucket``/``pad_rows``, or ``fixed``
+with a reviewable rationale), which functions are sanctioned lazy jit
+*builders*, and which call sites are exempt from the bucketing requirement
+and why. The table is the single source of truth: a new ``jax.jit`` that
+is not declared here is exactly the kind of silent retrace hazard the
+retrace pass exists to flag, and an entry's ``rationale``/``fixed_callers``
+strings are the reviewable artifact — the analogue of a GuardSpec row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+#: shape policies an entry may declare
+BUCKETED = "bucketed"   # wrapper routes shapes through pow2_bucket/pad_rows
+FIXED = "fixed"         # shapes bounded by construction; rationale required
+
+
+@dataclass(frozen=True)
+class JitEntry:
+    """One compilation root and its shape/staticness contract.
+
+    ``jit_fns`` are dotted names whose bodies execute at trace time (nested
+    functions as ``outer.inner``, methods as ``Class.method``); the tracing
+    pass expands them through the same-module call graph, so helpers only
+    reachable from a jitted body are scanned without being listed.
+    ``static`` names parameters (matched BY NAME anywhere in the
+    expansion) that jit treats as static — Python control flow on them is
+    legal. ``entry_names`` are the public callables whose *callers* own the
+    shape discipline; every package call site must bucket, be a declared
+    ``fixed_caller``, or itself be a traced body. ``builders`` may
+    construct jit/shard_map lazily (memoized); anywhere else a
+    ``jax.jit``/``shard_map`` call inside a plain function is flagged as a
+    per-call retrace.
+    """
+
+    module: str                      # repo-relative posix path
+    jit_fns: tuple = ()              # trace-time bodies (dotted names)
+    static: tuple = ()               # static param names (by name)
+    wrapper: str = ""                # bucketing wrapper (for BUCKETED)
+    shape_policy: str = BUCKETED
+    rationale: str = ""              # required when shape_policy == FIXED
+    builders: tuple = ()             # sanctioned lazy jit/shard_map builders
+    entry_names: tuple = ()          # callables whose callers own shapes
+    # ((module, function, rationale), ...) — call sites exempt from the
+    # bucketing requirement; an empty rationale is itself a finding.
+    fixed_callers: tuple = field(default_factory=tuple)
+
+
+_PKG = "vainplex_openclaw_tpu"
+
+JIT_TABLE: tuple[JitEntry, ...] = (
+    JitEntry(
+        module=f"{_PKG}/ops/similarity.py",
+        jit_fns=("_jaccard_matrix_jax_impl",),
+        wrapper="jaccard_from_rows",
+        shape_policy=BUCKETED,
+        builders=("_jaccard_matrix_jax",),
+    ),
+    JitEntry(
+        module=f"{_PKG}/ops/similarity.py",
+        jit_fns=("_batch_levenshtein_jax.one_pair",),
+        wrapper="batch_levenshtein_ratio",
+        shape_policy=BUCKETED,
+        builders=("_batch_levenshtein_jax",),
+    ),
+    JitEntry(
+        module=f"{_PKG}/ops/flash_attention.py",
+        jit_fns=("flash_attention", "_pallas_flash", "_flash_kernel",
+                 "_dense_stats_ref", "_flash_norm_bwd", "_flash_stats_bwd"),
+        static=("causal", "block_q", "block_k", "interpret", "return_stats",
+                "scale", "n_kb", "L"),  # L: default_block's shape-int param
+        wrapper="flash_attention",
+        shape_policy=FIXED,
+        rationale="pads unaligned lengths internally to block multiples "
+                  "(padded keys masked, padded queries sliced), so the "
+                  "compile cache is bounded by the measured block table, "
+                  "not by caller shape diversity",
+        entry_names=("flash_attention",),
+    ),
+    JitEntry(
+        module=f"{_PKG}/models/encoder.py",
+        jit_fns=("forward",),
+        static=("cfg", "impl", "n_heads"),
+        shape_policy=FIXED,
+        rationale="seq_len is fixed by config; the batch dim is owned per "
+                  "call site (every caller is bucketed, a traced body, or "
+                  "declared below)",
+        entry_names=("forward",),
+        fixed_callers=(
+            (f"{_PKG}/models/serve.py", "make_local_call_llm.call",
+             "single-prompt serve path: batch is always exactly 1"),
+        ),
+    ),
+    JitEntry(
+        module=f"{_PKG}/models/moe.py",
+        jit_fns=("moe_ffn", "moe_ffn_parts", "load_balance_loss"),
+        static=("cfg", "n_experts"),
+        shape_policy=FIXED,
+        rationale="helpers traced only inside encoder/long-context bodies; "
+                  "they never own a compile cache",
+    ),
+    JitEntry(
+        module=f"{_PKG}/models/train.py",
+        jit_fns=("train_step", "_eval_step"),
+        static=("cfg", "optimizer"),
+        shape_policy=FIXED,
+        rationale="batches are drop-remainder (train) or wrapped to a "
+                  "fixed batch_size (eval): every batch is exactly "
+                  "[batch_size, seq_len] by data-pipeline construction",
+        entry_names=("train_step", "_eval_step"),
+        fixed_callers=(
+            (f"{_PKG}/models/train.py", "train_loop",
+             "epoch() is drop-remainder: one static batch shape"),
+            (f"{_PKG}/models/train.py", "evaluate",
+             "eval_batches() wraps the tail to a full static batch"),
+        ),
+    ),
+    JitEntry(
+        module=f"{_PKG}/models/long_context.py",
+        jit_fns=("_build_run.run",),
+        static=("cfg", "mesh", "dp_axis", "sp_axis"),
+        shape_policy=FIXED,
+        rationale="L is divisible by the sp axis and fixed by config; the "
+                  "jitted shard_map runner is memoized per "
+                  "(cfg, mesh, axes) so repeat calls hit the jit cache",
+        builders=("_build_run",),
+        entry_names=("forward_long",),
+    ),
+    JitEntry(
+        module=f"{_PKG}/parallel/ring_attention.py",
+        jit_fns=("_build_ring.run", "ring_attention_local"),
+        static=("axis_name", "causal", "scale", "impl", "mesh",
+                "dp_axis", "sp_axis"),
+        shape_policy=FIXED,
+        rationale="shard shapes are fixed by the mesh; the jitted "
+                  "shard_map runner is memoized per (mesh, axes, causal, "
+                  "impl)",
+        builders=("_build_ring",),
+        entry_names=("ring_attention",),
+    ),
+    JitEntry(
+        module=f"{_PKG}/parallel/pipeline.py",
+        jit_fns=("_build_pipe_run.run",),
+        static=("mesh", "pp_axis", "n_microbatches", "stage_fn",
+                "treedef", "n_stages"),
+        shape_policy=FIXED,
+        rationale="microbatch count and stage layout are static; the "
+                  "jitted shard_map runner is memoized per (stage_fn, "
+                  "mesh, schedule)",
+        builders=("_build_pipe_run",),
+        entry_names=("pipeline_apply",),
+    ),
+    JitEntry(
+        module=f"{_PKG}/knowledge/embeddings.py",
+        jit_fns=("LocalEmbeddings._ensure_model.run",),
+        static=("cfg",),
+        wrapper="LocalEmbeddings._embed",
+        shape_policy=BUCKETED,
+        builders=("LocalEmbeddings._ensure_model",),
+    ),
+)
+
+
+def entries_for(module: str, table: tuple = None) -> list:
+    """Table entries declared for a repo-relative module path. ``table``
+    lets the fixture corpus drive the passes with synthetic entries."""
+    return [e for e in (JIT_TABLE if table is None else table)
+            if e.module == module]
+
+
+def table_modules() -> list:
+    """Distinct modules the table covers, in declaration order."""
+    seen: dict = {}
+    for e in JIT_TABLE:
+        seen.setdefault(e.module, None)
+    return list(seen)

@@ -1,0 +1,336 @@
+"""Mesh/PartitionSpec contract lints (GL-SHARD-*).
+
+Sharding bugs fail late and silently: an axis-name typo in a
+``PartitionSpec`` raises only when the spec finally meets a mesh (or, in
+``in_specs`` of an un-exercised code path, never); a donated buffer read
+after the call returns garbage only on real hardware (CPU aliasing hides
+it); a partition rule that matches zero params silently replicates what
+it was supposed to shard. Three rules:
+
+- **GL-SHARD-AXIS** — every axis-name string literal inside a
+  ``P(...)``/``PartitionSpec(...)`` call, and every ``*_axis`` parameter
+  default, must be an axis some mesh constructor in the repo actually
+  declares (``make_mesh(axes=…)`` / ``Mesh(devices, (...))`` literals —
+  the same register-then-check shape as GL-DRIFT-FAULTSITE).
+- **GL-SHARD-DONATE** — a ``donate_argnums`` argument must not be read
+  again after the call before being rebound, and must not be passed
+  twice in one call (aliased donation).
+- **GL-SHARD-RULE** — partition-rule tables (``[(pattern, P(...)), …]``,
+  first match wins — the SNIPPETS match_partition_rules shape item 4
+  adopts) must have no duplicate patterns, no rule shadowed by an
+  earlier substring/regex superset (dead rule), and no unparseable
+  regex. The runtime side is :func:`validate_rule_table`: given the
+  actual param paths, every rule must WIN on at least one path.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .findings import Finding
+from .retrace import _leaf
+from .tracing import _dotted
+
+_PKG = "vainplex_openclaw_tpu"
+_SPEC_NAMES = frozenset({"P", "PartitionSpec"})
+_REGEXY = re.compile(r"[\\^$*+?\[\]()|{}]")
+
+
+def _str_elements(node):
+    """String constants directly in an expression (handles tuples/lists)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _str_elements(e)
+
+
+# ── axis universe ────────────────────────────────────────────────────
+
+
+def registered_axes(root: str | Path, trees: dict = None) -> set:
+    """Axis names any mesh constructor in the repo declares. Conservative
+    in the direction that keeps a typo'd SPEC unmatched: only literal
+    tuples register axes; meshes built from variables register nothing.
+    ``trees`` (path → parsed ast) lets :func:`run` share one parse per
+    file across the passes."""
+    root = Path(root)
+    axes: set = {"dp", "tp", "sp"}  # make_mesh's signature default
+    scan = [p for p in (root / _PKG).rglob("*.py")]
+    scan += sorted((root / "tests").glob("*.py"))
+    for extra in ("__graft_entry__.py", "bench.py", "tpu_capture.py"):
+        if (root / extra).exists():
+            scan.append(root / extra)
+    for path in scan:
+        tree = (trees or {}).get(path)
+        if tree is None:
+            try:
+                tree = ast.parse(path.read_text(encoding="utf-8"))
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _leaf(_dotted(node.func))
+            if leaf == "make_mesh":
+                for kw in node.keywords:
+                    if kw.arg == "axes":
+                        axes.update(_str_elements(kw.value))
+                if len(node.args) >= 2:
+                    axes.update(_str_elements(node.args[1]))
+            elif leaf == "Mesh" and len(node.args) >= 2:
+                axes.update(_str_elements(node.args[1]))
+    return axes
+
+
+def check_axis_source(src: str, path: str, axes: set, tree=None) -> list:
+    """GL-SHARD-AXIS findings for one module against an axis universe."""
+    tree = ast.parse(src) if tree is None else tree
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _leaf(_dotted(node.func)) in _SPEC_NAMES:
+            for arg in node.args:
+                for name in _str_elements(arg):
+                    if name not in axes:
+                        findings.append(Finding(
+                            "GL-SHARD-AXIS", path, node.lineno,
+                            f"PartitionSpec names axis {name!r} which no "
+                            f"mesh in the repo declares — typo, or an "
+                            f"undeclared mesh axis",
+                            detail=f"axis:{name}:{node.lineno}"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            # align trailing defaults with trailing named args
+            pos_with_default = list(zip(args.args[-len(args.defaults):]
+                                        if args.defaults else [],
+                                        args.defaults))
+            kw_with_default = [(a, d) for a, d in
+                               zip(args.kwonlyargs, args.kw_defaults)
+                               if d is not None]
+            for a, d in pos_with_default + kw_with_default:
+                if a.arg.endswith("_axis") and isinstance(d, ast.Constant) \
+                        and isinstance(d.value, str) and d.value not in axes:
+                    findings.append(Finding(
+                        "GL-SHARD-AXIS", path, node.lineno,
+                        f"{node.name}() defaults {a.arg}={d.value!r} but "
+                        f"no mesh in the repo declares that axis",
+                        detail=f"default:{node.name}:{a.arg}:{d.value}"))
+    return findings
+
+
+# ── donation discipline ──────────────────────────────────────────────
+
+
+def _donating_functions(tree: ast.Module) -> dict:
+    """function name → donated positional indices, from jit decorators."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            is_jit = _leaf(_dotted(dec.func)) in ("jit", "pjit") or (
+                _leaf(_dotted(dec.func)) == "partial"
+                and any(_leaf(_dotted(a)) in ("jit", "pjit")
+                        for a in dec.args))
+            if not is_jit:
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    idxs = []
+                    val = kw.value
+                    vals = val.elts if isinstance(val, (ast.Tuple, ast.List)) \
+                        else [val]
+                    for v in vals:
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, int):
+                            idxs.append(v.value)
+                    if idxs:
+                        out[node.name] = tuple(idxs)
+    return out
+
+
+def check_donation_source(src: str, path: str,
+                          donors: dict | None = None, tree=None) -> list:
+    """GL-SHARD-DONATE findings for one module. ``donors`` maps function
+    name → donated positions; defaults to the module's own jit
+    decorators (cross-module donors are passed in by :func:`run`)."""
+    tree = ast.parse(src) if tree is None else tree
+    table = dict(_donating_functions(tree))
+    if donors:
+        table.update(donors)
+    if not table:
+        return []
+    findings = []
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        # name → [(lineno, col, is_store)] events, in source order
+        events: dict = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                events.setdefault(node.id, []).append(
+                    (node.lineno, node.col_offset,
+                     isinstance(node.ctx, (ast.Store, ast.Del))))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            donated = table.get(_leaf(_dotted(node.func)))
+            if not donated:
+                continue
+            names = [a.id if isinstance(a, ast.Name) else None
+                     for a in node.args]
+            for idx in donated:
+                if idx >= len(names) or names[idx] is None:
+                    continue
+                name = names[idx]
+                if names.count(name) > 1:
+                    findings.append(Finding(
+                        "GL-SHARD-DONATE", path, node.lineno,
+                        f"{name!r} passed twice to "
+                        f"{_leaf(_dotted(node.func))}() with argument "
+                        f"{idx} donated — aliased donation",
+                        detail=f"alias:{name}:{node.lineno}"))
+                # first event strictly after the call line: a Load before
+                # any rebind means reading a donated (deleted) buffer.
+                # Stores on the call line itself (`x, y = f(x, …)`) bind
+                # after the call returns and count as the rebind.
+                later = sorted(e for e in events.get(name, [])
+                               if e[0] > node.lineno
+                               or (e[0] == node.lineno and e[2]))
+                if later and not later[0][2]:
+                    findings.append(Finding(
+                        "GL-SHARD-DONATE", path, node.lineno,
+                        f"{name!r} is donated to "
+                        f"{_leaf(_dotted(node.func))}() at line "
+                        f"{node.lineno} but read again at line "
+                        f"{later[0][0]} before being rebound — donated "
+                        f"buffers are deleted on dispatch",
+                        detail=f"read-after-donate:{name}:{node.lineno}"))
+    return findings
+
+
+# ── partition-rule tables ────────────────────────────────────────────
+
+
+def _rule_tables(tree: ast.Module):
+    """Yield (lineno, [pattern, ...]) for every [(str, P(...)), …] list."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.List) or not node.elts:
+            continue
+        patterns = []
+        for e in node.elts:
+            if (isinstance(e, ast.Tuple) and len(e.elts) == 2
+                    and isinstance(e.elts[0], ast.Constant)
+                    and isinstance(e.elts[0].value, str)
+                    and isinstance(e.elts[1], ast.Call)
+                    and _leaf(_dotted(e.elts[1].func)) in _SPEC_NAMES):
+                patterns.append(e.elts[0].value)
+            else:
+                patterns = None
+                break
+        if patterns:
+            yield node.lineno, patterns
+
+
+def check_rule_tables_source(src: str, path: str, tree=None) -> list:
+    """GL-SHARD-RULE findings for the static rule tables in one module."""
+    tree = ast.parse(src) if tree is None else tree
+    findings = []
+    for lineno, patterns in _rule_tables(tree):
+        seen: dict = {}
+        for i, pat in enumerate(patterns):
+            if pat in seen:
+                findings.append(Finding(
+                    "GL-SHARD-RULE", path, lineno,
+                    f"rule table repeats pattern {pat!r} — the second "
+                    f"entry can never win (first match wins)",
+                    detail=f"dup:{pat}:{lineno}"))
+                continue
+            seen[pat] = i
+            if pat == "" and i != len(patterns) - 1:
+                findings.append(Finding(
+                    "GL-SHARD-RULE", path, lineno,
+                    "empty pattern matches every path — all later rules "
+                    "are dead",
+                    detail=f"empty:{lineno}"))
+            if _REGEXY.search(pat):
+                try:
+                    re.compile(pat)
+                except re.error as exc:
+                    findings.append(Finding(
+                        "GL-SHARD-RULE", path, lineno,
+                        f"rule pattern {pat!r} is not a valid regex: {exc}",
+                        detail=f"badre:{pat}:{lineno}"))
+            for prev in patterns[:i]:
+                if prev and prev in pat:
+                    findings.append(Finding(
+                        "GL-SHARD-RULE", path, lineno,
+                        f"rule {pat!r} is dead: earlier rule {prev!r} is "
+                        f"a substring, so it wins on every path the "
+                        f"later rule matches",
+                        detail=f"shadow:{prev}->{pat}:{lineno}"))
+    return findings
+
+
+def validate_rule_table(rules, paths, regex: bool = False) -> list:
+    """Runtime contract for a partition-rule table against REAL param
+    paths (the item-4 ``match_partition_rules`` precondition): every rule
+    must WIN (be the first match) on at least one path. Returns human-
+    readable problem strings; empty means the table is live end to end.
+    ``regex=True`` matches with ``re.search`` (the SNIPPETS shape),
+    else substring (parallel/mesh.shard_params semantics)."""
+    problems = []
+    hit = [False] * len(rules)
+
+    def matches(pat, path):
+        return bool(re.search(pat, path)) if regex else pat in path
+
+    for path in paths:
+        for i, (pat, _spec) in enumerate(rules):
+            if matches(pat, path):
+                hit[i] = True
+                break
+    for i, ((pat, _spec), won) in enumerate(zip(rules, hit)):
+        if not won:
+            if any(matches(pat, p) for p in paths):
+                problems.append(
+                    f"rule {i} ({pat!r}) matches paths but never wins — "
+                    f"shadowed by an earlier rule on every match")
+            else:
+                problems.append(
+                    f"rule {i} ({pat!r}) matches zero param paths — dead "
+                    f"rule (typo, or params renamed)")
+    return problems
+
+
+# ── entry point ──────────────────────────────────────────────────────
+
+
+def run(root) -> tuple[list, int]:
+    root = Path(root)
+    findings: list = []
+    scan = sorted((root / _PKG).rglob("*.py"))
+    if (root / "__graft_entry__.py").exists():
+        scan.append(root / "__graft_entry__.py")
+    # one read + parse per file, shared across every check below
+    trees: dict = {}
+    for path in scan:
+        try:
+            trees[path] = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue
+    axes = registered_axes(root, trees)
+    # donors visible across modules (train_step is called package-wide)
+    donors: dict = {}
+    for tree in trees.values():
+        donors.update(_donating_functions(tree))
+    for path, tree in trees.items():
+        rel = path.relative_to(root).as_posix()
+        findings.extend(check_axis_source("", rel, axes, tree=tree))
+        findings.extend(check_donation_source("", rel, donors, tree=tree))
+        findings.extend(check_rule_tables_source("", rel, tree=tree))
+    return findings, len(trees)

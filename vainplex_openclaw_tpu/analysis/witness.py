@@ -1,4 +1,19 @@
-"""Runtime lock-order witness: the dynamic half of the lock-order pass.
+"""Runtime witnesses: the dynamic half of the static passes.
+
+Two witnesses live here. :class:`LockOrderWitness` (below) closes the
+lock-order pass's callback/cross-object gap at test time.
+:class:`RetraceWitness` does the same for the retrace pass: static
+analysis proves the *discipline* (shapes bucketed, jit construction
+memoized); the witness proves the *outcome* — that a same-bucket request
+stream actually compiles zero new programs. It generalizes
+``ops/similarity.TRACE_COUNTS`` (PR 1's two hand-rolled counters) into
+one reusable instrument: wrap unjitted impls to count Python-body
+executions (= traces), probe jitted callables' compile-cache sizes, and
+absorb existing trace counters, then ``assert_budget()`` after driving
+the workload. Armed in ``bench.py`` and the perf-equivalence suites the
+way the lock witness is armed in the chaos storms.
+
+Lock-order witness notes:
 
 The static graph (:mod:`.lock_order`) sees lexical ``with`` nesting inside
 one class; it cannot see a StageTimer lock taken inside a FactStore
@@ -139,3 +154,133 @@ class LockOrderWitness:
             raise AssertionError(
                 f"lock acquisition order has cycles: {pretty} "
                 f"(edges: {sorted(self.edges())})")
+
+
+class RetraceWitness:
+    """Counts jit traces per callable so tests/benches can pin that a
+    same-bucket stream compiles ZERO new programs.
+
+    Three instrumentation modes, composable per name:
+
+    - :meth:`wrap_trace` wraps an UNJITTED impl; the wrapper's Python body
+      runs exactly once per trace when a jit transform consumes it, so the
+      per-name count IS the trace count (keyed by the abstract signature
+      of each traced call for diagnostics).
+    - :meth:`probe` registers an already-jitted callable exposing jax's
+      ``_cache_size()``; growth between :meth:`baseline` and
+      :meth:`assert_budget` counts compiles without touching the callee.
+    - :meth:`attach_counter` absorbs an existing trace counter (the
+      ``TRACE_COUNTS`` dict in ops/similarity, ``LocalEmbeddings.
+      trace_count``) behind the same assertion surface.
+
+    Thread-safe the cheap way (one lock around counter updates) — this is
+    test/bench freight; nothing imports it on a serving path.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._trace_counts: dict = {}   # name -> {signature: traces}
+        self._probes: dict = {}         # name -> callable returning int
+        self._counters: dict = {}       # name -> callable returning int
+        self._base: dict = {}           # name -> count at last baseline()
+
+    # ── instrumentation ──────────────────────────────────────────────
+
+    @staticmethod
+    def _signature(args, kwargs) -> tuple:
+        def one(a):
+            shape = getattr(a, "shape", None)
+            dtype = getattr(a, "dtype", None)
+            if shape is not None:
+                return ("arr", tuple(shape), str(dtype))
+            return ("val", repr(a)[:64])
+        return (tuple(one(a) for a in args),
+                tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+
+    def wrap_trace(self, name: str, fn):
+        """Wrap an unjitted impl; bumps once per Python-body execution
+        (= once per jit trace when a transform consumes the wrapper)."""
+        def traced(*args, **kwargs):
+            sig = self._signature(args, kwargs)
+            with self._lock:
+                sigs = self._trace_counts.setdefault(name, {})
+                sigs[sig] = sigs.get(sig, 0) + 1
+            return fn(*args, **kwargs)
+        traced.__name__ = getattr(fn, "__name__", name)
+        traced.__wrapped__ = fn
+        return traced
+
+    def wrap_module_fn(self, module, attr: str, name: "Optional[str]" = None):
+        """Replace ``module.attr`` with a trace-counting wrapper in place
+        (global-name lookups inside already-jitted callers pick it up on
+        their next trace). Returns an undo callable."""
+        original = getattr(module, attr)
+        setattr(module, attr, self.wrap_trace(name or attr, original))
+        return lambda: setattr(module, attr, original)
+
+    def probe(self, name: str, jitted) -> None:
+        """Watch an already-jitted callable's compile-cache size
+        (``_cache_size`` — present on jax.jit/pjit wrappers)."""
+        sizer = getattr(jitted, "_cache_size", None)
+        if sizer is None:  # no probe surface: count nothing, loudly
+            raise TypeError(f"{jitted!r} exposes no _cache_size()")
+        self._probes[name] = sizer
+
+    def attach_counter(self, name: str, getter) -> None:
+        """Absorb an external trace counter (``lambda: TRACE_COUNTS['x']``)."""
+        self._counters[name] = getter
+
+    # ── readings ─────────────────────────────────────────────────────
+
+    def traces(self, name: str) -> int:
+        with self._lock:
+            if name in self._trace_counts:
+                return sum(self._trace_counts[name].values())
+        if name in self._probes:
+            return int(self._probes[name]())
+        if name in self._counters:
+            return int(self._counters[name]())
+        return 0
+
+    def signatures(self, name: str) -> dict:
+        """signature -> trace count for a wrap_trace'd name (diagnostics:
+        a signature traced twice means the jit cache was rebuilt)."""
+        with self._lock:
+            return dict(self._trace_counts.get(name, {}))
+
+    def names(self) -> list:
+        with self._lock:
+            wrapped = list(self._trace_counts)
+        return sorted(set(wrapped) | set(self._probes) | set(self._counters))
+
+    # ── assertions ───────────────────────────────────────────────────
+
+    def baseline(self) -> dict:
+        """Snapshot every instrumented count; subsequent budget checks are
+        relative to this (call after warmup, before the measured phase)."""
+        self._base = {n: self.traces(n) for n in self.names()}
+        return dict(self._base)
+
+    def assert_budget(self, budget: int = 0, name: "Optional[str]" = None) -> None:
+        """Assert every instrumented name (or just ``name``) traced at
+        most ``budget`` new programs since the last :meth:`baseline`
+        (never called → since construction). budget=0 is the same-bucket
+        no-retrace pin. A name nothing ever instrumented raises — a
+        typo'd pin that asserts nothing forever is a disarmed witness."""
+        if name is not None and name not in self.names():
+            raise KeyError(
+                f"{name!r} was never instrumented (have: {self.names()}) — "
+                f"this assertion would pass unconditionally")
+        names = [name] if name is not None else self.names()
+        over = []
+        for n in names:
+            grew = self.traces(n) - self._base.get(n, 0)
+            if grew > budget:
+                over.append(f"{n}: {grew} new traces (budget {budget})")
+        if over:
+            raise AssertionError(
+                "retrace budget exceeded — same-bucket calls are "
+                "recompiling: " + "; ".join(over))
+
+    def assert_no_retrace(self, name: "Optional[str]" = None) -> None:
+        self.assert_budget(0, name)

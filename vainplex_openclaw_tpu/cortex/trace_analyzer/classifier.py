@@ -100,7 +100,10 @@ def local_triage(findings: list[FailureSignal], min_severity: str = "medium",
     floor below carries all the recall."""
     from ...models import encode_texts, forward
     from ...models.pretrained import load_pretrained
+    from ...ops.similarity import pad_rows, pow2_bucket
 
+    if not findings:
+        return []
     loaded = load_pretrained(checkpoint_dir)
     if loaded is None:
         import jax
@@ -113,11 +116,18 @@ def local_triage(findings: list[FailureSignal], min_severity: str = "medium",
         cfg, params = loaded
     texts = [f"{f.signal} {f.summary} {' '.join(map(str, f.evidence))}" for f in findings]
     tokens = encode_texts(texts, cfg.seq_len, cfg.vocab_size)
-    out = forward(params, tokens, cfg)
+    # Bucket the batch dim to a power of two (the PR-1 shape policy,
+    # GL-RETRACE-UNBUCKETED): triage batch sizes track finding counts,
+    # which vary per analyzer run — unbucketed, every distinct count paid
+    # a fresh XLA compile on the serving path. Zero-token padding rows are
+    # batch-independent in the encoder (masked pooling clamps the
+    # denominator) and are sliced back out below.
+    padded = pad_rows(tokens, pow2_bucket(len(texts)))
+    out = forward(params, padded, cfg)
     keep_logits = out["keep"]
     import numpy as np
 
-    keep = np.asarray(keep_logits).argmax(axis=-1).astype(bool)
+    keep = np.asarray(keep_logits)[:len(texts)].argmax(axis=-1).astype(bool)
     # The trained keep head prunes noise findings; the rule floor guarantees
     # recall either way — a rule-severe finding is never dropped by the model.
     floor = SEVERITY_RANK[min_severity]

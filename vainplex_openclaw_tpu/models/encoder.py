@@ -17,12 +17,12 @@ are computed on-device). Designed TPU-first:
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 @dataclass(frozen=True)
@@ -52,7 +52,10 @@ class EncoderConfig:
 
 
 def _dense_init(key, shape, scale=None):
-    scale = scale if scale is not None else (1.0 / np.sqrt(shape[0]))
+    # math.sqrt: a weak Python float. np.sqrt here returned a STRONG
+    # np.float64 scalar that silently upcast the whole init tree to f64
+    # the moment jax_enable_x64 was on (GL-RETRACE-DTYPE, the PR-2 class).
+    scale = scale if scale is not None else (1.0 / math.sqrt(shape[0]))
     return jax.random.normal(key, shape, dtype=jnp.float32) * scale
 
 
@@ -161,7 +164,7 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
         # padded query rows sliced) and picks measured-optimal blocks.
         out = flash_attention(q, k, v, mask)
     else:
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)
         scores = jnp.where(mask[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(dt)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)

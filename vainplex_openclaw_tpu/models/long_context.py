@@ -14,7 +14,7 @@ parity); positions are recovered per-shard with ``axis_index``.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -29,12 +29,15 @@ from ..parallel.ring_attention import ring_attention_local
 from .encoder import EncoderConfig, _rmsnorm
 
 
-def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
-                 mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp") -> dict:
-    """tokens [B, L] int32, L divisible by the sp axis size → same outputs as
-    ``encoder.forward``: {severity, keep, mood, embedding} with batch sharded
-    over dp and sequence memory spread over sp."""
+@lru_cache(maxsize=8)
+def _build_run(cfg: EncoderConfig, mesh: Mesh, dp_axis: str, sp_axis: str):
+    """Jitted shard_map runner, memoized per (cfg, mesh, axes). The old
+    per-call closure handed every ``forward_long`` call a fresh compile
+    cache — the whole network re-traced per request
+    (GL-RETRACE-UNBUCKETED). EncoderConfig is a frozen dataclass and Mesh
+    is hashable, so equal configurations share one compiled runner."""
 
+    @jax.jit
     @partial(shard_map, mesh=mesh,
              in_specs=(P(), P(dp_axis, sp_axis)),
              out_specs={"severity": P(dp_axis, None), "keep": P(dp_axis, None),
@@ -99,4 +102,12 @@ def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
             "moe_aux": moe_aux,
         }
 
-    return run(params, tokens)
+    return run
+
+
+def forward_long(params: dict, tokens: jax.Array, cfg: EncoderConfig,
+                 mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp") -> dict:
+    """tokens [B, L] int32, L divisible by the sp axis size → same outputs as
+    ``encoder.forward``: {severity, keep, mood, embedding} with batch sharded
+    over dp and sequence memory spread over sp."""
+    return _build_run(cfg, mesh, dp_axis, sp_axis)(params, tokens)

@@ -14,11 +14,11 @@ mean router prob per expert, scaled by E) to keep routing uniform.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 @dataclass(frozen=True)
@@ -30,8 +30,10 @@ class MoEConfig:
 
 def init_moe_params(key: jax.Array, cfg: MoEConfig) -> dict:
     kg, k1, k2 = jax.random.split(key, 3)
-    scale1 = 1.0 / np.sqrt(cfg.d_model)
-    scale2 = 1.0 / np.sqrt(cfg.d_ff)
+    # weak Python floats — np.sqrt's strong float64 scalars upcast the
+    # expert stacks to f64 under x64 (GL-RETRACE-DTYPE)
+    scale1 = 1.0 / math.sqrt(cfg.d_model)
+    scale2 = 1.0 / math.sqrt(cfg.d_ff)
     return {
         "gate": jax.random.normal(kg, (cfg.d_model, cfg.n_experts), jnp.float32) * 0.02,
         "w1": jax.random.normal(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff),

@@ -22,10 +22,10 @@ by the multichip dryrun's ring+flash stage and tests/test_parallel.py).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -122,8 +122,10 @@ def _pallas_flash(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
     vf = v.reshape(B * H, Lk, Dh)
     n_kb = Lk // block_k
 
+    # math.sqrt: weak Python float — np.sqrt's strong float64 scalar would
+    # promote the f32 score block to f64 under x64 (GL-RETRACE-DTYPE)
     kernel = functools.partial(_flash_kernel, causal=causal, block_q=block_q,
-                               block_k=block_k, scale=1.0 / np.sqrt(Dh),
+                               block_k=block_k, scale=1.0 / math.sqrt(Dh),
                                n_kb=n_kb, return_stats=return_stats)
     out_shape = [jax.ShapeDtypeStruct((B * H, Lq, Dh),
                                       jnp.float32 if return_stats else q.dtype)]
@@ -168,7 +170,7 @@ def _dense_stats_ref(q, k, v, bias, causal: bool):
     so gradients recompute the block densely (correct everywhere; a tiled
     backward kernel is the remaining optimization)."""
     Dh = q.shape[-1]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)
     scores = scores + bias[:, :, None, :].astype(jnp.float32)
     if causal:
         Lq, Lk = q.shape[2], k.shape[2]

@@ -15,7 +15,7 @@ XLA sees a fixed unrolled schedule, no data-dependent branching.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable
 
 import jax
@@ -42,23 +42,22 @@ def stack_stage_params(block_params: list, n_stages: int):
     return jax.tree_util.tree_map(stack, *block_params)
 
 
-def pipeline_apply(stage_params, x: jax.Array, stage_fn: Callable,
-                   mesh: Mesh, *, n_microbatches: int, pp_axis: str = "pp"):
-    """Run x [B, ...] through all stages; returns [B, ...] (replicated).
-
-    stage_params: pytree with leading [S, per_stage, ...] axes, sharded so
-    each device holds its own stage slice. stage_fn(local_params, x) applies
-    one stage's layers to a microbatch (local_params has leading [per_stage]).
-    """
+@lru_cache(maxsize=8)
+def _build_pipe_run(stage_fn: Callable, mesh: Mesh, pp_axis: str,
+                    n_microbatches: int, treedef):
+    """Jitted shard_map schedule, memoized per (stage_fn, mesh, schedule
+    shape). The old per-call closure rebuilt — and re-traced — the whole
+    unrolled wavefront on every ``pipeline_apply`` call
+    (GL-RETRACE-UNBUCKETED). ``treedef`` (hashable) pins the stage-param
+    structure the in_specs are built over; function objects hash by
+    identity, so a caller defining ``stage_fn`` inline pays one build per
+    definition while stable stage_fns share the cache."""
     S = mesh.shape[pp_axis]
     M = n_microbatches
-    B = x.shape[0]
-    if B % M:
-        raise ValueError(f"batch {B} not divisible into {M} microbatches")
-    micro = x.reshape((M, B // M) + x.shape[1:])
+    spec_params = jax.tree_util.tree_unflatten(
+        treedef, [P(pp_axis)] * treedef.num_leaves)
 
-    spec_params = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
-
+    @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(spec_params, P()), out_specs=P(),
              check_vma=False)
     def run(stage_params, micro):
@@ -88,5 +87,23 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn: Callable,
         # out is non-zero only on the last stage; psum replicates it.
         return jax.lax.psum(out, pp_axis)
 
+    return run
+
+
+def pipeline_apply(stage_params, x: jax.Array, stage_fn: Callable,
+                   mesh: Mesh, *, n_microbatches: int, pp_axis: str = "pp"):
+    """Run x [B, ...] through all stages; returns [B, ...] (replicated).
+
+    stage_params: pytree with leading [S, per_stage, ...] axes, sharded so
+    each device holds its own stage slice. stage_fn(local_params, x) applies
+    one stage's layers to a microbatch (local_params has leading [per_stage]).
+    """
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    micro = x.reshape((M, B // M) + x.shape[1:])
+    treedef = jax.tree_util.tree_structure(stage_params)
+    run = _build_pipe_run(stage_fn, mesh, pp_axis, M, treedef)
     result = run(stage_params, micro)
     return result.reshape((B,) + x.shape[1:])

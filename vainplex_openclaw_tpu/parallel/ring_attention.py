@@ -16,11 +16,11 @@ for the flagship encoder's long-context path (models/long_context.py).
 
 from __future__ import annotations
 
-from functools import partial
+import math
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:
@@ -60,7 +60,9 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
     use_flash = impl == "flash" and not causal and scale is None
-    scale = scale if scale is not None else 1.0 / np.sqrt(Dh)
+    # math.sqrt: weak Python float (np.sqrt's strong float64 scalar would
+    # flip the f32 score math to f64 under x64 — GL-RETRACE-DTYPE)
+    scale = scale if scale is not None else 1.0 / math.sqrt(Dh)
 
     m = jnp.full((B, H, Lq), NEG_INF, jnp.float32)
     l = jnp.zeros((B, H, Lq), jnp.float32)
@@ -121,16 +123,18 @@ def ring_attention_local(q, k, v, kv_mask, *, axis_name: str, causal: bool = Fal
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def ring_attention(q, k, v, kv_mask, mesh: Mesh, *, dp_axis: str = "dp",
-                   sp_axis: str = "sp", causal: bool = False,
-                   impl: str = "auto"):
-    """Sharded exact attention: q/k/v [B, H, L, Dh] sharded (dp, -, sp, -),
-    kv_mask [B, L] sharded (dp, sp). Returns out with q's sharding.
-    ``impl`` selects the per-rotation block kernel (see
-    ``ring_attention_local``): flash-tiled on TPU, dense-XLA elsewhere."""
+@lru_cache(maxsize=16)
+def _build_ring(mesh: Mesh, dp_axis: str, sp_axis: str, causal: bool,
+                impl: str):
+    """Jitted shard_map runner, memoized per (mesh, axes, causal, impl).
+    Building the closure per ``ring_attention`` call handed every call a
+    FRESH compile cache — a guaranteed whole-network retrace per request
+    (GL-RETRACE-UNBUCKETED); Mesh is hashable, so equal meshes share one
+    compiled runner and repeat calls hit the jit cache."""
     qkv_spec = P(dp_axis, None, sp_axis, None)
     mask_spec = P(dp_axis, sp_axis)
 
+    @jax.jit
     @partial(shard_map, mesh=mesh,
              in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
              out_specs=qkv_spec, check_vma=False)
@@ -138,14 +142,25 @@ def ring_attention(q, k, v, kv_mask, mesh: Mesh, *, dp_axis: str = "dp",
         return ring_attention_local(q, k, v, kv_mask, axis_name=sp_axis,
                                     causal=causal, impl=impl)
 
-    return run(q, k, v, kv_mask)
+    return run
+
+
+def ring_attention(q, k, v, kv_mask, mesh: Mesh, *, dp_axis: str = "dp",
+                   sp_axis: str = "sp", causal: bool = False,
+                   impl: str = "auto"):
+    """Sharded exact attention: q/k/v [B, H, L, Dh] sharded (dp, -, sp, -),
+    kv_mask [B, L] sharded (dp, sp). Returns out with q's sharding.
+    ``impl`` selects the per-rotation block kernel (see
+    ``ring_attention_local``): flash-tiled on TPU, dense-XLA elsewhere."""
+    return _build_ring(mesh, dp_axis, sp_axis, causal, impl)(
+        q, k, v, kv_mask)
 
 
 def dense_attention_reference(q, k, v, kv_mask, *, causal: bool = False):
     """Single-device exact attention, for parity tests and small inputs."""
     Dh = q.shape[-1]
     L = q.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(Dh)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)
     keep = kv_mask[:, None, None, :]
     if causal:
         pos = jnp.arange(L)
