@@ -898,6 +898,232 @@ def _cluster_cli(argv: list) -> dict:
     return bench_cluster_scaling(**kwargs)
 
 
+def hibernation_stage_records(stage_quantiles: dict) -> list[dict]:
+    """One line per lifecycle stage (snapshot/compress/demote/wake) — the
+    hibernation costs pre-attributed like every stage family."""
+    return [{"metric": "hibernation_stage_ms", "stage": name, "unit": "ms",
+             **qd}
+            for name, qd in (stage_quantiles or {}).items()]
+
+
+def _hibernation_workload(seed: int, n_ops: int, n_workspaces: int):
+    """Seeded zipf tenant draws over a ``n_workspaces``-sized id space: the
+    head stays resident, the tail wakes and hibernates — exactly the
+    millions-of-cold-workspaces shape ROADMAP item 4 names."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ranks = np.minimum(rng.zipf(1.3, size=n_ops), n_workspaces)
+    msgs = [
+        "let's discuss the deploy pipeline",
+        "for the billing rollout we decided to go with plan B",
+        "I'll finish the search index tomorrow",
+        "random chatter about nothing in particular",
+    ]
+    pick = rng.integers(0, len(msgs), size=n_ops)
+    return [(int(r), msgs[int(p)]) for r, p in zip(ranks, pick)]
+
+
+def _hibernation_pass(root, seed: int, n_ops: int, n_workspaces: int,
+                      max_resident: "int | None") -> dict:
+    """One steady-state pass over the real gateway+cortex stack.
+    ``max_resident=None`` = hibernation off (every workspace stays
+    resident — the legacy memory shape). Heap deltas come from tracemalloc
+    (allocator-level, stable on a noisy container where RSS is not)."""
+    import gc
+    import pathlib
+    import tracemalloc
+
+    from vainplex_openclaw_tpu.core import Gateway
+    from vainplex_openclaw_tpu.cortex import CortexPlugin
+    from vainplex_openclaw_tpu.storage.journal import reset_journals
+
+    ops = _hibernation_workload(seed, n_ops, n_workspaces)
+    root = pathlib.Path(root)
+    lifecycle_cfg = ({"maxResident": max_resident} if max_resident
+                     else False)
+    gw = Gateway(config={"workspace": str(root)})
+    plugin = CortexPlugin(wall_timers=False)
+    gw.load(plugin, plugin_config={
+        "languages": ["en"], "registerTools": False,
+        "storage": {"journal": True, "lifecycle": lifecycle_cfg}})
+    gw.start()
+    gc.collect()
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    t0 = time.perf_counter()
+    for rank, msg in ops:
+        gw.message_received(msg, {"workspace": str(root / f"w{rank:06d}")})
+    elapsed = time.perf_counter() - t0
+    gc.collect()
+    heap = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+    resident = len(plugin._trackers)
+    stats = plugin.lifecycle.stats() if plugin.lifecycle is not None else {}
+    quantiles = (plugin.lifecycle.timer.snapshot()["quantiles"]
+                 if plugin.lifecycle is not None else {})
+    gw.stop()
+    reset_journals()
+    return {"heap_mb": round(heap / 1e6, 3), "resident": resident,
+            "msg_s": round(n_ops / elapsed, 1) if elapsed else 0.0,
+            "lifecycle": stats, "quantiles": quantiles}
+
+
+def _hibernation_recovery_pass(root, depth: int, msgs_per_depth: int,
+                               lifecycle_on: bool) -> dict:
+    """Recovery cost at one journal-history depth: write ``depth`` rounds
+    of tracker history + an append-stream record per message (the
+    audit/event shape — the streams whose wal footprint actually grows
+    with history; snapshot streams coalesce), kill -9 (``abandon()`` —
+    buffered dropped, wal kept, no farewell meta), then time a cold open +
+    stream registration + tracker load. Legacy meta persists only at
+    rotation/close, so its recovery re-replays (and tail-dedupes) the
+    WHOLE history; a shipped snapshot's durable watermark bounds replay by
+    ``shipEveryRecords`` at EVERY depth — the replayed-record counts make
+    that gate deterministic where wall-clock on a noisy container is not."""
+    import pathlib
+
+    from vainplex_openclaw_tpu.cortex.patterns import MergedPatterns
+    from vainplex_openclaw_tpu.cortex.thread_tracker import ThreadTracker
+    from vainplex_openclaw_tpu.storage.atomic import jsonl_dumps
+    from vainplex_openclaw_tpu.storage.journal import (Journal,
+                                                       dedup_against_tail)
+    from vainplex_openclaw_tpu.storage.lifecycle import lifecycle_settings
+
+    class _Null:
+        def __getattr__(self, _name):
+            return lambda *a, **k: None
+
+    ws = pathlib.Path(root)
+    events = ws / "events.jsonl"
+
+    def sink(batch, dedup):
+        if dedup:
+            batch, _dropped = dedup_against_tail(events, batch)
+        if not batch:
+            return
+        with events.open("a", encoding="utf-8") as fh:
+            fh.write("".join(raw + "\n" for _q, raw, _m in batch))
+
+    def build(journal):
+        journal.register_append("events", sink, auto_compact=32)
+        tracker = ThreadTracker(ws, {}, patterns, _Null(), journal=journal)
+        return tracker
+
+    lc = lifecycle_settings(None) if lifecycle_on else None
+    if lc is not None:
+        lc["shipEveryRecords"] = 64
+    patterns = MergedPatterns(["en"], None, compiled=True)
+    j = Journal(ws / "journal", {"maxBatchRecords": 16}, wall=False,
+                lifecycle=lc)
+    tt = build(j)
+    n = 0
+    for r in range(depth):
+        for i in range(msgs_per_depth):
+            tt.process_message(
+                f"let's discuss the deploy pipeline v{r}.{i}", "user")
+            n += 1
+            j.append("events", raw=jsonl_dumps({"op": n, "round": r}))
+    j.abandon()  # kill -9: committed wal stays, nothing else runs
+    t0 = time.perf_counter()
+    j2 = Journal(ws / "journal", {"maxBatchRecords": 16}, wall=False,
+                 lifecycle=lc)
+    tt2 = build(j2)
+    ms = (time.perf_counter() - t0) * 1000.0
+    replay = j2.stats()["replay"]
+    n_threads = len(tt2.threads)
+    j2.close()
+    return {"ms": round(ms, 3),
+            "replayed": replay["records"] + replay["skipped"],
+            "records": replay["records"], "threads": n_threads}
+
+
+def bench_hibernation(n_ops: int = 3000, n_workspaces: int = 100_000,
+                      seed: int = 0, max_resident: int = 48,
+                      depths: tuple = (4, 16, 64),
+                      msgs_per_depth: int = 24) -> dict:
+    """Workspace lifecycle (ISSUE 11): steady-state memory under a seeded
+    zipf workload with hibernation on vs off, wake p50/p99, and — the
+    headline — recovery cost vs journal-history depth. ``value`` is the
+    on-path recovery flatness (max/min recovery ms across depths; ~1 means
+    failover/wake p99 is independent of history length, the ROADMAP item-4
+    acceptance). The deterministic form of the same claim rides in
+    ``recovery_records_on``: replayed records stay bounded by the ship
+    cadence at every depth while ``recovery_records_off`` grows linearly."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        on = _hibernation_pass(f"{tmp}/on", seed, n_ops, n_workspaces,
+                               max_resident)
+        off = _hibernation_pass(f"{tmp}/off", seed, n_ops, n_workspaces,
+                                None)
+        rec_on = {}
+        rec_off = {}
+        for d in depths:
+            rec_on[str(d)] = _hibernation_recovery_pass(
+                f"{tmp}/r-on-{d}", d, msgs_per_depth, True)
+            rec_off[str(d)] = _hibernation_recovery_pass(
+                f"{tmp}/r-off-{d}", d, msgs_per_depth, False)
+    on_ms = [r["ms"] for r in rec_on.values()]
+    off_ms = [r["ms"] for r in rec_off.values()]
+    flatness = round(max(on_ms) / max(min(on_ms), 1e-6), 3)
+    growth = round(max(off_ms) / max(min(off_ms), 1e-6), 3)
+    ls = on["lifecycle"]
+    return {
+        "metric": "hibernation",
+        "value": flatness,
+        "unit": "recovery_flatness_on",
+        "seed": seed,
+        "n_ops": n_ops,
+        "n_workspaces": n_workspaces,
+        "max_resident": max_resident,
+        "distinct_workspaces": off["resident"],
+        "resident_on": on["resident"],
+        "resident_off": off["resident"],
+        "heap_mb_on": on["heap_mb"],
+        "heap_mb_off": off["heap_mb"],
+        "heap_ratio_off_on": (round(off["heap_mb"] / on["heap_mb"], 2)
+                              if on["heap_mb"] else None),
+        "msg_s_on": on["msg_s"],
+        "msg_s_off": off["msg_s"],
+        "wakes": ls.get("wakes", 0),
+        "evictions": ls.get("evictions", 0),
+        "wake_p50_ms": ls.get("wakeP50Ms"),
+        "wake_p99_ms": ls.get("wakeP99Ms"),
+        "recovery_ms_on": {k: v["ms"] for k, v in rec_on.items()},
+        "recovery_ms_off": {k: v["ms"] for k, v in rec_off.items()},
+        "recovery_records_on": {k: v["replayed"] for k, v in rec_on.items()},
+        "recovery_records_off": {k: v["replayed"]
+                                 for k, v in rec_off.items()},
+        "recovery_flatness_on": flatness,
+        "recovery_growth_off": growth,
+        "lifecycle_stage_quantiles": on["quantiles"],
+        "vs_baseline": None,
+    }
+
+
+def _hibernation_cli(argv: list) -> dict:
+    """``python bench.py hibernation [--ops N] [--workspaces N] [--seed N]
+    [--resident N] [--depths 4,16,64]``"""
+    kwargs: dict = {}
+    flags = {"--ops": ("n_ops", int), "--workspaces": ("n_workspaces", int),
+             "--seed": ("seed", int), "--resident": ("max_resident", int)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--depths" and i + 1 < len(argv):
+            kwargs["depths"] = tuple(int(d)
+                                     for d in argv[i + 1].split(","))
+            i += 2
+            continue
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"hibernation: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    return bench_hibernation(**kwargs)
+
+
 # Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
 # Public figures; unknown kinds report mfu: null rather than a wrong number.
 _TPU_PEAK_BF16 = (
@@ -1503,6 +1729,16 @@ if __name__ == "__main__":
         # per-stage quantile lines ride on stderr like every secondary.
         rec = _cluster_cli(sys.argv[2:])
         for srec in cluster_stage_records(rec.get("cluster_stage_quantiles")):
+            print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+        print(json.dumps(rec, ensure_ascii=False))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "hibernation":
+        # Subcommand mode (ISSUE 11): ONE stdout line = the lifecycle
+        # record; per-stage quantile lines ride on stderr like every
+        # secondary.
+        rec = _hibernation_cli(sys.argv[2:])
+        for srec in hibernation_stage_records(
+                rec.get("lifecycle_stage_quantiles")):
             print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         print(json.dumps(rec, ensure_ascii=False))
         sys.exit(0)

@@ -1,0 +1,737 @@
+"""Workspace lifecycle suite (ISSUE 11): snapshot shipping, segment
+tiering, LRU hibernation.
+
+Four layers:
+
+- **Shipping** — durable watermarks on a record cadence: recovery after a
+  kill -9 starts from the shipped snapshot + wal tail, never the whole
+  history; a failed ship degrades to the PR-7 idempotent re-replay.
+- **Tiering** — rotated segments demote to a compressed ``cold/`` tier
+  (bounded fanout, bounded count) and round-trip byte-exactly; a stale
+  meta rehydrates them transparently; a fresh meta never decompresses one.
+- **Hibernation** — the wake-vs-never-slept oracle: a workspace evicted
+  and faulted back in N times must leave BYTE-IDENTICAL tracker state
+  (threads/decisions/commitments, knowledge facts) to one that never
+  slept, because the wake path IS the PR-7/PR-9 recovery path.
+- **Chaos** — seeded ``CHAOS_SEED`` storms over the three ``lifecycle.*``
+  fault sites (crash mid-snapshot / mid-demote / mid-wake) interleaved
+  with journal torn-write faults: zero escaped exceptions, deterministic
+  reruns, and recoverable state throughout. The ``slow``-marked mini-soak
+  drives 10k workspaces of zipf traffic through the full worker profile
+  gating bounded heap growth and zero verdict losses.
+"""
+
+import gzip
+import json
+import os
+import random
+
+import pytest
+
+from vainplex_openclaw_tpu.cluster.worker import InProcessWorker
+from vainplex_openclaw_tpu.core import Gateway
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.cortex import CortexPlugin
+from vainplex_openclaw_tpu.cortex.patterns import MergedPatterns
+from vainplex_openclaw_tpu.cortex.thread_tracker import ThreadTracker
+from vainplex_openclaw_tpu.knowledge.fact_store import FactStore
+from vainplex_openclaw_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                     installed)
+from vainplex_openclaw_tpu.sitrep.collectors import collect_lifecycle
+from vainplex_openclaw_tpu.storage.journal import (Journal, get_journal,
+                                                   peek_journal,
+                                                   reset_journals)
+from vainplex_openclaw_tpu.storage.lifecycle import (LIFECYCLE_DEFAULTS,
+                                                     LifecycleManager,
+                                                     lifecycle_settings)
+from vainplex_openclaw_tpu.utils import ids
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_700_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def lc_settings(**over):
+    s = lifecycle_settings(None)
+    s.update(over)
+    return s
+
+
+def make_journal(root, lifecycle=None, **settings):
+    return Journal(root / "journal", settings, wall=False,
+                   lifecycle=lifecycle)
+
+
+# ── settings resolution ──────────────────────────────────────────────
+
+
+class TestSettings:
+    def test_bool_and_dict_forms(self):
+        assert lifecycle_settings(None)["enabled"] is True
+        assert lifecycle_settings(
+            {"storage": {"lifecycle": False}})["enabled"] is False
+        got = lifecycle_settings(
+            {"storage": {"lifecycle": {"maxResident": 7,
+                                       "shipEveryRecords": 9}}})
+        assert got["enabled"] is True
+        assert got["maxResident"] == 7
+        assert got["shipEveryRecords"] == 9
+        assert got["tierFanout"] == LIFECYCLE_DEFAULTS["tierFanout"]
+
+    def test_unknown_keys_ignored(self):
+        got = lifecycle_settings({"storage": {"lifecycle": {"bogus": 1}}})
+        assert "bogus" not in got
+
+    def test_default_enabled_override(self):
+        assert lifecycle_settings({}, default_enabled=False)["enabled"] is False
+
+
+# ── snapshot shipping ────────────────────────────────────────────────
+
+
+class TestSnapshotShipping:
+    def test_recovery_starts_from_shipped_watermark_after_kill9(self, tmp_path):
+        """The satellite fix: meta persists at every ship, so a kill -9
+        replays only the post-ship tail — not the whole history."""
+        lc = lc_settings(shipEveryRecords=8)
+        j = make_journal(tmp_path, lifecycle=lc, maxBatchRecords=2)
+        appended = []
+
+        def sink(batch, dedup):
+            appended.extend(raw for _q, raw, _m in batch)
+
+        j.register_append("events", sink)
+        for i in range(50):
+            j.append("events", {"i": i})
+        assert j.stats()["lifecycle"]["ships"] >= 4
+        j.abandon()  # kill -9: committed wal stays, no farewell meta
+
+        j2 = make_journal(tmp_path, lifecycle=lc)
+        rep = j2.stats()["replay"]
+        # everything before the last ship is covered by the durable
+        # watermark: total lines even READ is bounded by the ship cadence
+        # + one commit batch, regardless of the 50-record history
+        assert rep["records"] + rep["skipped"] <= 12
+        j2.close()
+
+    def test_legacy_journal_replays_full_history(self, tmp_path):
+        """The escape-hatch oracle: without lifecycle the same sequence
+        re-reads every record (meta only lands at rotation/close)."""
+        j = make_journal(tmp_path, lifecycle=None, maxBatchRecords=2)
+        j.register_append("events", lambda batch, dedup: None)
+        for i in range(50):
+            j.append("events", {"i": i})
+        j.abandon()
+        j2 = make_journal(tmp_path, lifecycle=None)
+        rep = j2.stats()["replay"]
+        assert rep["records"] + rep["skipped"] == 50
+        assert j2.stats()["lifecycle"] is None
+        j2.close()
+
+    def test_ship_failure_counted_and_degrades_to_replay(self, tmp_path):
+        lc = lc_settings(shipEveryRecords=4)
+        snap = tmp_path / "state.json"
+        with installed(FaultPlan([FaultSpec("lifecycle.snapshot", rate=1.0)],
+                                 seed=CHAOS_SEED)):
+            j = make_journal(tmp_path, lifecycle=lc, maxBatchRecords=2)
+            j.register_snapshot("s", snap, indent=None)
+            for i in range(20):
+                assert j.append("s", {"i": i})
+            stats = j.stats()["lifecycle"]
+            assert stats["ships"] == 0
+            assert stats["shipFailures"] > 0
+            j.abandon()
+        # recovery still lands the newest state — shipping is a cost
+        # optimization, never a durability dependency
+        j2 = make_journal(tmp_path, lifecycle=lc)
+        j2.register_snapshot("s", snap, indent=None)
+        assert json.loads(snap.read_text())["i"] == 19
+        j2.close()
+
+    def test_ship_snapshot_rotates_shipped_prefix_cold(self, tmp_path):
+        lc = lc_settings(shipEveryRecords=1000)  # no auto-ship
+        j = make_journal(tmp_path, lifecycle=lc)
+        j.register_snapshot("s", tmp_path / "state.json", indent=None)
+        j.append("s", {"i": 1})
+        assert j.ship_snapshot()
+        stats = j.stats()["lifecycle"]
+        assert stats["ships"] == 1
+        assert stats["coldSegments"] == 1  # shipped prefix left the live wal
+        meta = json.loads((tmp_path / "journal" / "journal.meta.json")
+                          .read_text())
+        assert meta["watermarks"]["s"] == 1
+        j.close()
+
+
+# ── segment tiering ──────────────────────────────────────────────────
+
+
+class TestSegmentTiering:
+    def drive(self, tmp_path, lc, rounds=6, per_round=8):
+        j = make_journal(tmp_path, lifecycle=lc, maxBatchRecords=4,
+                         maxSegmentBytes=1)
+        # Spy on demotion to capture each segment's exact plain bytes at
+        # the moment it leaves the live wal (rotation fires inside commit
+        # once the segment outgrows maxSegmentBytes, so post-hoc reads of
+        # "the current segment" race it).
+        captured = {}
+        orig_demote = j._demote_segment
+
+        def spy(seg):
+            try:
+                captured[int(seg.name.split(".")[1])] = seg.read_bytes()
+            except (ValueError, IndexError, OSError):
+                pass
+            return orig_demote(seg)
+
+        j._demote_segment = spy
+        j.register_snapshot("s", tmp_path / "state.json", indent=None)
+        rng = random.Random(CHAOS_SEED)
+        for r in range(rounds):
+            for i in range(per_round):
+                j.append("s", {"r": r, "i": i,
+                               "pad": "x" * rng.randrange(10, 60)})
+            j.compact()  # > maxSegmentBytes → rotate + demote
+        return j, captured
+
+    def test_demoted_segments_round_trip_byte_exactly(self, tmp_path):
+        """Property: gunzip(cold copy) == the plain segment bytes at the
+        moment of rotation, for every demoted generation."""
+        j, originals = self.drive(tmp_path, lc_settings())
+        cold = dict(j.cold_segments())
+        assert cold, "nothing demoted"
+        for gen, original in originals.items():
+            if gen not in cold:
+                continue  # capped or still live
+            assert gzip.decompress(cold[gen].read_bytes()) == original, \
+                f"cold segment {gen} did not round-trip"
+        assert j.stats()["lifecycle"]["coldDemoted"] >= len(cold)
+        j.close()
+
+    def test_fanout_bounded_directories(self, tmp_path):
+        lc = lc_settings(tierFanout=4)
+        j, _ = self.drive(tmp_path, lc, rounds=9)
+        cold_dir = tmp_path / "journal" / "cold"
+        subdirs = [p.name for p in cold_dir.iterdir() if p.is_dir()]
+        assert subdirs and len(subdirs) <= 4
+        for gen, seg in j.cold_segments():
+            assert seg.parent.name == f"{gen % 4:02x}"
+        j.close()
+
+    def test_cold_cap_drops_oldest_counted(self, tmp_path):
+        lc = lc_settings(maxColdSegments=3)
+        j, _ = self.drive(tmp_path, lc, rounds=9)
+        cold = j.cold_segments()
+        assert len(cold) <= 3
+        stats = j.stats()["lifecycle"]
+        assert stats["coldDropped"] > 0
+        # survivors are the NEWEST generations
+        gens = [g for g, _p in cold]
+        assert gens == sorted(gens) and gens[-1] >= 6
+        j.close()
+
+    def test_stale_meta_rehydrates_fresh_meta_skips(self, tmp_path):
+        lc = lc_settings()
+        j, _ = self.drive(tmp_path, lc)
+        j.close()
+        root = tmp_path / "journal"
+        # fresh meta: recovery must not even decompress the cold tier
+        j2 = make_journal(tmp_path, lifecycle=lc)
+        assert j2.stats()["replay"]["cold_segments"] == 0
+        j2.close()
+        # lost meta (worst-case crash): cold history transparently
+        # rehydrates and the final state is still recoverable
+        (root / "journal.meta.json").unlink()
+        j3 = make_journal(tmp_path, lifecycle=lc)
+        rep = j3.stats()["replay"]
+        assert rep["cold_segments"] > 0
+        assert rep["records"] > 0
+        st = j3.register_snapshot("s", tmp_path / "state.json", indent=None)
+        assert st.compactions >= 1  # adoption completed the compaction
+        data = json.loads((tmp_path / "state.json").read_text())
+        assert data["r"] == 5 and data["i"] == 7  # the newest state
+        j3.close()
+
+    def test_demote_failure_goes_to_backlog_and_retries(self, tmp_path):
+        lc = lc_settings(shipEveryRecords=1000)
+        with installed(FaultPlan([FaultSpec("lifecycle.demote", steps=(1,))],
+                                 seed=CHAOS_SEED)):
+            j = make_journal(tmp_path, lifecycle=lc, maxBatchRecords=4,
+                             maxSegmentBytes=1)
+            j.register_snapshot("s", tmp_path / "state.json", indent=None)
+            j.append("s", {"i": 0, "pad": "x" * 40})
+            j.compact()  # rotation: first demote faults
+            stats = j.stats()["lifecycle"]
+            assert stats["demoteFailures"] == 1
+            assert stats["demoteBacklog"] == 1
+            # the plain segment is still on disk — never lose the only copy
+            assert list((tmp_path / "journal").glob("wal.000000.jsonl"))
+            # a ship retries the backlog (site no longer faulting: step 2+)
+            j.append("s", {"i": 1})
+            assert j.ship_snapshot()
+            stats = j.stats()["lifecycle"]
+            assert stats["demoteBacklog"] == 0
+            assert stats["coldDemoted"] >= 1
+            assert not list((tmp_path / "journal").glob("wal.000000.jsonl"))
+            j.close()
+
+    def test_legacy_rotation_leaves_no_cold_tier(self, tmp_path):
+        j = make_journal(tmp_path, lifecycle=None, maxBatchRecords=4,
+                         maxSegmentBytes=1)
+        j.register_snapshot("s", tmp_path / "state.json", indent=None)
+        for i in range(8):
+            j.append("s", {"i": i, "pad": "x" * 40})
+        j.compact()
+        assert j.rotations >= 1
+        assert not (tmp_path / "journal" / "cold").exists()
+        j.close()
+
+
+# ── hibernation: the wake-vs-never-slept oracle ──────────────────────
+
+
+WORDS = ["deploy", "pipeline", "billing", "search", "index", "cache",
+         "gateway", "rollout", "retries", "quota", "sharding", "backlog"]
+
+
+def lifecycle_message(rng):
+    kind = rng.random()
+    topic = f"the {rng.choice(WORDS)} {rng.choice(WORDS)}"
+    if kind < 0.3:
+        return f"let's talk about {topic}"
+    if kind < 0.5:
+        return f"for {topic} we decided to go with plan {rng.randrange(9)}"
+    if kind < 0.65:
+        return f"{topic} is done and shipped"
+    if kind < 0.8:
+        return f"I'll finish {topic} tomorrow"
+    return f"random chatter {rng.randrange(1000)} about nothing"
+
+
+def run_plugin_sequence(root, seed, max_resident, n_ws=4, n_msgs=60):
+    """Drive one gateway+cortex stack over ``n_ws`` workspaces with the
+    given residency cap; returns the tracker file bytes per workspace."""
+    ids._ID_RNG.seed(seed)
+    clock = FakeClock()
+    rng = random.Random(seed)
+    gw = Gateway(config={"workspace": str(root)}, clock=clock)
+    plugin = CortexPlugin(wall_timers=False, clock=clock)
+    gw.load(plugin, plugin_config={
+        "languages": ["en"], "registerTools": False,
+        "storage": {"journal": True,
+                    "lifecycle": {"maxResident": max_resident}}})
+    gw.start()
+    for i in range(n_msgs):
+        ws = str(root / f"t{rng.randrange(n_ws)}")
+        sender = rng.choice(["user", "agent"])
+        msg = lifecycle_message(rng)
+        if sender == "user":
+            gw.message_received(msg, {"workspace": ws})
+        else:
+            gw.message_sent(msg, {"workspace": ws})
+    stats = (plugin.lifecycle.stats() if plugin.lifecycle is not None
+             else {})
+    gw.stop()
+    reset_journals()
+    out = {}
+    for t in range(n_ws):
+        for name in ("threads.json", "decisions.json", "commitments.json"):
+            p = root / f"t{t}" / "memory" / "reboot" / name
+            out[f"t{t}/{name}"] = p.read_bytes() if p.exists() else b""
+    return out, stats
+
+
+class TestHibernationOracle:
+    def test_wake_vs_never_slept_byte_identical_all_trackers(self, tmp_path):
+        """A workspace that hibernated and woke dozens of times must leave
+        byte-identical threads/decisions/commitments files to one that
+        never slept — across every tracker kind, for multiple seeds."""
+        for seed in range(4):
+            slept, stats = run_plugin_sequence(
+                tmp_path / f"sleep{seed}", seed, max_resident=1)
+            awake, _ = run_plugin_sequence(
+                tmp_path / f"awake{seed}", seed, max_resident=1000)
+            assert stats["evictions"] > 5, "cap never engaged"
+            assert stats["wakes"] > 5, "nothing ever woke"
+            assert slept == awake, f"state diverged for seed {seed}"
+            assert any(slept.values()), "sequence produced no state"
+
+    def test_fact_store_hibernate_wake_equivalent(self, tmp_path):
+        def run(root, cycles):
+            ids._ID_RNG.seed(11)
+            clock = FakeClock()
+            store = FactStore(root, {"writeDebounceMs": 0}, list_logger(),
+                              clock=clock, wall_timers=False)
+            store.load()
+            rng = random.Random(11)
+            for i in range(40):
+                store.add_fact(f"s{rng.randrange(8)}", "likes",
+                               f"o{rng.randrange(12)}")
+                if cycles and i % 7 == 6:
+                    store.hibernate()
+                    assert not store.loaded and store.count() == 0
+                    store.load()
+            store.flush()
+            return (root / "knowledge" / "facts.json").read_bytes()
+
+        a = run(tmp_path / "cycled", cycles=True)
+        b = run(tmp_path / "straight", cycles=False)
+        assert a == b and a
+
+    def test_failed_hibernate_keeps_workspace_resident(self, tmp_path):
+        manager = LifecycleManager({"maxResident": 1}, clock=FakeClock())
+
+        def bad():
+            raise OSError("disk gone")
+
+        manager.register("a", bad)
+        assert not manager.hibernate("a")
+        assert manager.stats()["hibernateFailures"] == 1
+        assert manager.stats()["resident"] == 1  # NOT dropped
+        assert not manager.is_sleeping("a")
+
+    def test_idle_eviction_via_idle_victims(self):
+        clock = FakeClock()
+        manager = LifecycleManager({"idleSeconds": 10}, clock=clock)
+        done = []
+        manager.register("a", lambda: done.append("a"))
+        manager.note_traffic("a")
+        assert manager.idle_victims() == []
+        clock.advance(11)
+        assert manager.idle_victims() == ["a"]
+        assert manager.hibernate("a")
+        assert done == ["a"] and manager.is_sleeping("a")
+
+    def test_hibernate_drops_owner_closures_and_bounds_sleep_markers(self):
+        """Review catch (ISSUE 11): the manager's own bookkeeping must not
+        be the unbounded-growth shape it removes — owner callbacks drop at
+        eviction (re-registered on wake) and the sleeping-marker set is
+        bounded, aging out oldest-first."""
+        clock = FakeClock()
+        manager = LifecycleManager({"maxResident": 1}, clock=clock)
+        manager._sleep_cap = 3  # exercise the bound without 16× churn
+        for i in range(6):
+            ws = f"w{i}"
+            manager.register(ws, lambda: None, owner="cortex")
+            assert manager.hibernate(ws)
+            assert ws not in manager._owners  # no pinned closures asleep
+        assert len(manager._sleeping) == 3
+        assert not manager.is_sleeping("w0")  # aged out, uncounted wake
+        assert manager.is_sleeping("w5")
+
+    def test_fact_store_ingest_racing_hibernate_never_persists_empty(
+            self, tmp_path):
+        """Review catch (ISSUE 11): the evict holds the store lock end to
+        end, so an ingest serializes entirely before (flushed with the
+        rest) or entirely after (ordinary not-loaded error / reload) — a
+        reload can never slip between the flush and the clear and have the
+        debounced save persist an empty store."""
+        store = FactStore(tmp_path, {"writeDebounceMs": 0}, list_logger(),
+                          wall_timers=False)
+        store.load()
+        store.add_fact("s", "p", "o")
+        real_flush = store.storage.flush_all
+        raced = {}
+
+        def reload_mid_evict():
+            # what the gateway thread would do if the lock were released
+            # mid-evict; under the fixed single-critical-section evict this
+            # runs REENTRANTLY (same thread holds the RLock) and must see
+            # the store still fully loaded, pre-clear
+            raced["loaded"] = store.loaded
+            raced["count"] = store.count()
+            real_flush()
+
+        store.storage.flush_all = reload_mid_evict
+        store.hibernate()
+        assert raced == {"loaded": True, "count": 1}
+        store.storage.flush_all = real_flush
+        store.load()
+        assert store.count() == 1  # the flushed snapshot, never empty
+        facts = json.loads(
+            (tmp_path / "knowledge" / "facts.json").read_text())["facts"]
+        assert len(facts) == 1
+
+    def test_lru_eviction_order(self):
+        clock = FakeClock()
+        manager = LifecycleManager({"maxResident": 2}, clock=clock)
+        for ws in ("a", "b", "c"):
+            manager.register(ws, lambda: None)
+            manager.note_traffic(ws)
+            clock.advance(1)
+        victims = manager.note_traffic("d")
+        assert victims and victims[0] == "a"  # least recently used first
+
+
+# ── escape hatch end-to-end ──────────────────────────────────────────
+
+
+class TestEscapeHatch:
+    def test_lifecycle_false_restores_legacy_end_to_end(self, tmp_path):
+        gw = Gateway(config={"workspace": str(tmp_path)})
+        plugin = CortexPlugin(wall_timers=False)
+        gw.load(plugin, plugin_config={
+            "languages": ["en"],
+            "storage": {"journal": True, "lifecycle": False}})
+        gw.start()
+        for i in range(6):
+            gw.message_received(f"let's discuss the deploy pipeline v{i}",
+                                {"workspace": str(tmp_path / f"t{i}")})
+        assert plugin.lifecycle is None
+        assert len(plugin._trackers) == 6  # nothing ever evicts
+        assert gw.get_status()["lifecycle"] == {}
+        tr = plugin.trackers({"workspace": str(tmp_path / "t0")})
+        assert tr.journal is not None
+        assert tr.journal.lifecycle is None  # journal kept PR-7 behavior
+        assert tr.journal.stats()["lifecycle"] is None
+        gw.stop()
+        reset_journals()
+
+
+# ── seeded chaos storm over the lifecycle fault sites ────────────────
+
+
+class TestLifecycleChaos:
+    N = 120
+
+    def run_storm(self, root, seed):
+        ids._ID_RNG.seed(seed)
+        clock = FakeClock()
+        rng = random.Random(seed)
+        plan = FaultPlan([
+            FaultSpec("lifecycle.snapshot", rate=0.25),
+            FaultSpec("lifecycle.demote", rate=0.3),
+            FaultSpec("lifecycle.wake", rate=0.25),
+            FaultSpec("journal.append", rate=0.08, mode="torn"),
+            FaultSpec("journal.fsync", rate=0.1),
+        ], seed=seed)
+        with installed(plan):
+            gw = Gateway(config={"workspace": str(root)}, clock=clock)
+            plugin = CortexPlugin(wall_timers=False, clock=clock)
+            gw.load(plugin, plugin_config={
+                "languages": ["en"], "registerTools": False,
+                "storage": {
+                    "journal": {"maxBatchRecords": 8},
+                    "lifecycle": {"maxResident": 2,
+                                  "shipEveryRecords": 16}}})
+            gw.start()
+            for i in range(self.N):
+                ws = str(root / f"t{rng.randrange(5)}")
+                # the gateway hooks are fail-open: NOTHING may escape, not
+                # even a wake crash mid-eviction-storm
+                gw.message_received(lifecycle_message(rng),
+                                    {"workspace": ws})
+            stats = (plugin.lifecycle.stats()
+                     if plugin.lifecycle is not None else {})
+            gw.stop()
+        reset_journals()
+        # recovery after the storm: every workspace's state loads clean
+        recovered = {}
+        for t in range(5):
+            ws = root / f"t{t}"
+            p = ws / "memory" / "reboot" / "threads.json"
+            recovered[f"t{t}"] = p.read_bytes() if p.exists() else b""
+        return {"fired": dict(plan.fired),
+                "evictions": stats.get("evictions"),
+                "wakes": stats.get("wakes"),
+                "failures": stats.get("hibernateFailures"),
+                "recovered": recovered}
+
+    def test_storm_survives_and_faults_fired(self, tmp_path):
+        got = self.run_storm(tmp_path / "a", CHAOS_SEED)
+        fired = got["fired"]
+        assert any(site.startswith("lifecycle.") for site in fired), fired
+        assert got["evictions"] > 0
+        assert any(got["recovered"].values()), "storm left no state at all"
+
+    def test_storm_deterministic_per_seed(self, tmp_path):
+        a = self.run_storm(tmp_path / "a", CHAOS_SEED)
+        b = self.run_storm(tmp_path / "b", CHAOS_SEED)
+        assert a == b, "same-seed lifecycle storms diverged"
+
+    def test_different_seed_different_storm(self, tmp_path):
+        a = self.run_storm(tmp_path / "a", CHAOS_SEED)
+        c = self.run_storm(tmp_path / "c", CHAOS_SEED + 23)
+        assert a["fired"] != c["fired"] or \
+            a["recovered"] != c["recovered"]
+
+
+# ── cluster integration: wake re-arms the fence ──────────────────────
+
+
+class TestClusterWakeFencing:
+    def test_woken_tenant_journal_is_fenced(self, tmp_path):
+        clock = FakeClock()
+        worker = InProcessWorker(
+            "w0", tmp_path / "w0", clock=clock, ack_every=4,
+            wall_timers=False,
+            journal_cfg={"maxBatchRecords": 1_000_000, "windowMs": 0},
+            lifecycle_cfg={"maxResident": 1})
+        ws_a = str(tmp_path / "w0" / "tenants" / "a")
+        ws_b = str(tmp_path / "w0" / "tenants" / "b")
+        worker.add_workspace(ws_a, 3)
+        worker.add_workspace(ws_b, 5)
+        seq = 0
+        for i in range(6):
+            # alternate tenants: maxResident=1 hibernates the other one
+            # every op, so every delivery is a wake
+            ws = ws_a if i % 2 == 0 else ws_b
+            seq += 1
+            worker.deliver(seq, {"ws": ws, "wsKey": os.path.basename(ws),
+                                 "kind": "msg_in",
+                                 "content": f"let's discuss the deploy v{i}",
+                                 "i": i})
+            journal = peek_journal(ws)
+            assert journal is not None
+            # the wake re-armed the fence at the worker's lease epoch —
+            # without this a partitioned zombie's woken tenant would
+            # write unfenced (the ISSUE-9 split-brain reopened)
+            assert journal.fence_epoch == (3 if ws == ws_a else 5)
+        assert worker.cortex.lifecycle.stats()["evictions"] >= 4
+        worker.stop()
+        reset_journals()
+
+
+# ── ops surface ──────────────────────────────────────────────────────
+
+
+class TestOpsSurface:
+    def test_collector_skipped_without_gateway(self):
+        got = collect_lifecycle({}, {})
+        assert got["status"] == "skipped"
+
+    def test_collector_ok_and_warn_paths(self):
+        status = {"lifecycle": {"cortex": {
+            "resident": 3, "hibernated": 7, "wakes": 12, "evictions": 9,
+            "hibernateFailures": 0, "wakeP50Ms": 1.0, "wakeP99Ms": 2.0}},
+            "journal": {"journal:/ws": {"lifecycle": {
+                "ships": 4, "shipFailures": 0, "coldSegments": 2,
+                "coldBytes": 512, "demoteBacklog": 0,
+                "demoteFailures": 0}}}}
+        got = collect_lifecycle({}, {"gateway_status": lambda: status})
+        assert got["status"] == "ok"
+        assert "3 resident / 7 hibernated" in got["summary"]
+        assert "2 cold segments" in got["summary"]
+        status["journal"]["journal:/ws"]["lifecycle"]["demoteBacklog"] = 4
+        got = collect_lifecycle({}, {"gateway_status": lambda: status})
+        assert got["status"] == "warn"
+        assert "demoteBacklog=4" in got["summary"]
+
+    def test_gateway_status_and_ops_render(self, tmp_path):
+        from vainplex_openclaw_tpu.sitrep.plugin import SitrepPlugin
+
+        gw = Gateway(config={"workspace": str(tmp_path)})
+        plugin = CortexPlugin(wall_timers=False)
+        gw.load(plugin, plugin_config={
+            "languages": ["en"], "registerTools": False,
+            "storage": {"journal": True,
+                        "lifecycle": {"maxResident": 1}}})
+        sitrep = SitrepPlugin(workspace=str(tmp_path), wall_timers=False)
+        gw.load(sitrep, plugin_config={"intervalMinutes": 0})
+        gw.start()
+        for t in range(3):
+            gw.message_received("let's discuss the deploy pipeline",
+                                {"workspace": str(tmp_path / f"t{t}")})
+        report = sitrep.ops_report()
+        lc = report["collectors"]["lifecycle"]
+        assert lc["status"] in ("ok", "warn")
+        assert "hibernated" in lc["summary"]
+        text = sitrep.ops_text()
+        assert "lifecycle:" in text
+        gw.stop()
+        reset_journals()
+
+
+# ── mini-soak (slow marker; the CI lifecycle-soak job runs this) ─────
+
+
+@pytest.mark.slow
+class TestLifecycleSoak:
+    def test_10k_workspace_zipf_soak_bounded_heap_zero_verdict_losses(
+            self, tmp_path):
+        """10k-workspace id space, zipf traffic, full worker profile
+        (governance credential guard + redaction + cortex): the resident
+        set stays at the cap, allocator heap growth flattens once the
+        working set is faulted in, and every verdict-bearing op lands —
+        denials denied, secrets redacted — throughout the eviction churn."""
+        import gc
+        import tracemalloc
+
+        from vainplex_openclaw_tpu.cluster.worker import (
+            build_worker_gateway, dispatch_op)
+
+        try:
+            import numpy as np
+
+            nrng = np.random.default_rng(3)
+            ranks = [int(r) for r in
+                     np.minimum(nrng.zipf(1.3, size=1200), 10_000)]
+        except ImportError:  # numpy is baked in, but stay honest
+            r = random.Random(3)
+            ranks = [min(int(r.paretovariate(0.3)), 10_000)
+                     for _ in range(1200)]
+
+        def run(root, lifecycle_cfg):
+            rng = random.Random(3)
+            gw, cortex, _gov = build_worker_gateway(
+                root, "w0", wall_timers=False,
+                journal_cfg=True, lifecycle_cfg=lifecycle_cfg)
+            denied = secrets = 0
+            gc.collect()
+            tracemalloc.start()
+            base = tracemalloc.get_traced_memory()[0]
+            for i, rank in enumerate(ranks):
+                ws = str(root / "tenants" / f"t{rank:05d}")
+                ctx = {"workspace": ws, "agent_id": "w0",
+                       "session_key": "agent:w0:soak"}
+                r = rng.random()
+                if r < 0.7:
+                    dispatch_op(gw, "msg_in", lifecycle_message(rng), ctx)
+                elif r < 0.85:
+                    obs = dispatch_op(gw, "tool_denied",
+                                      "/home/user/.env", ctx)
+                    assert obs["blocked"] is True, f"verdict lost at op {i}"
+                    denied += 1
+                else:
+                    obs = dispatch_op(
+                        gw, "tool_secret",
+                        f"export API_KEY=sk-{'a' * 20}{i % 10}", ctx)
+                    assert obs["redacted"] is True, \
+                        f"redaction lost at op {i}"
+                    secrets += 1
+            gc.collect()
+            heap = tracemalloc.get_traced_memory()[0] - base
+            tracemalloc.stop()
+            stats = (cortex.lifecycle.stats()
+                     if cortex.lifecycle is not None else {})
+            resident = len(cortex._trackers)
+            gw.stop()
+            reset_journals()
+            return heap, resident, stats, denied, secrets
+
+        heap_on, resident_on, stats, denied, secrets = run(
+            tmp_path / "on", {"maxResident": 32})
+        heap_off, resident_off, _off_stats, denied_off, secrets_off = run(
+            tmp_path / "off", False)
+        # verdict integrity held through the eviction churn (and without)
+        assert denied > 20 and secrets > 10
+        assert (denied, secrets) == (denied_off, secrets_off)
+        # residency bounded at the cap; the legacy shape keeps every
+        # distinct tenant live
+        assert resident_on <= 32
+        assert resident_off > 150
+        assert stats["evictions"] > 50 and stats["wakes"] > 20
+        # bounded steady-state heap: a sleeping workspace costs recency
+        # bookkeeping (~bytes), not live trackers (~tens of KB) — the
+        # hibernating run must hold well under half the legacy heap
+        assert heap_on < heap_off * 0.5, (heap_on, heap_off)

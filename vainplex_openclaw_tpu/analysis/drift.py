@@ -57,6 +57,9 @@ CONFIG_SITES: tuple = (
     ("vainplex_openclaw_tpu/cluster/supervisor.py",
      ("CLUSTER_DEFAULTS",), ("cfg", "self.cfg"),
      None),
+    ("vainplex_openclaw_tpu/storage/lifecycle.py",
+     ("LIFECYCLE_DEFAULTS",), ("s", "raw", "self.settings"),
+     ("lifecycle_settings", "__init__")),
 )
 
 

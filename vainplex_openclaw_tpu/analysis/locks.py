@@ -90,7 +90,9 @@ GUARDED: tuple = (
             "_commit_lock": ("_marks", "_fh", "_wal_bytes", "_gen",
                              "_meta_dirty", "_wal_tail_dirty",
                              "_fenced", "fence_rejected", "fence_path",
-                             "fence_epoch"),
+                             "fence_epoch", "_records_since_ship", "ships",
+                             "ship_failures", "cold_demoted", "cold_dropped",
+                             "demote_failures", "_demote_backlog"),
         },
         # _streams: registration writes race _drain_pending's iteration;
         # point reads (dict probe) are GIL-atomic and stay unflagged.
@@ -98,19 +100,36 @@ GUARDED: tuple = (
         # fence state (ISSUE 9): written only under the commit lock
         # (set_fence / the commit-time check); append's fast-path read of
         # _fenced and stats()' counter reads are torn-tolerant scalars.
+        # lifecycle state (ISSUE 11): ship/demote counters and the demote
+        # backlog are commit-lock-owned; _lifecycle_stats()' reads are
+        # torn-tolerant scalars/len probes, declared write_only like the
+        # other stats counters.
         write_only=("_streams", "_wal_bytes", "_gen",
-                    "_fenced", "fence_rejected", "fence_path", "fence_epoch"),
+                    "_fenced", "fence_rejected", "fence_path", "fence_epoch",
+                    "_records_since_ship", "ships", "ship_failures",
+                    "cold_demoted", "cold_dropped", "demote_failures",
+                    "_demote_backlog"),
         holders={
             "_open": ("_commit_lock",),
+            # _open-only recovery helpers (construction-time, like _open).
+            "_replay_record": ("_commit_lock",),
+            "_rehydrate_cold": ("_commit_lock",),
             "_adopt_recovered": ("_commit_lock",),
             "_spill_locked": ("_commit_lock", "_buffer_lock"),
             "_write_meta": ("_commit_lock",),
             "_maybe_rotate": ("_commit_lock",),
+            # Lifecycle (ISSUE 11): ship/demote run only from commit(),
+            # compact()-adjacent paths and _maybe_rotate — all commit-lock
+            # holders.
+            "_ship_locked": ("_commit_lock",),
+            "_demote_segment": ("_commit_lock",),
+            "_retry_demotes": ("_commit_lock",),
+            "_cap_cold_tier": ("_commit_lock",),
             # commit() takes _commit_lock via acquire()/release() (the
             # non-blocking group_wait probe needs the manual form).
             "commit": ("_commit_lock",),
         },
-        init_only=("_open",),
+        init_only=("_open", "_replay_record", "_rehydrate_cold"),
         hot=("_buffer_lock",),
     ),
     GuardSpec(
@@ -125,7 +144,10 @@ GUARDED: tuple = (
         hot=("_facts_lock",),
         # load() reads facts.json under the lock once at startup — blocking
         # there is serialization of first use, not a serving-path convoy.
-        allow_blocking=("load",),
+        # hibernate() (ISSUE 11) flushes under the lock for the same
+        # reason inverted: eviction is an idle-path event, and releasing
+        # the lock mid-evict lets a reload race the clear.
+        allow_blocking=("load", "hibernate"),
     ),
     GuardSpec(
         module="vainplex_openclaw_tpu/knowledge/embeddings.py",
@@ -183,6 +205,16 @@ GUARDED: tuple = (
     GuardSpec(
         module="vainplex_openclaw_tpu/cluster/ring.py", cls="LeaseTable",
         locks={"_lock": ("_leases",)},
+        hot=("_lock",),
+    ),
+    # Workspace lifecycle (ISSUE 11): recency bookkeeping is read by the
+    # ingest path per message — hot, and eviction callbacks (journal close,
+    # tracker flush: blocking I/O) deliberately run OUTSIDE it.
+    GuardSpec(
+        module="vainplex_openclaw_tpu/storage/lifecycle.py",
+        cls="LifecycleManager",
+        locks={"_lock": ("_resident", "_owners", "_timers", "_sleeping",
+                         "wakes", "evictions", "hibernate_failures")},
         hot=("_lock",),
     ),
 )

@@ -95,6 +95,7 @@ class ClusterSupervisor:
                  transport=None, logger=None,
                  worker_mode: str = "inproc", wall_timers: bool = True,
                  settable_clock: Any = None, journal_cfg: Any = True,
+                 lifecycle_cfg: Any = True,
                  on_result: Optional[Callable[[dict, dict], None]] = None):
         cfg = dict(CLUSTER_DEFAULTS)
         cfg.update(config or {})
@@ -106,6 +107,10 @@ class ClusterSupervisor:
         self.wall_timers = wall_timers
         self.settable_clock = settable_clock
         self.journal_cfg = journal_cfg
+        # Workspace lifecycle (ISSUE 11): with the default settings a new
+        # owner's recovery loads the last shipped snapshot + wal tail —
+        # failover cost tracks the ship cadence, not the journal's age.
+        self.lifecycle_cfg = lifecycle_cfg
         self.on_result = on_result or (lambda op, obs: None)
         self.timer = StageTimer()
         self.ring = HashRing(int(cfg.get("vnodes", 160)))
@@ -150,14 +155,16 @@ class ClusterSupervisor:
         if self.worker_mode == "process":
             return ProcessWorker(worker_id, worker_root, self._result_q,
                                  ack_every=int(self.cfg.get("ackEveryOps", 16)),
-                                 journal_cfg=self.journal_cfg)
+                                 journal_cfg=self.journal_cfg,
+                                 lifecycle_cfg=self.lifecycle_cfg)
         return InProcessWorker(
             worker_id, worker_root, clock=self.clock,
             ack_every=int(self.cfg.get("ackEveryOps", 16)),
             wall_timers=self.wall_timers,
             deterministic_ids=bool(self.cfg.get("deterministicIds", False)),
             settable_clock=self.settable_clock,
-            journal_cfg=self.journal_cfg, logger=self.logger)
+            journal_cfg=self.journal_cfg, lifecycle_cfg=self.lifecycle_cfg,
+            logger=self.logger)
 
     def add_worker(self, worker_id: str) -> None:
         handle = self._make_handle(worker_id)

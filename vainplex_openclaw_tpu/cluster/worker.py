@@ -75,7 +75,8 @@ def dispatch_op(gw, kind: str, content: str, ctx: dict) -> dict:
 def build_worker_gateway(worker_root: str | Path, worker_id: str,
                          clock: Callable[[], float] = time.time,
                          wall_timers: bool = True,
-                         journal_cfg: Any = True, logger=None):
+                         journal_cfg: Any = True, lifecycle_cfg: Any = True,
+                         logger=None):
     """The standard worker profile: governance (credential guard +
     redaction, audit at the worker root) + cortex (per-tenant trackers over
     the shared workspace journals). Stage-timer keys carry the worker's
@@ -101,7 +102,12 @@ def build_worker_gateway(worker_root: str | Path, worker_id: str,
     gw.load(cortex, plugin_config={"languages": "all",
                                    "traceAnalyzer": {"enabled": False},
                                    "registerTools": False,
-                                   "storage": {"journal": journal_cfg}})
+                                   # lifecycle (ISSUE 11): shipping keeps
+                                   # per-tenant recovery O(wal tail) after a
+                                   # worker death; hibernation bounds a
+                                   # worker's resident tenant trackers.
+                                   "storage": {"journal": journal_cfg,
+                                               "lifecycle": lifecycle_cfg}})
     gw.start()
     return gw, cortex, gov
 
@@ -116,7 +122,8 @@ class InProcessWorker:
                  ack_every: int = 16, wall_timers: bool = True,
                  deterministic_ids: bool = False,
                  settable_clock: Any = None,
-                 journal_cfg: Any = True, logger=None):
+                 journal_cfg: Any = True, lifecycle_cfg: Any = True,
+                 logger=None):
         self.worker_id = worker_id
         self.root = Path(root)
         self.clock = clock
@@ -131,7 +138,8 @@ class InProcessWorker:
         self._touched: set[str] = set()   # workspaces dirty since last ack
         self.gw, self.cortex, self.gov = build_worker_gateway(
             self.root, worker_id, clock=clock, wall_timers=wall_timers,
-            journal_cfg=journal_cfg, logger=logger)
+            journal_cfg=journal_cfg, lifecycle_cfg=lifecycle_cfg,
+            logger=logger)
 
     # ── shard management ─────────────────────────────────────────────
 
@@ -194,6 +202,7 @@ class InProcessWorker:
         ws = op["ws"]
         ctx = {"workspace": ws, "agent_id": self.worker_id,
                "session_key": f"agent:{self.worker_id}:cluster"}
+        self._ensure_workspace_awake(ws)
         obs = dispatch_op(self.gw, op["kind"], op["content"], ctx)
         self.delivered += 1
         self._touched.add(ws)
@@ -201,6 +210,28 @@ class InProcessWorker:
         if len(self._since_ack) >= self.ack_every:
             return obs, self._ack()
         return obs, None
+
+    def _ensure_workspace_awake(self, ws: str) -> None:
+        """Close the hibernation/fencing gap (ISSUE 11): LRU eviction
+        closes a tenant's journal, and the wake on the next op opens a
+        FRESH instance that knows nothing about the lease — a partitioned
+        zombie worker waking a moved tenant would otherwise write unfenced.
+        Before dispatching, any sharded workspace whose journal is missing
+        or fence-less is woken through the cortex path and re-armed at this
+        worker's lease epoch, so the commit-time fence check covers
+        post-wake writes exactly like post-takeover ones."""
+        epoch = self.shard.get(ws)
+        if epoch is None:
+            return
+        journal = peek_journal(ws)
+        if journal is not None and journal.fence_epoch is not None:
+            return
+        try:
+            trackers = self.cortex.trackers({"workspace": ws})
+        except OSError:
+            return  # wake fault: the dispatch hooks retry fail-open
+        if trackers.journal is not None:
+            trackers.journal.set_fence(Path(ws) / FENCE_FILE, epoch)
 
     def _ack(self) -> list:
         """Group-commit every touched journal, then release the seqs. The
@@ -302,15 +333,16 @@ def mp_context():
 
 
 def _process_worker_main(worker_id: str, root: str, ack_every: int,
-                         hb_interval_s: float, journal_cfg, in_q,
-                         out_q) -> None:
+                         hb_interval_s: float, journal_cfg, lifecycle_cfg,
+                         in_q, out_q) -> None:
     """Child entry point: build the worker profile, loop on the op queue.
     Every outbound message doubles as a heartbeat (the supervisor stamps
     ``last_hb`` on anything it drains); an idle child beats explicitly."""
     import queue as _queue
 
     worker = InProcessWorker(worker_id, root, ack_every=ack_every,
-                             wall_timers=True, journal_cfg=journal_cfg)
+                             wall_timers=True, journal_cfg=journal_cfg,
+                             lifecycle_cfg=lifecycle_cfg)
     out_q.put(("hb", worker_id, time.time()))
     while True:
         try:
@@ -355,7 +387,7 @@ class ProcessWorker:
 
     def __init__(self, worker_id: str, root: str | Path, out_q,
                  ack_every: int = 16, hb_interval_s: float = 0.25,
-                 journal_cfg: Any = True):
+                 journal_cfg: Any = True, lifecycle_cfg: Any = True):
         # The worker module imports in ~0.3s with no jax, so spawn's
         # re-import cost (see mp_context) is noise next to gateway build.
         ctx = mp_context()
@@ -366,7 +398,7 @@ class ProcessWorker:
         self.proc = ctx.Process(
             target=_process_worker_main,
             args=(worker_id, str(root), ack_every, hb_interval_s,
-                  journal_cfg, self._in_q, out_q),
+                  journal_cfg, lifecycle_cfg, self._in_q, out_q),
             daemon=True, name=f"cluster-{worker_id}")
         self.proc.start()
         self.shard: dict[str, int] = {}

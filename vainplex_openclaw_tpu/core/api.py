@@ -529,6 +529,22 @@ class PluginApi:
         register the same instance under the same name — idempotent."""
         self._gateway._register_journal(self.id, name, journal)
 
+    def register_lifecycle(self, name: str, manager: Any) -> None:
+        """Publish a workspace LifecycleManager (ISSUE 11) into the
+        gateway's observability registry: ``get_status()["lifecycle"]`` and
+        sitrep's lifecycle collector read resident/hibernated counts, wake
+        quantiles and eviction counters from one place."""
+        self._gateway._register_lifecycle(self.id, name, manager)
+
+    def unregister_stage_timer(self, name: str) -> None:
+        """Drop a per-workspace registry entry at hibernation (ISSUE 11);
+        the caller is responsible for absorbing the timer's histogram into
+        an aggregate first if its quantiles should survive."""
+        self._gateway._unregister_stage_timer(name)
+
+    def unregister_journal(self, name: str) -> None:
+        self._gateway._unregister_journal(name)
+
     def get_gateway_status(self) -> dict:
         """Public view of ``Gateway.get_status()`` (ISSUE 4's degradation
         surface) so plugin status commands can report degraded/breaker state

@@ -116,6 +116,11 @@ class Gateway:
         # them. Multiple plugins sharing one workspace journal register the
         # same name — last one wins, same instance either way.
         self.journals: dict[str, Any] = {}
+        # Lifecycle registry (ISSUE 11): plugins publish their
+        # LifecycleManagers (hibernation/wake accounting); get_status()
+        # exports resident/hibernated/wake-quantile counters and sitrep's
+        # lifecycle collector reads them.
+        self.lifecycles: dict[str, Any] = {}
         # Admission control (ISSUE 6): None unless configured — seed
         # behavior is "never shed".
         self.admission = AdmissionController.from_config(
@@ -160,6 +165,19 @@ class Gateway:
 
     def _register_journal(self, plugin_id: str, name: str, journal: Any) -> None:
         self.journals[name] = journal
+
+    def _register_lifecycle(self, plugin_id: str, name: str, manager: Any) -> None:
+        self.lifecycles[name] = manager
+
+    def _unregister_stage_timer(self, name: str) -> None:
+        # Hibernation (ISSUE 11): a sleeping workspace's per-ws registry
+        # entries are dropped so 10⁵ workspaces that spoke once don't pin
+        # 10⁵ timers/journal objects in RAM forever; the lifecycle manager
+        # absorbs the timer's histogram into its aggregate first.
+        self.stage_timers.pop(self.worker_prefix + name, None)
+
+    def _unregister_journal(self, name: str) -> None:
+        self.journals.pop(name, None)
 
     # ── lifecycle ────────────────────────────────────────────────────
 
@@ -417,4 +435,6 @@ class Gateway:
             "admission": (self.admission.stats() if self.admission is not None
                           else {"enabled": False}),
             "journal": {name: j.stats() for name, j in self.journals.items()},
+            "lifecycle": {name: m.stats()
+                          for name, m in self.lifecycles.items()},
         }

@@ -18,7 +18,9 @@ from typing import Callable, Optional
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand
+from ..resilience.faults import maybe_fail
 from ..storage.journal import get_journal, journal_settings
+from ..storage.lifecycle import LifecycleManager, lifecycle_settings
 from ..utils.stage_timer import StageTimer
 from .boot_context import BootContextGenerator
 from .commitment_tracker import CommitmentTracker
@@ -52,7 +54,10 @@ DEFAULTS = {
     # persists append to the shared workspace journal instead of paying an
     # atomic rename each message. ``storage.journal: false`` restores the
     # legacy write-per-message path end-to-end (the durability oracle).
-    "storage": {"journal": True},
+    # Workspace lifecycle (ISSUE 11): snapshot shipping + segment tiering
+    # on the journal, LRU hibernation of idle workspace trackers.
+    # ``storage.lifecycle: false`` restores the PR-7 full-replay behavior.
+    "storage": {"journal": True, "lifecycle": True},
 }
 
 MANIFEST = PluginManifest(
@@ -87,7 +92,8 @@ MANIFEST = PluginManifest(
                 batchSize={"type": "integer", "minimum": 1}),
             "registerTools": {"type": "boolean"},
             "storage": {"type": "object", "properties": {
-                "journal": {"type": ["boolean", "object"]}}},
+                "journal": {"type": ["boolean", "object"]},
+                "lifecycle": {"type": ["boolean", "object"]}}},
             "traceAnalyzer": enabled_section(
                 languages={"type": "array", "items": {"type": "string"}},
                 fetchBatchSize={"type": "integer", "minimum": 1},
@@ -110,7 +116,8 @@ MANIFEST = PluginManifest(
 
 class _WorkspaceTrackers:
     def __init__(self, workspace: str, config: dict, patterns: MergedPatterns,
-                 logger, clock, wall_timers: bool, call_llm=None):
+                 logger, clock, wall_timers: bool, call_llm=None,
+                 lifecycle_cfg: Optional[dict] = None, lifecycle_timer=None):
         self.workspace = workspace
         # One shared StageTimer per workspace (ISSUE 5): extract/mood/threads/
         # decisions/commitments/persist accumulate into a single breakdown
@@ -120,9 +127,14 @@ class _WorkspaceTrackers:
         # instance knowledge/governance/events use for this workspace, so
         # one fsync covers every edge's records. None (escape hatch or an
         # unopenable journal dir) keeps every tracker on its legacy path.
+        # The lifecycle settings (ISSUE 11) arm snapshot shipping + segment
+        # tiering on the shared instance (first creator wins, like the rest
+        # of the journal settings).
         js = journal_settings(config)
         self.journal = (get_journal(workspace, js, clock=clock,
-                                    wall=wall_timers, logger=logger)
+                                    wall=wall_timers, logger=logger,
+                                    lifecycle=lifecycle_cfg,
+                                    lifecycle_timer=lifecycle_timer)
                         if js["enabled"] else None)
         self.threads = ThreadTracker(workspace, config["threads"], patterns, logger,
                                      clock, timer=self.timer, journal=self.journal)
@@ -147,6 +159,25 @@ class _WorkspaceTrackers:
         for tracker in (self.threads, self.decisions, self.commitments):
             tracker.flush()
 
+    def hibernate(self) -> None:
+        """Evict this workspace down to its journaled snapshot (ISSUE 11):
+        flush every tracker, ship a durable snapshot (legacy files current +
+        durable watermark), then close the shared journal so the next
+        ``get_journal`` opens fresh and replays — the wake path IS the
+        recovery path. Raises ``OSError`` while anything failed to flush:
+        the LifecycleManager keeps the workspace RESIDENT on failure, so a
+        broken disk degrades to no-eviction, never to dropped state."""
+        ok = True
+        for tracker in (self.threads, self.decisions, self.commitments):
+            ok = tracker.flush() and ok
+        if self.journal is not None:
+            ok = self.journal.ship_snapshot() and ok
+        if not ok:
+            raise OSError(f"hibernate {self.workspace}: flush incomplete")
+        self.commitments._debouncer.stop()
+        if self.journal is not None:
+            self.journal.close()
+
 
 class CortexPlugin:
     id = "cortex"
@@ -165,6 +196,10 @@ class CortexPlugin:
         self.patterns: Optional[MergedPatterns] = None
         self._trackers: dict[str, _WorkspaceTrackers] = {}
         self._api = None
+        # Workspace lifecycle (ISSUE 11): None = storage.lifecycle:false —
+        # no hibernation, journals keep the PR-7 full-replay behavior.
+        self.lifecycle: Optional[LifecycleManager] = None
+        self._lifecycle_cfg: Optional[dict] = None
 
     def register(self, api) -> None:
         self.config = load_plugin_config(self.id, api.plugin_config,
@@ -180,6 +215,13 @@ class CortexPlugin:
                                        logger=api.logger, compiled=compiled)
         api.logger.info(f"patterns loaded: {','.join(codes)}"
                         + ("" if compiled else " (interpreter path)"))
+        ls = lifecycle_settings(self.config)
+        if ls["enabled"]:
+            self._lifecycle_cfg = ls
+            self.lifecycle = LifecycleManager(ls, clock=self.clock,
+                                              logger=api.logger)
+            if hasattr(api, "register_lifecycle"):
+                api.register_lifecycle("cortex", self.lifecycle)
 
         api.on("message_received", self._make_ingest("user"), priority=100)
         api.on("message_sent", self._on_message_sent, priority=100)
@@ -223,17 +265,32 @@ class CortexPlugin:
 
     def trackers(self, ctx: dict) -> _WorkspaceTrackers:
         ws = self._workspace_for(ctx)
-        if ws not in self._trackers:
-            self._trackers[ws] = _WorkspaceTrackers(ws, self.config, self.patterns,
-                                                    self.logger, self.clock,
-                                                    self.wall_timers, self.call_llm)
+        tr = self._trackers.get(ws)
+        if tr is None:
+            # Wake path (ISSUE 11): identical to first-sight construction —
+            # the journal open replays last-snapshot + wal tail, the
+            # trackers load the compacted files. ``lifecycle.wake`` faults
+            # fire BEFORE construction so a crashed wake leaves no
+            # half-built entry; the hook's fail-open catch retries on the
+            # next message.
+            waking = self.lifecycle is not None and self.lifecycle.is_sleeping(ws)
+            t0 = time.perf_counter()
+            if waking:
+                maybe_fail("lifecycle.wake")
+            lc_timer = (self.lifecycle.timer_for(ws)
+                        if self.lifecycle is not None else None)
+            tr = _WorkspaceTrackers(ws, self.config, self.patterns,
+                                    self.logger, self.clock,
+                                    self.wall_timers, self.call_llm,
+                                    lifecycle_cfg=self._lifecycle_cfg,
+                                    lifecycle_timer=lc_timer)
+            self._trackers[ws] = tr
             if self._api is not None and hasattr(self._api, "register_stage_timer"):
                 # Per-workspace edge in the observability registry (ISSUE 6);
                 # keyed by workspace so a multi-tenant gateway's sitrep can
                 # attribute latency to the tenant that paid it.
-                self._api.register_stage_timer(f"cortex:{ws}",
-                                               self._trackers[ws].timer)
-            journal = self._trackers[ws].journal
+                self._api.register_stage_timer(f"cortex:{ws}", tr.timer)
+            journal = tr.journal
             if (journal is not None and self._api is not None
                     and hasattr(self._api, "register_journal")):
                 # Journal stats surface (ISSUE 7 satellite): pending/group/
@@ -242,7 +299,43 @@ class CortexPlugin:
                 # journal's own StageTimer.
                 self._api.register_journal(f"journal:{ws}", journal)
                 self._api.register_stage_timer(f"journal:{ws}", journal.timer)
-        return self._trackers[ws]
+            if self.lifecycle is not None:
+                self.lifecycle.register(ws, lambda w=ws: self._hibernate_workspace(w),
+                                        owner="cortex")
+                if (self._api is not None
+                        and hasattr(self._api, "register_stage_timer")
+                        and lc_timer is not None):
+                    self._api.register_stage_timer(f"lifecycle:{ws}", lc_timer)
+                if waking:
+                    self.lifecycle.note_wake(
+                        ws, (time.perf_counter() - t0) * 1000.0)
+        if self.lifecycle is not None:
+            base = self._workspace_for({})
+            for victim in self.lifecycle.note_traffic(ws):
+                if victim == base:
+                    # The plugin's own base workspace never self-evicts: a
+                    # single-workspace gateway must not hibernate the
+                    # journal its co-plugins (governance audit, events)
+                    # share mid-flight.
+                    continue
+                self.lifecycle.hibernate(victim)
+        return tr
+
+    def _hibernate_workspace(self, ws: str) -> None:
+        """LifecycleManager eviction callback: flush-ship-close the
+        workspace's trackers and drop every per-workspace registry entry so
+        a sleeping workspace costs neither RAM nor registry growth. Raises
+        ``OSError`` (kept resident by the manager) when the flush failed."""
+        tr = self._trackers.get(ws)
+        if tr is None:
+            return
+        tr.hibernate()  # raises before anything is dropped on failure
+        del self._trackers[ws]
+        if self._api is not None and hasattr(self._api, "unregister_stage_timer"):
+            self._api.unregister_stage_timer(f"cortex:{ws}")
+            self._api.unregister_stage_timer(f"journal:{ws}")
+            self._api.unregister_stage_timer(f"lifecycle:{ws}")
+            self._api.unregister_journal(f"journal:{ws}")
 
     # ── hook handlers (every one fail-open) ──────────────────────────
 
@@ -338,6 +431,11 @@ class CortexPlugin:
 
     def status_text(self) -> str:
         lines = ["🧠 cortex:"]
+        if self.lifecycle is not None:
+            ls = self.lifecycle.stats()
+            lines.append(f"  lifecycle: resident={ls['resident']} "
+                         f"hibernated={ls['hibernated']} wakes={ls['wakes']} "
+                         f"wakeP99={ls['wakeP99Ms']}ms")
         if self.patterns is not None and self.patterns.unsafe:
             lines.append(
                 f"  ⚠ {len(self.patterns.unsafe)} ReDoS-unsafe pattern(s) "

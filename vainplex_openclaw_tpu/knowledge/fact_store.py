@@ -146,6 +146,34 @@ class FactStore:
         if self.loaded:
             self.storage.flush_all()
 
+    def hibernate(self) -> None:
+        """Evict the store down to its journaled snapshot (ISSUE 11): flush
+        the debounced save (journal mode compacts ``facts.json`` current),
+        then drop the in-RAM facts dict and both indexes. The next
+        ``load()`` faults everything back in from the snapshot — the wake
+        path is the ordinary load path.
+
+        The WHOLE evict runs under ``_facts_lock`` (flush included): a
+        racing ``load()``/``add_fact()`` must serialize either entirely
+        before (its fact is flushed with the rest) or entirely after (it
+        reloads the flushed snapshot, or raises the ordinary not-loaded
+        error into the fail-open hook). Releasing the lock mid-evict would
+        let a reload slip between the flush and the clear — a
+        loaded-but-empty store whose next debounced save persists empty.
+        Hibernation is an idle-path event, so blocking under the hot lock
+        here is cold by construction (``allow_blocking`` in the GUARDED
+        table, same rationale as ``load``). The flush's debounced supplier
+        re-enters the RLock on this thread; the Debouncer calls it with no
+        Debouncer lock held, so there is no lock-order edge."""
+        with self._facts_lock:
+            if not self.loaded:
+                return
+            self.storage.flush_all()
+            self.facts.clear()
+            self._content_index.clear()
+            self._lower.clear()
+            self.loaded = False
+
     # ── content index ────────────────────────────────────────────────
 
     def _index(self, fact: Fact) -> None:

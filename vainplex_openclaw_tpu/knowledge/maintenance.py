@@ -13,7 +13,8 @@ from ..utils.stage_timer import StageTimer
 class Maintenance:
     def __init__(self, fact_store, embeddings, logger,
                  decay_hours: float = 24.0, sync_minutes: float = 30.0,
-                 wall_timers: bool = True, timer: Optional[StageTimer] = None):
+                 wall_timers: bool = True, timer: Optional[StageTimer] = None,
+                 lifecycle=None):
         self.fact_store = fact_store
         self.embeddings = embeddings
         self.logger = logger
@@ -21,6 +22,10 @@ class Maintenance:
         self.sync_minutes = sync_minutes
         self.wall_timers = wall_timers
         self.timer = timer if timer is not None else StageTimer()
+        # Workspace lifecycle (ISSUE 11): idle hibernation needs a periodic
+        # probe precisely because an idle store gets no traffic to piggyback
+        # on — the maintenance loop is that probe.
+        self.lifecycle = lifecycle
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._synced_ids: set = set()
@@ -62,6 +67,17 @@ class Maintenance:
             self._synced_ids.update(f.id for f in pending)
         return n
 
+    def run_hibernation(self) -> int:
+        """One idle-eviction tick (ISSUE 11): hibernate every workspace the
+        lifecycle manager reports past its idle horizon. Returns evictions."""
+        if self.lifecycle is None:
+            return 0
+        n = 0
+        for ws in self.lifecycle.idle_victims():
+            if self.lifecycle.hibernate(ws):
+                n += 1
+        return n
+
     def _loop(self, interval_s: float, fn) -> None:
         while not self._stop.wait(interval_s):
             try:
@@ -72,9 +88,15 @@ class Maintenance:
     def start(self) -> None:
         if not self.wall_timers:
             return
-        for interval, fn, name in ((self.decay_hours * 3600, self.run_decay, "ke-decay"),
-                                   (self.sync_minutes * 60, self.run_embeddings_sync,
-                                    "ke-embeddings")):
+        jobs = [(self.decay_hours * 3600, self.run_decay, "ke-decay"),
+                (self.sync_minutes * 60, self.run_embeddings_sync,
+                 "ke-embeddings")]
+        if self.lifecycle is not None and self.lifecycle.idle_s > 0:
+            # Probe at half the idle horizon: an idle store sleeps at most
+            # 1.5× idleSeconds past its last message.
+            jobs.append((self.lifecycle.idle_s / 2, self.run_hibernation,
+                         "ke-hibernate"))
+        for interval, fn, name in jobs:
             t = threading.Thread(target=self._loop, args=(interval, fn),
                                  daemon=True, name=name)
             t.start()

@@ -14,7 +14,9 @@ from typing import Callable, Optional
 from ..config.loader import load_plugin_config
 from ..config.manifest import PluginManifest, enabled_section
 from ..core.api import PluginCommand, PluginService
+from ..resilience.faults import maybe_fail
 from ..storage.journal import get_journal, journal_settings
+from ..storage.lifecycle import LifecycleManager, lifecycle_settings
 from ..utils.stage_timer import StageTimer
 from .embeddings import create_embeddings
 from .entity_extractor import EntityExtractor
@@ -27,7 +29,10 @@ DEFAULTS = {
     "workspace": None,
     # storage.journal (ISSUE 7): debounced facts.json saves ride the shared
     # group-commit workspace journal; false restores the atomic-rename path.
-    "storage": {"maxFacts": 2000, "writeDebounceMs": 2000, "journal": True},
+    # storage.lifecycle (ISSUE 11): snapshot shipping + tiering on the
+    # shared journal; idle hibernation of the fact store (idleSeconds > 0).
+    "storage": {"maxFacts": 2000, "writeDebounceMs": 2000, "journal": True,
+                "lifecycle": True},
     "extraction": {"minImportance": 0.5, "mentionPredicate": "mentioned"},
     "llm": {"enabled": False, "batchSize": 3},
     "embeddings": {"backend": "local", "enabled": True,
@@ -47,7 +52,8 @@ MANIFEST = PluginManifest(
             "storage": {"type": "object", "properties": {
                 "maxFacts": {"type": "integer", "minimum": 1},
                 "writeDebounceMs": {"type": "integer", "minimum": 0},
-                "journal": {"type": ["boolean", "object"]}}},
+                "journal": {"type": ["boolean", "object"]},
+                "lifecycle": {"type": ["boolean", "object"]}}},
             "extraction": {"type": "object", "properties": {
                 "minImportance": {"type": "number", "minimum": 0, "maximum": 1},
                 "mentionPredicate": {"type": "string"}}},
@@ -88,6 +94,9 @@ class KnowledgeEnginePlugin:
         self.embeddings = None
         self.maintenance: Optional[Maintenance] = None
         self.enhancer: Optional[KnowledgeLlmEnhancer] = None
+        self.lifecycle: Optional[LifecycleManager] = None
+        self._ws_key = ""
+        self._maintenance_started = False
 
     def register(self, api) -> None:
         self.config = load_plugin_config(self.id, api.plugin_config,
@@ -99,11 +108,28 @@ class KnowledgeEnginePlugin:
         workspace = (self._workspace_override or self.config.get("workspace")
                      or api.config.get("workspace") or ".")
         self.extractor = EntityExtractor(api.logger, clock=self.clock)
+        # Workspace lifecycle (ISSUE 11): shipping/tiering settings ride the
+        # shared journal (first creator wins); the manager drives idle
+        # hibernation of the fact store through the maintenance loop.
+        ls = lifecycle_settings(self.config)
+        self._ws_key = str(workspace)
+        if ls["enabled"]:
+            self.lifecycle = LifecycleManager(ls, clock=self.clock,
+                                              logger=api.logger)
+            if hasattr(api, "register_lifecycle"):
+                api.register_lifecycle("knowledge", self.lifecycle)
+        else:
+            self.lifecycle = None
         # Shared per-workspace group-commit journal (ISSUE 7); falls back to
         # the legacy debounced atomic write when disabled or unopenable.
         js = journal_settings(self.config)
         self.journal = (get_journal(workspace, js, clock=self.clock,
-                                    wall=self.wall_timers, logger=api.logger)
+                                    wall=self.wall_timers, logger=api.logger,
+                                    lifecycle=ls if ls["enabled"] else None,
+                                    lifecycle_timer=(
+                                        self.lifecycle.timer_for(self._ws_key)
+                                        if self.lifecycle is not None
+                                        else None))
                         if js["enabled"] else None)
         if self.journal is not None and hasattr(api, "register_journal"):
             api.register_journal(f"journal:{workspace}", self.journal)
@@ -111,6 +137,13 @@ class KnowledgeEnginePlugin:
                                     api.logger, clock=self.clock,
                                     wall_timers=self.wall_timers,
                                     timer=self.timer, journal=self.journal)
+        if self.lifecycle is not None:
+            # The store hibernates to its journaled snapshot; the shared
+            # journal itself stays open — cortex (or gateway stop) owns
+            # closing it, and knowledge's eviction is about the facts dict
+            # and its indexes, not the wal fd.
+            self.lifecycle.register(self._ws_key, self.fact_store.hibernate,
+                                    owner="knowledge")
         kwargs = {"http_post": self.http_post} if self.http_post else {}
         self.embeddings = create_embeddings(self.config.get("embeddings"),
                                             api.logger, timer=self.timer,
@@ -120,7 +153,8 @@ class KnowledgeEnginePlugin:
                                        decay_hours=mcfg.get("decayHours", 24),
                                        sync_minutes=mcfg.get("syncMinutes", 30),
                                        wall_timers=self.wall_timers,
-                                       timer=self.timer)
+                                       timer=self.timer,
+                                       lifecycle=self.lifecycle)
         if self.config.get("llm", {}).get("enabled") and self.call_llm is not None:
             self.enhancer = KnowledgeLlmEnhancer(self.call_llm, api.logger,
                                                  self.config["llm"].get("batchSize", 3))
@@ -142,9 +176,28 @@ class KnowledgeEnginePlugin:
     # ── lifecycle ────────────────────────────────────────────────────
 
     def _ensure_loaded(self) -> None:
-        if not self.fact_store.loaded:
-            self.fact_store.load()
+        if self.fact_store.loaded:
+            return
+        # Wake path (ISSUE 11): after a hibernation this re-load IS the
+        # recovery — the ``lifecycle.wake`` fault fires before it so a
+        # crashed wake leaves the store empty-and-unloaded for the next
+        # message to retry (the hook handlers are fail-open).
+        waking = (self.lifecycle is not None
+                  and self.lifecycle.is_sleeping(self._ws_key))
+        t0 = time.perf_counter()
+        if waking:
+            maybe_fail("lifecycle.wake")
+        self.fact_store.load()
+        if not self._maintenance_started:
             self.maintenance.start()
+            self._maintenance_started = True
+        if waking:
+            # Hibernation dropped the owner callback (the manager must not
+            # pin closures for sleeping workspaces) — re-register on wake.
+            self.lifecycle.register(self._ws_key, self.fact_store.hibernate,
+                                    owner="knowledge")
+            self.lifecycle.note_wake(self._ws_key,
+                                     (time.perf_counter() - t0) * 1000.0)
 
     def _shutdown(self) -> None:
         if self.maintenance is not None:
@@ -172,6 +225,10 @@ class KnowledgeEnginePlugin:
             if not content:
                 return None
             self._ensure_loaded()
+            if self.lifecycle is not None:
+                # Recency stamp; idle eviction itself runs on the
+                # maintenance probe (an idle store gets no messages).
+                self.lifecycle.note_traffic(self._ws_key)
             min_importance = self.config.get("extraction", {}).get("minImportance", 0.5)
             predicate = self.config.get("extraction", {}).get("mentionPredicate", "mentioned")
             with self.timer.stage("extract"):

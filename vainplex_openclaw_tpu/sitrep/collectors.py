@@ -310,6 +310,64 @@ def collect_cluster(config: dict, ctx: dict) -> dict:
             "summary": summary}
 
 
+def collect_lifecycle(config: dict, ctx: dict) -> dict:
+    """Workspace lifecycle health (ISSUE 11): resident/hibernated counts,
+    wake quantiles and eviction counters per registered LifecycleManager,
+    plus the per-journal tiering view (cold segments/bytes, demote
+    backlog, ship counters). Warns ONLY on current conditions — a
+    non-empty demote backlog is the one live signal that the tier is
+    falling behind. Lifetime counters (wakes, evictions, hibernate/
+    demote/ship failures) stay visible in the items and summary but never
+    latch the report to warn forever over one long-past incident — the
+    same rule collect_gateway applies to its error counters."""
+    status_fn = ctx.get("gateway_status")
+    if status_fn is None:
+        return {"status": "skipped", "items": [], "summary": "no gateway wired"}
+    s = status_fn() or {}
+    managers = s.get("lifecycle") or {}
+    journals = s.get("journal") or {}
+    tiers = {name: (j.get("lifecycle") or {})
+             for name, j in journals.items() if j.get("lifecycle")}
+    if not managers and not tiers:
+        return {"status": "skipped", "items": [],
+                "summary": "no lifecycle managers registered"}
+    items = []
+    worries = []
+    resident = hibernated = wakes = 0
+    wake_p99 = None
+    for name in sorted(managers):
+        m = managers[name]
+        items.append({"manager": name, **m})
+        resident += m.get("resident", 0)
+        hibernated += m.get("hibernated", 0)
+        wakes += m.get("wakes", 0)
+        if m.get("wakeP99Ms") is not None:
+            wake_p99 = max(wake_p99 or 0.0, m["wakeP99Ms"])
+    cold_segments = cold_bytes = backlog = 0
+    failures = 0
+    for name in sorted(tiers):
+        t = tiers[name]
+        items.append({"journal": name, **t})
+        cold_segments += t.get("coldSegments", 0)
+        cold_bytes += t.get("coldBytes", 0)
+        backlog += t.get("demoteBacklog", 0)
+        failures += (t.get("demoteFailures", 0) or 0) + \
+            (t.get("shipFailures", 0) or 0)
+        if t.get("demoteBacklog"):
+            worries.append(f"{name}.demoteBacklog={t['demoteBacklog']}")
+    summary = (f"{resident} resident / {hibernated} hibernated, "
+               f"{wakes} wakes"
+               + (f" (p99 {wake_p99}ms)" if wake_p99 is not None else "")
+               + f", tier: {cold_segments} cold segments "
+                 f"({cold_bytes} B)")
+    if failures:
+        summary += f", {failures} lifetime ship/demote failures"
+    if worries:
+        summary += " — " + ", ".join(worries)
+    return {"status": "warn" if worries else "ok", "items": items,
+            "summary": summary}
+
+
 def collect_slo(config: dict, ctx: dict) -> dict:
     """SLO-threshold rollup: p99 budgets (ms) from config against live
     stage quantiles. Keys: ``"edge:stage"`` beats ``"edge"`` beats
@@ -391,6 +449,7 @@ BUILTIN_COLLECTORS: dict[str, Callable] = {
     "resilience": collect_resilience,
     "journal": collect_journal,
     "cluster": collect_cluster,
+    "lifecycle": collect_lifecycle,
     "slo": collect_slo,
     "pattern_safety": collect_pattern_safety,
 }

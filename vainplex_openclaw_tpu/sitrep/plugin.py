@@ -31,6 +31,8 @@ DEFAULTS = {
         # Sharded-gateway health (ISSUE 9): skipped unless a cluster
         # supervisor registered ``cluster.status`` on this gateway.
         "cluster": {"enabled": True},
+        # Workspace lifecycle (ISSUE 11): hibernation/wake + tier health.
+        "lifecycle": {"enabled": True},
         "slo": {"enabled": True},
         # ReDoS screening rollup (ISSUE 8): reads governance status only.
         "pattern_safety": {"enabled": True},
@@ -42,7 +44,7 @@ DEFAULTS = {
 # config says — the live dashboard must not go dark because an operator
 # trimmed the periodic report.
 OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "journal",
-                  "cluster", "slo", "pattern_safety")
+                  "cluster", "lifecycle", "slo", "pattern_safety")
 
 MANIFEST = PluginManifest(
     id="sitrep",
@@ -220,6 +222,10 @@ class SitrepPlugin:
         if cl.get("status") != "skipped":
             lines.append(f"  {icon.get(cl.get('status'), '•')} cluster: "
                          f"{cl.get('summary', 'n/a')}")
+        lc = results.get("lifecycle", {})
+        if lc.get("status") != "skipped":
+            lines.append(f"  {icon.get(lc.get('status'), '•')} lifecycle: "
+                         f"{lc.get('summary', 'n/a')}")
         slo = results.get("slo", {})
         lines.append(f"  {icon.get(slo.get('status'), '•')} slo: "
                      f"{slo.get('summary', 'n/a')}")

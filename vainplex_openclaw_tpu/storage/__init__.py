@@ -17,6 +17,7 @@ from .journal import (
     journal_settings,
     reset_journals,
 )
+from .lifecycle import LIFECYCLE_DEFAULTS, LifecycleManager, lifecycle_settings
 from .workspace import is_file_older_than, is_writable, reboot_dir
 
 __all__ = [
@@ -25,12 +26,15 @@ __all__ = [
     "Debouncer",
     "Journal",
     "JsonlReadReport",
+    "LIFECYCLE_DEFAULTS",
+    "LifecycleManager",
     "append_jsonl",
     "dedup_against_tail",
     "get_journal",
     "is_file_older_than",
     "is_writable",
     "journal_settings",
+    "lifecycle_settings",
     "read_json",
     "read_jsonl",
     "reboot_dir",

@@ -45,6 +45,7 @@ Every consumer keeps its legacy persist path intact behind
 from __future__ import annotations
 
 import atexit
+import gzip
 import os
 import threading
 import time
@@ -209,7 +210,9 @@ class Journal:
 
     def __init__(self, root: str | Path, settings: Optional[dict] = None,
                  clock: Callable[[], float] = time.time, wall: bool = True,
-                 logger=None, timer: Optional[StageTimer] = None):
+                 logger=None, timer: Optional[StageTimer] = None,
+                 lifecycle: Optional[dict] = None,
+                 lifecycle_timer: Optional[StageTimer] = None):
         s = dict(DEFAULT_JOURNAL_SETTINGS)
         s.update(settings or {})
         self.root = Path(root)
@@ -218,6 +221,17 @@ class Journal:
         self.wall = wall
         self.logger = logger
         self.timer = timer or StageTimer()
+        # Workspace lifecycle (ISSUE 11): resolved ``lifecycle_settings``
+        # dict arms snapshot shipping (durable watermarks on a record
+        # cadence) and segment tiering (rotated segments demoted to a
+        # compressed cold/ tier instead of deleted). ``None`` — the
+        # ``storage.lifecycle: false`` escape hatch and every direct
+        # construction — keeps the PR-7 behavior verbatim: meta at
+        # rotation/close only, rotated segments unlinked.
+        self.lifecycle = (dict(lifecycle)
+                          if lifecycle and lifecycle.get("enabled", True)
+                          else None)
+        self.lifecycle_timer = lifecycle_timer or StageTimer()
         self.fsync_mode = s.get("fsync", "group")
         self.window_s = float(s.get("windowMs", 20.0)) / 1000.0
         self.max_batch = int(s.get("maxBatchRecords", 128))
@@ -244,9 +258,17 @@ class Journal:
         self.fsync_failures = 0
         self.rotations = 0
         self.last_error: Optional[str] = None
+        # Lifecycle counters (commit-lock side; stats() reads torn-tolerant)
+        self._records_since_ship = 0
+        self.ships = 0
+        self.ship_failures = 0
+        self.cold_demoted = 0
+        self.cold_dropped = 0
+        self.demote_failures = 0
+        self._demote_backlog: list[Path] = []
         self._replay = {"segments": 0, "records": 0, "skipped": 0,
                         "corrupt_lines": 0, "torn_tails": 0, "read_errors": 0,
-                        "deduped": 0}
+                        "deduped": 0, "cold_segments": 0}
         # recovered-but-unregistered records: stream → [(q, payload_obj, meta)]
         self._recovered: dict[str, list[tuple[int, Any, Optional[dict]]]] = {}
         self._marks: dict[str, int] = {}
@@ -270,31 +292,96 @@ class Journal:
     def _seg_path(self, gen: int) -> Path:
         return self.root / f"wal.{gen:06d}.jsonl"
 
+    def _cold_dir(self) -> Path:
+        return self.root / str((self.lifecycle or {}).get("tierDir", "cold"))
+
+    def _cold_path(self, gen: int) -> Path:
+        # Bounded directory fanout: gen % tierFanout subdirectories, so no
+        # single directory ever accumulates the whole tier's entries.
+        fan = max(1, int((self.lifecycle or {}).get("tierFanout", 16)))
+        return self._cold_dir() / f"{gen % fan:02x}" / f"wal.{gen:06d}.jsonl.gz"
+
+    def cold_segments(self) -> list[tuple[int, Path]]:
+        """(gen, path) for every cold-tier segment, oldest first."""
+        out = []
+        for seg in self._cold_dir().glob("*/wal.*.jsonl.gz"):
+            try:
+                out.append((int(seg.name.split(".")[1]), seg))
+            except (ValueError, IndexError):
+                continue
+        out.sort()
+        return out
+
+    def _replay_record(self, w: Any) -> None:
+        rep = self._replay
+        if not isinstance(w, dict) or "s" not in w:
+            rep["corrupt_lines"] += 1
+            return
+        name = str(w["s"])
+        try:
+            q = int(w.get("q") or 0)
+        except (TypeError, ValueError):
+            rep["corrupt_lines"] += 1
+            return
+        if q <= self._marks.get(name, 0):
+            rep["skipped"] += 1
+            return
+        rep["records"] += 1
+        self._recovered.setdefault(name, []).append(
+            (q, w.get("p"), w.get("m")))
+
+    def _rehydrate_cold(self, meta: dict, meta_present: bool) -> None:
+        """Replay cold-tier segments that the on-disk meta cannot vouch for.
+
+        A demoted segment is fully compacted by construction, and the
+        rotation that demoted it wrote meta with the NEW generation — so
+        whenever ``meta.gen`` exceeds a cold segment's generation, every
+        record in it is at-or-below the persisted watermarks and the
+        segment is skipped without even decompressing. Only a crash that
+        lost the meta write (or the whole meta file) forces rehydration,
+        which keeps the common-path recovery cost O(wal tail), never
+        O(history) — the whole point of shipping."""
+        import json as _json
+
+        if self.lifecycle is None:
+            return
+        meta_gen = int(meta.get("gen", 0)) if meta_present else None
+        rep = self._replay
+        for gen, seg in self.cold_segments():
+            if meta_gen is not None and gen < meta_gen:
+                continue
+            try:
+                with gzip.open(seg, "rt", encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+            except (OSError, EOFError) as exc:
+                rep["read_errors"] += 1
+                self.last_error = str(exc)
+                continue
+            rep["cold_segments"] += 1
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    self._replay_record(_json.loads(line))
+                except (ValueError, TypeError):
+                    rep["corrupt_lines"] += 1
+
     def _open(self) -> None:
         self.root.mkdir(parents=True, exist_ok=True)
+        meta_present = (self.root / _META_NAME).exists()
         meta = read_json(self.root / _META_NAME, {}) or {}
         self._marks = {str(k): int(v)
                        for k, v in (meta.get("watermarks") or {}).items()}
+        # Cold tier first: demoted segments carry strictly older gens than
+        # any live wal segment, so their surviving records (stale-meta
+        # crash recovery only) must enter the recovered lists first.
+        self._rehydrate_cold(meta, meta_present)
         segs = sorted(self.root.glob("wal.*.jsonl"))
         rep = self._replay
         for i, seg in enumerate(segs):
             report = JsonlReadReport()
             for w in read_jsonl(seg, report=report):
-                if not isinstance(w, dict) or "s" not in w:
-                    rep["corrupt_lines"] += 1
-                    continue
-                name = str(w["s"])
-                try:
-                    q = int(w.get("q") or 0)
-                except (TypeError, ValueError):
-                    rep["corrupt_lines"] += 1
-                    continue
-                if q <= self._marks.get(name, 0):
-                    rep["skipped"] += 1
-                    continue
-                rep["records"] += 1
-                self._recovered.setdefault(name, []).append(
-                    (q, w.get("p"), w.get("m")))
+                self._replay_record(w)
             rep["segments"] += 1
             rep["corrupt_lines"] += report.corrupt_lines
             if report.read_error is not None:
@@ -630,6 +717,7 @@ class Journal:
             self._wal_bytes += len(data.encode("utf-8"))
             self.commits += 1
             self.committed_records += nrec
+            self._records_since_ship += nrec
             auto = []
             for st, recs in drained:
                 if st.kind == "snapshot":
@@ -643,6 +731,10 @@ class Journal:
                 self._compact_streams(auto)
             if self._wal_bytes > self.max_segment:
                 self.compact()  # full compaction enables rotation
+            if (self.lifecycle is not None and not self._fenced
+                    and self._records_since_ship
+                    >= int(self.lifecycle.get("shipEveryRecords", 512))):
+                self._ship_locked()
             return True
         finally:
             self._commit_lock.release()
@@ -726,22 +818,140 @@ class Journal:
                     self.timer.add("compact", (pc() - t0) * 1000.0)
         return ok
 
-    def _write_meta(self) -> None:
-        """Persist watermarks. Deliberately rare (rotation, close) and never
-        fsynced: a stale meta file only means recovery re-replays records the
-        last compactions already delivered — snapshot replay is idempotent
-        and append replay tail-dedupes — so correctness never rides on this
-        write, and paying an fsync per compaction for it measurably taxed the
-        audit hot path (profiled: 2 of the 3 fsyncs per flush were meta)."""
+    def _write_meta(self, durable: bool = False) -> None:
+        """Persist watermarks. Deliberately rare (rotation, close, snapshot
+        ship) and un-fsynced by default: a stale meta file only means
+        recovery re-replays records the last compactions already delivered —
+        snapshot replay is idempotent and append replay tail-dedupes — so
+        correctness never rides on this write, and paying an fsync per
+        compaction for it measurably taxed the audit hot path (profiled: 2
+        of the 3 fsyncs per flush were meta). A snapshot SHIP (ISSUE 11)
+        passes ``durable=True``: the fsync there is amortized over
+        ``shipEveryRecords`` commits and is exactly what makes recovery
+        start from the shipped watermark after kill -9."""
         try:
             write_json_atomic(self.root / _META_NAME,
                               {"version": 1, "gen": self._gen,
                                "watermarks": dict(self._marks)},
-                              indent=None)
+                              indent=None, durable=durable)
             self._meta_dirty = False
         except OSError as exc:
             # Stale watermarks only mean extra (deduped) replay next open.
             self.last_error = str(exc)
+
+    # ── lifecycle: snapshot shipping + segment tiering (ISSUE 11) ────
+
+    def _ship_locked(self) -> bool:
+        """Commit-lock held. One snapshot ship: compact every stream to its
+        legacy file, retry any backlogged demotions, then persist the
+        watermarks DURABLY. After this returns True, recovery replays only
+        the wal records committed since — history before the ship is paid
+        for exactly once, here, off the per-record hot path."""
+        if self._fenced or self._closed:
+            return False
+        pc = time.perf_counter
+        t0 = pc()
+        try:
+            maybe_fail("lifecycle.snapshot")
+        except OSError as exc:
+            self.ship_failures += 1
+            self.last_error = str(exc)
+            return False
+        ok = self._compact_streams(list(self._streams.values()))
+        self._retry_demotes()
+        if ok:
+            if self._wal_bytes > 0:
+                # Rotate the shipped prefix out of the live wal: without
+                # this, recovery still READS (and skips) every pre-ship
+                # record — O(history) parse cost with an O(tail) replay.
+                # Rotation demotes the old segment cold and writes the
+                # durable meta with the new gen, so the cold copy is
+                # provably skippable at the next open.
+                self._maybe_rotate()
+            if self._meta_dirty:
+                self._write_meta(durable=True)
+            ok = not self._meta_dirty
+        if ok:
+            self.ships += 1
+            self._records_since_ship = 0
+        else:
+            self.ship_failures += 1
+        self.lifecycle_timer.add("snapshot", (pc() - t0) * 1000.0)
+        return ok
+
+    def ship_snapshot(self) -> bool:
+        """Commit + ship now (the hibernate path and tests call this; the
+        steady-state cadence is ``shipEveryRecords`` inside commit). On a
+        legacy journal (no lifecycle) this degrades to a plain compaction —
+        the escape hatch must not grow a durable-meta side channel."""
+        ok = self.commit()
+        if self.lifecycle is None:
+            return self.compact() and ok
+        with self._commit_lock:
+            return self._ship_locked() and ok
+
+    def _demote_segment(self, seg: Path) -> bool:
+        """Commit-lock held. Compress one fully-compacted rotated segment
+        into the cold tier and drop the plain copy. A failure (fault site
+        ``lifecycle.demote``, disk trouble) leaves the plain segment in
+        place on the retry backlog — cold demotion is a space optimization
+        and must never be able to lose the only copy of a segment."""
+        pc = time.perf_counter
+        t0 = pc()
+        try:
+            gen = int(seg.name.split(".")[1])
+        except (ValueError, IndexError):
+            return False
+        dst = self._cold_path(gen)
+        tmp = dst.with_name(dst.name + f".tmp{os.getpid()}")
+        try:
+            maybe_fail("lifecycle.demote")
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            data = seg.read_bytes()
+            t_comp = pc()
+            with gzip.open(tmp, "wb", compresslevel=6) as fh:
+                fh.write(data)
+            self.lifecycle_timer.add("compress", (pc() - t_comp) * 1000.0)
+            os.replace(tmp, dst)
+            seg.unlink()
+        except OSError as exc:
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            self.demote_failures += 1
+            self.last_error = str(exc)
+            if seg not in self._demote_backlog:
+                self._demote_backlog.append(seg)
+            self.lifecycle_timer.add("demote", (pc() - t0) * 1000.0)
+            return False
+        if seg in self._demote_backlog:
+            self._demote_backlog.remove(seg)
+        self.cold_demoted += 1
+        self.lifecycle_timer.add("demote", (pc() - t0) * 1000.0)
+        return True
+
+    def _retry_demotes(self) -> None:
+        """Commit-lock held. Re-attempt backlogged demotions (each retry is
+        its own ``lifecycle.demote`` fault-site step)."""
+        for seg in list(self._demote_backlog):
+            if not seg.exists():
+                self._demote_backlog.remove(seg)
+                continue
+            self._demote_segment(seg)
+
+    def _cap_cold_tier(self) -> None:
+        """Commit-lock held. Enforce ``maxColdSegments``: the oldest cold
+        segments beyond the cap are unlinked — dropped AND counted, the
+        bounded-disk contract."""
+        cap = max(0, int(self.lifecycle.get("maxColdSegments", 64)))
+        cold = self.cold_segments()
+        for _gen, seg in cold[:max(0, len(cold) - cap)]:
+            try:
+                seg.unlink()
+                self.cold_dropped += 1
+            except OSError as exc:
+                self.last_error = str(exc)
 
     def _maybe_rotate(self) -> None:
         """Start a fresh segment once everything is compacted; the old
@@ -769,13 +979,27 @@ class Journal:
         self._wal_bytes = 0
         self.rotations += 1
         self._meta_dirty = True
-        self._write_meta()
-        for seg in self.root.glob("wal.*.jsonl"):
+        # Meta BEFORE demotion: once meta carries the new gen, every cold
+        # segment (gen < meta.gen) is provably covered by the persisted
+        # watermarks and recovery skips it without decompressing.
+        self._write_meta(durable=self.lifecycle is not None)
+        for seg in sorted(self.root.glob("wal.*.jsonl")):
             try:
-                if int(seg.name.split(".")[1]) < self._gen:
-                    seg.unlink()
-            except (OSError, ValueError, IndexError):
+                if int(seg.name.split(".")[1]) >= self._gen:
+                    continue
+            except (ValueError, IndexError):
                 continue
+            if self.lifecycle is not None:
+                # Tiering (ISSUE 11): demote instead of delete — compressed
+                # history with bounded fanout; failures go to the backlog.
+                self._demote_segment(seg)
+            else:
+                try:
+                    seg.unlink()
+                except OSError:
+                    continue
+        if self.lifecycle is not None:
+            self._cap_cold_tier()
 
     # ── owner-driven accounting ──────────────────────────────────────
 
@@ -859,7 +1083,10 @@ class Journal:
                 self.compact()
                 with self._commit_lock:
                     if self._meta_dirty and not self._fenced:
-                        self._write_meta()
+                        # Lifecycle journals close DURABLY: a hibernated
+                        # workspace's wake must start from this watermark
+                        # even across a kill -9 (wake IS recovery).
+                        self._write_meta(durable=self.lifecycle is not None)
         finally:
             self._closed = True
             with self._buffer_lock:
@@ -875,6 +1102,7 @@ class Journal:
                 except OSError:
                     pass
             _LIVE_JOURNALS.discard(self)
+            _registry_discard(self)
 
     def drop_pending(self) -> int:
         """Discard every buffered (uncommitted) record WITHOUT committing —
@@ -908,6 +1136,7 @@ class Journal:
             except OSError:
                 pass
         _LIVE_JOURNALS.discard(self)
+        _registry_discard(self)
 
     def stats(self) -> dict:
         with self._buffer_lock:
@@ -953,6 +1182,34 @@ class Journal:
             "lastError": self.last_error,
             "replay": dict(self._replay),
             "streams": streams,
+            "lifecycle": self._lifecycle_stats(),
+        }
+
+    def _lifecycle_stats(self) -> Optional[dict]:
+        """Shipping/tiering counters (None on a legacy journal). Runs
+        outside the locks — every read here is a torn-tolerant scalar or a
+        directory listing, and stats() must not convoy the commit path."""
+        if self.lifecycle is None:
+            return None
+        cold = self.cold_segments()
+        cold_bytes = 0
+        for _gen, seg in cold:
+            try:
+                cold_bytes += seg.stat().st_size
+            except OSError:
+                continue
+        return {
+            "ships": self.ships,
+            "shipFailures": self.ship_failures,
+            "recordsSinceShip": self._records_since_ship,
+            "shipEveryRecords": int(self.lifecycle.get("shipEveryRecords",
+                                                       512)),
+            "coldSegments": len(cold),
+            "coldBytes": cold_bytes,
+            "coldDemoted": self.cold_demoted,
+            "coldDropped": self.cold_dropped,
+            "demoteBacklog": len(self._demote_backlog),
+            "demoteFailures": self.demote_failures,
         }
 
 
@@ -963,9 +1220,24 @@ _REGISTRY_LOCK = threading.Lock()
 _LIVE_JOURNALS: "weakref.WeakSet[Journal]" = weakref.WeakSet()
 
 
+def _registry_discard(j: Journal) -> None:
+    """Drop a closed/abandoned journal from the registry so it can be
+    garbage-collected. Hibernation (ISSUE 11) closes one journal per
+    evicted workspace — at 10⁵ cold workspaces, pinning every closed
+    instance (streams, timers, settings) in this dict is the exact
+    unbounded-RSS shape the lifecycle work removes. ``get_journal``
+    already treats closed entries as absent, so this changes reachability
+    only, never lookup semantics."""
+    with _REGISTRY_LOCK:
+        for key in [k for k, v in _REGISTRY.items() if v is j]:
+            del _REGISTRY[key]
+
+
 def get_journal(workspace: str | Path, settings: Optional[dict] = None,
                 clock: Callable[[], float] = time.time, wall: bool = True,
-                logger=None) -> Optional[Journal]:
+                logger=None, lifecycle: Optional[dict] = None,
+                lifecycle_timer: Optional[StageTimer] = None
+                ) -> Optional[Journal]:
     """The shared per-workspace journal: cortex, knowledge, governance, and
     the event store all group-commit through ONE segment writer (that is the
     whole point — one fsync covers everyone's records). First creator's
@@ -992,7 +1264,8 @@ def get_journal(workspace: str | Path, settings: Optional[dict] = None,
                 j.wall = True
             return j
         try:
-            j = Journal(root, s, clock=clock, wall=wall, logger=logger)
+            j = Journal(root, s, clock=clock, wall=wall, logger=logger,
+                        lifecycle=lifecycle, lifecycle_timer=lifecycle_timer)
         except OSError as exc:
             if logger is not None:
                 logger.warn(f"journal unavailable at {root}: {exc}")
@@ -1018,14 +1291,17 @@ def peek_journal(workspace: str | Path,
 
 
 def reset_journals() -> None:
-    """Close every registered journal (tests)."""
+    """Close every registered journal (tests). Snapshot-then-close: each
+    close now discards itself from the registry (under the registry lock),
+    so closing while holding it would deadlock."""
     with _REGISTRY_LOCK:
-        for j in list(_REGISTRY.values()):
-            try:
-                j.close()
-            except Exception:  # noqa: BLE001
-                pass
+        journals = list(_REGISTRY.values())
         _REGISTRY.clear()
+    for j in journals:
+        try:
+            j.close()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 @atexit.register
