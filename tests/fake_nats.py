@@ -116,8 +116,10 @@ class _FakeJetStream:
     async def publish(self, subject: str, payload: bytes):
         if self.state.publish_error is not None:
             raise self.state.publish_error
-        self.state.add(subject, payload)
+        seq = self.state.add(subject, payload)
         self.state.published_subjects.append(subject)
+        # Real clients return a PubAck carrying the stream sequence.
+        return types.SimpleNamespace(stream=None, seq=seq, duplicate=False)
 
     async def pull_subscribe(self, subject, durable=None, stream=None, config=None):
         if stream not in self.state.streams:

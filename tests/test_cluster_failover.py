@@ -367,6 +367,37 @@ class TestSitrepClusterCollector:
         assert out["status"] == "warn"
         assert "last failover: w0 (3 ws, 7 replayed, 41.2ms)" in out["summary"]
 
+    def test_route_log_kind_and_last_handoff_in_summary(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = self._status(
+            routeLog={"kind": "nats", "published": 10, "healthy": True,
+                      "outboxDepth": 0, "breaker": "closed"},
+            lastHandoff={"ws": "tenant3", "from": "w0", "to": "w1",
+                         "replayedRecords": 0, "durationMs": 3.4})
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "ok"
+        assert "routeLog=nats" in out["summary"]
+        assert "last handoff: tenant3 w0→w1 (0 replayed, 3.4ms)" \
+            in out["summary"]
+        assert out["items"][0]["lastHandoff"]["to"] == "w1"
+        assert out["items"][0]["routeLog"]["kind"] == "nats"
+
+    def test_degraded_route_log_warns(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        for route_log, needle in (
+                ({"kind": "nats", "healthy": False}, "routeLog(nats) unhealthy"),
+                ({"kind": "nats", "healthy": True, "outboxDepth": 7},
+                 "routeLog outbox=7"),
+                ({"kind": "nats", "healthy": True, "outboxDepth": 0,
+                  "breaker": "open"}, "routeLog breaker=open")):
+            out = collect_cluster(
+                {}, {"cluster_status": lambda rl=route_log:
+                     self._status(routeLog=rl)})
+            assert out["status"] == "warn", route_log
+            assert needle in out["summary"], out["summary"]
+
 
 class TestEscapeHatch:
     def test_no_cluster_config_keeps_timer_names_unprefixed(self):

@@ -1,0 +1,454 @@
+"""Planned lease handoff + cross-machine route log chaos (ISSUE 12).
+
+The handoff is failover's zero-downtime peer: drain → journal group-commit
+barrier + snapshot ship → epoch++/durable fence regrant → resume, with **no
+journal replay and no route-log redelivery**. The storms here pin that
+against the PR-9 oracle machinery: a storm interleaved with planned
+handoffs converges byte-identical to an untouched single-owner run, aborts
+are clean (the source keeps serving), the whole thing is bit-reproducible
+per CHAOS_SEED, and the same storm holds when the route log rides the NATS
+adapter (fake broker) instead of MemoryTransport. The two-supervisor
+adoption test is the cross-machine shape: a replacement supervisor
+generation recovers watermarks from the shared schedule, re-grants every
+lease (fencing the old generation), and finishes the storm byte-identical
+to a never-replaced oracle.
+
+``CHAOS_SEED`` (env) parameterizes the storms; CI runs seeds 0/1/2.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from fake_nats import FakeJetStreamState, install
+from test_cluster_failover import (BASE_T, CHAOS_SEED, JOURNAL_CFG, N_OPS,
+                                   SetClock, build_ops, flush_cluster,
+                                   run_storm, tenant_state, verdict_check)
+
+from vainplex_openclaw_tpu.analysis.witness import LockOrderWitness
+from vainplex_openclaw_tpu.cluster import ClusterSupervisor
+from vainplex_openclaw_tpu.cluster.ring import FENCE_FILE, LeaseTable
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                     installed)
+from vainplex_openclaw_tpu.storage.journal import Journal, reset_journals
+
+HANDOFF_STEPS = (60, 120)
+
+
+def _strip_timing(record: dict) -> dict:
+    return {k: v for k, v in record.items()
+            if k not in ("durationMs", "stagesMs", "at")}
+
+
+def run_handoff_storm(root: Path, seed: int, handoff_steps=HANDOFF_STEPS,
+                      kill_step=None, fault_specs=(), transport=None,
+                      config=None) -> dict:
+    """The PR-9 storm shape with planned handoffs interleaved: at each
+    ``handoff_steps`` op index, the least-recently-moved leased workspace
+    is handed to the least-loaded other worker."""
+    reset_journals()
+    clock = SetClock()
+    results: dict[int, dict] = {}
+    cfg = {"workers": 3, "ackEveryOps": 6, "deterministicIds": True,
+           "heartbeatMissLimit": 2}
+    cfg.update(config or {})
+    sup = ClusterSupervisor(
+        root, cfg, clock=clock, wall_timers=False, settable_clock=clock,
+        journal_cfg=JOURNAL_CFG, logger=list_logger(), transport=transport,
+        on_result=lambda op, obs: results.__setitem__(op.get("i"), obs))
+    witness = LockOrderWitness()
+    witness.wrap_attr(sup, "_lock", "ClusterSupervisor._lock")
+    witness.wrap_attr(sup.leases, "_lock", "LeaseTable._lock")
+    if sup.leases.journal is not None:
+        witness.wrap_attr(sup.leases.journal, "_commit_lock",
+                          "Journal._commit_lock")
+        witness.wrap_attr(sup.leases.journal, "_buffer_lock",
+                          "Journal._buffer_lock")
+    witness.wrap_attr(sup.timer, "_lock", "ClusterSupervisor.timer._lock")
+
+    ops = build_ops(seed, root)
+    specs = [
+        FaultSpec("cluster.route", steps=(37,)),
+        FaultSpec("journal.fsync", rate=0.05),
+        FaultSpec("journal.append", rate=0.02, mode="torn"),
+        *fault_specs,
+    ]
+    if kill_step is not None:
+        specs.append(FaultSpec("cluster.worker.crash", steps=(kill_step,)))
+    plan = FaultPlan(specs, seed=seed)
+    handoff_at = set(handoff_steps)
+    next_move = 0
+    with installed(plan):
+        for i, op in enumerate(ops):
+            sup.submit(op)
+            sup.tick()
+            if i in handoff_at:
+                leased = sorted(sup.leases.snapshot())
+                if leased:
+                    sup.handoff(leased[next_move % len(leased)],
+                                reason=f"storm step {i}")
+                    next_move += 1
+        flush_cluster(sup)
+    stats = sup.stats()
+    state = tenant_state(root)
+    summary = {
+        "results": {i: results.get(i) for i in range(N_OPS)},
+        "fired": dict(plan.fired),
+        "handoffs": [_strip_timing(h) for h in stats["handoffs"]],
+        "handoffAborts": stats["handoffAborts"],
+        "failovers": [{k: v for k, v in f.items() if k != "durationMs"}
+                      for f in stats["failovers"]],
+        "membership": stats["membership"],
+        "fencedRecords": stats["fencedRecords"],
+        "redelivered": stats["redelivered"],
+        "leases": {Path(ws).name: lease
+                   for ws, lease in stats["leases"].items()},
+        "state": state,
+    }
+    sup.stop()
+    witness.assert_acyclic()
+    reset_journals()
+    return summary
+
+
+class TestPlannedHandoff:
+    def test_handoff_mid_storm_zero_replay_zero_losses(self, tmp_path):
+        moved = run_handoff_storm(tmp_path / "move", CHAOS_SEED)
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+
+        assert len(moved["handoffs"]) == len(HANDOFF_STEPS)
+        for h in moved["handoffs"]:
+            # THE handoff contract: nothing replayed, nothing redelivered
+            assert h["replayedRecords"] == 0, h
+            assert h["redelivered"] == 0, h
+            assert h["from"] != h["to"]
+        assert moved["handoffAborts"] == 0
+        ops = build_ops(CHAOS_SEED, tmp_path / "move")
+        verdict_check(moved, ops)
+        # no stale-epoch write ever landed, and no worker died
+        assert moved["fencedRecords"] == 0
+        assert moved["membership"]["dead"] == []
+        # bit-identical converged state vs the never-moved oracle
+        assert moved["state"].keys() == oracle["state"].keys()
+        for name in moved["state"]:
+            assert moved["state"][name] == oracle["state"][name], name
+        # the moved workspaces carry bumped epochs; the rest stay at 1
+        bumped = [ws for ws, lease in moved["leases"].items()
+                  if lease["epoch"] > 1]
+        assert len(bumped) == len(HANDOFF_STEPS)
+
+    def test_handoff_storm_bit_identical_per_seed(self, tmp_path):
+        a = run_handoff_storm(tmp_path / "a", CHAOS_SEED)
+        b = run_handoff_storm(tmp_path / "b", CHAOS_SEED)
+        assert a == b
+        assert sum(a["fired"].values()) > 0, "the storm was real"
+
+    def test_handoff_plus_worker_kill_still_converges(self, tmp_path):
+        """Handoffs and a crash failover in ONE storm: the two movement
+        paths compose — state still converges to the untouched oracle."""
+        both = run_handoff_storm(tmp_path / "both", CHAOS_SEED,
+                                 kill_step=90)
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        assert len(both["failovers"]) == 1
+        assert len(both["handoffs"]) >= 1
+        ops = build_ops(CHAOS_SEED, tmp_path / "both")
+        verdict_check(both, ops)
+        assert both["fencedRecords"] == 0
+        for name in oracle["state"]:
+            assert both["state"][name] == oracle["state"][name], name
+
+    @pytest.mark.parametrize("site", ["cluster.handoff.drain",
+                                      "cluster.handoff.barrier",
+                                      "cluster.handoff.regrant"])
+    def test_pre_grant_fault_aborts_cleanly(self, tmp_path, site):
+        """A fault at any pre-grant stage aborts the handoff: counted, the
+        source keeps serving, zero losses, state untouched vs oracle."""
+        aborted = run_handoff_storm(
+            tmp_path / "abort", CHAOS_SEED, handoff_steps=(60,),
+            fault_specs=(FaultSpec(site, steps=(1,)),))
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        assert aborted["fired"].get(site) == 1
+        assert aborted["handoffAborts"] == 1
+        assert aborted["handoffs"] == []
+        ops = build_ops(CHAOS_SEED, tmp_path / "abort")
+        verdict_check(aborted, ops)
+        # the abort left ownership unmoved: every lease still at epoch 1
+        assert all(lease["epoch"] == 1
+                   for lease in aborted["leases"].values())
+        for name in oracle["state"]:
+            assert aborted["state"][name] == oracle["state"][name], name
+
+    def test_fence_write_fault_at_regrant_falls_back_to_source(self, tmp_path):
+        """``cluster.lease`` firing inside the handoff's grant (the fence
+        write itself): the supervisor never admits an owner behind an
+        unwritten fence — it re-grants BACK to the source (consistent
+        owner+fence at a newer epoch), counts the abort, and the storm
+        still converges. The 8 first-sight grants precede the handoff, so
+        the handoff's fence write is lease call #9."""
+        aborted = run_handoff_storm(
+            tmp_path / "fence", CHAOS_SEED, handoff_steps=(60,),
+            fault_specs=(FaultSpec("cluster.lease", steps=(9,)),))
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        assert aborted["fired"].get("cluster.lease") == 1
+        assert aborted["handoffAborts"] == 1
+        assert aborted["handoffs"] == []
+        ops = build_ops(CHAOS_SEED, tmp_path / "fence")
+        verdict_check(aborted, ops)
+        assert aborted["fencedRecords"] == 0
+        # exactly one workspace carries the fallback's bumped epochs; its
+        # owner is consistent with its fence, and state converges
+        bumped = {ws: l for ws, l in aborted["leases"].items()
+                  if l["epoch"] > 1}
+        assert len(bumped) == 1, aborted["leases"]
+        for name in oracle["state"]:
+            assert aborted["state"][name] == oracle["state"][name], name
+
+    def test_resume_fault_post_grant_is_retried(self, tmp_path):
+        """Past the regrant commit point the handoff MUST complete: a
+        resume fault is retried like failover recovery, the move lands,
+        and the storm still converges."""
+        done = run_handoff_storm(
+            tmp_path / "resume", CHAOS_SEED, handoff_steps=(60,),
+            fault_specs=(FaultSpec("cluster.handoff.resume", steps=(1,)),))
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        assert done["fired"].get("cluster.handoff.resume") == 1
+        assert done["handoffAborts"] == 0
+        assert len(done["handoffs"]) == 1
+        assert done["handoffs"][0]["replayedRecords"] == 0
+        ops = build_ops(CHAOS_SEED, tmp_path / "resume")
+        verdict_check(done, ops)
+        for name in oracle["state"]:
+            assert done["state"][name] == oracle["state"][name], name
+
+    def test_retire_worker_moves_everything_planned(self, tmp_path):
+        reset_journals()
+        clock = SetClock()
+        results: dict[int, dict] = {}
+        sup = ClusterSupervisor(
+            tmp_path, {"workers": 3, "ackEveryOps": 6,
+                       "deterministicIds": True},
+            clock=clock, wall_timers=False, settable_clock=clock,
+            journal_cfg=JOURNAL_CFG,
+            on_result=lambda op, obs: results.__setitem__(op.get("i"), obs))
+        ops = build_ops(CHAOS_SEED, tmp_path)
+        for op in ops[:90]:
+            sup.submit(op)
+        victim = sup.stats()["membership"]["live"][0]
+        owned = sup.leases.owned_by(victim)
+        out = sup.retire_worker(victim)
+        assert out["retired"] is True
+        assert out["moved"] == len(owned) and out["aborted"] == 0
+        stats = sup.stats()
+        # a PLANNED retirement is not a death: the sitrep collector must
+        # not latch to warn over it
+        assert victim not in stats["membership"]["dead"]
+        assert stats["membership"]["retired"] == [victim]
+        assert victim not in stats["membership"]["live"]
+        assert stats["failovers"] == []  # planned, not crash
+        assert all(h["replayedRecords"] == 0 and h["redelivered"] == 0
+                   for h in stats["handoffs"])
+        assert sup.leases.owned_by(victim) == []
+        for op in ops[90:]:
+            sup.submit(op)
+        sup.drain()
+        assert len(results) == N_OPS
+        sup.stop()
+        reset_journals()
+
+
+class TestNatsRouteLog:
+    def test_storm_over_nats_route_log_matches_memory_oracle(self, tmp_path):
+        """The tentpole's transport half: the SAME chaos storm (including
+        a worker kill, so redelivery really rides the adapter's fetch)
+        over JetStream (fake broker) converges to the MemoryTransport
+        oracle's bytes, with the watermark schedule visible on the wire."""
+        state = FakeJetStreamState()
+        uninstall = install(state)
+        try:
+            nats_run = run_handoff_storm(
+                tmp_path / "nats", CHAOS_SEED, kill_step=90,
+                config={"routeTransport": "nats", "ackWatermarkEvery": 1})
+        finally:
+            uninstall()
+        oracle = run_handoff_storm(tmp_path / "mem", CHAOS_SEED,
+                                   kill_step=90)
+        ops = build_ops(CHAOS_SEED, tmp_path / "nats")
+        verdict_check(nats_run, ops)
+        assert len(nats_run["failovers"]) == 1
+        for name in oracle["state"]:
+            assert nats_run["state"][name] == oracle["state"][name], name
+        # the schedule really lives on the broker: route + ack subjects
+        subjects = set(state.published_subjects)
+        assert any(s.startswith("cluster.route.") for s in subjects)
+        assert any(s.startswith("cluster.ack.") for s in subjects)
+
+
+class TestTwoSupervisorAdoption:
+    """Cross-machine shape: supervisor generation A serves the first half,
+    goes away (workers crash — what a machine loss looks like from the
+    journals' perspective), and generation B adopts the same root +
+    schedule: leases re-granted to B's workers (epoch++, durable fences),
+    watermarks recovered from the spine's ack events, redelivery from the
+    shared route log. The whole two-generation run must converge
+    byte-identical to a single never-replaced supervisor."""
+
+    SPLIT = 90
+
+    def _run_two_generations(self, root: Path, seed: int,
+                             kill_step=None) -> dict:
+        from vainplex_openclaw_tpu.events.transport import MemoryTransport
+
+        reset_journals()
+        clock = SetClock()
+        results: dict[int, dict] = {}
+        note = lambda op, obs: results.__setitem__(op.get("i"), obs)  # noqa: E731
+        transport = MemoryTransport(clock=clock)  # the shared schedule
+        ops = build_ops(seed, root)
+
+        sup_a = ClusterSupervisor(
+            root, {"workers": 3, "ackEveryOps": 6, "deterministicIds": True,
+                   "ackWatermarkEvery": 1},
+            clock=clock, wall_timers=False, settable_clock=clock,
+            journal_cfg=JOURNAL_CFG, transport=transport, on_result=note)
+        plan = FaultPlan([FaultSpec("journal.fsync", rate=0.05)], seed=seed)
+        with installed(plan):
+            for op in ops[:self.SPLIT]:
+                sup_a.submit(op)
+                sup_a.tick()
+            # generation A drains to the ack boundary, then its machine
+            # "dies": every worker crashes (journals abandoned, nothing
+            # flushed beyond what was already committed+acked).
+            sup_a.drain()
+            leases_before = {Path(ws).name: lease["epoch"]
+                             for ws, lease in sup_a.leases.snapshot().items()}
+            for state in sup_a.workers().values():
+                state.handle.crash()
+            sup_a.leases.close()
+
+            sup_b = ClusterSupervisor(
+                root, {"workers": 3, "ackEveryOps": 6,
+                       "deterministicIds": True, "ackWatermarkEvery": 1,
+                       "workerPrefix": "b"},
+                clock=clock, wall_timers=False, settable_clock=clock,
+                journal_cfg=JOURNAL_CFG, transport=transport, on_result=note,
+                adopt=True)
+            for op in ops[self.SPLIT:]:
+                sup_b.submit(op)
+                sup_b.tick()
+                if kill_step is not None and op["i"] == kill_step:
+                    live = sup_b.stats()["membership"]["live"]
+                    if len(live) > 1:
+                        sup_b.workers()[live[0]].handle.crash()
+                        sup_b.tick()
+            flush_cluster(sup_b)
+        stats = sup_b.stats()
+        state = tenant_state(root)
+        summary = {
+            "results": {i: results.get(i) for i in range(N_OPS)},
+            "leasesBefore": leases_before,
+            "leases": {Path(ws).name: lease
+                       for ws, lease in stats["leases"].items()},
+            "adoption": [f for f in stats["failovers"]
+                         if f["worker"] == "(adopted)"],
+            "membership": stats["membership"],
+            "fencedRecords": stats["fencedRecords"],
+            "state": state,
+        }
+        sup_b.stop()
+        reset_journals()
+        return summary
+
+    def test_adoption_converges_to_single_supervisor_oracle(self, tmp_path):
+        two = self._run_two_generations(tmp_path / "two", CHAOS_SEED)
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        ops = build_ops(CHAOS_SEED, tmp_path / "two")
+        verdict_check(two, ops)
+        assert len(two["adoption"]) == 1
+        adoption = two["adoption"][0]
+        assert adoption["workspacesMoved"] == len(two["leasesBefore"])
+        # every adopted lease moved to a b-worker at a bumped epoch
+        for ws, lease in two["leases"].items():
+            assert lease["owner"].startswith("b"), lease
+            assert lease["epoch"] == two["leasesBefore"][ws] + 1, ws
+        assert two["state"].keys() == oracle["state"].keys()
+        for name in two["state"]:
+            assert two["state"][name] == oracle["state"][name], name
+
+    def test_adoption_with_crash_in_second_generation(self, tmp_path):
+        two = self._run_two_generations(tmp_path / "two", CHAOS_SEED,
+                                        kill_step=120)
+        oracle = run_storm(tmp_path / "oracle", CHAOS_SEED)
+        ops = build_ops(CHAOS_SEED, tmp_path / "two")
+        verdict_check(two, ops)
+        assert len(two["membership"]["dead"]) == 1
+        for name in oracle["state"]:
+            assert two["state"][name] == oracle["state"][name], name
+
+    def test_old_generation_zombie_write_is_fenced(self, tmp_path):
+        """A writer of generation A that survived the machine loss (the
+        partition case) still holds epoch N; after B's adoption every
+        workspace is fenced at N+1 — the zombie's commit dies at the
+        journal boundary, counted, bytes untouched."""
+        two = self._run_two_generations(tmp_path / "z", CHAOS_SEED)
+        ws_name, lease = sorted(two["leases"].items())[0]
+        ws = tmp_path / "z" / "tenants" / ws_name
+        before = {p.name: p.read_bytes()
+                  for p in (ws / "memory" / "reboot").glob("*.json")}
+        zombie = Journal(ws / "journal", JOURNAL_CFG, wall=False)
+        zombie.register_snapshot(
+            "cortex:threads", ws / "memory" / "reboot" / "threads.json",
+            indent=None)
+        zombie.set_fence(ws / FENCE_FILE, lease["epoch"] - 1)  # generation A
+        zombie.append("cortex:threads", {"threads": ["ZOMBIE WRITE"]})
+        assert zombie.commit() is False
+        assert zombie.stats()["fencedRecords"] == 1
+        zombie.close()
+        after = {p.name: p.read_bytes()
+                 for p in (ws / "memory" / "reboot").glob("*.json")}
+        assert after == before
+        assert LeaseTable.read_fence(ws)["epoch"] == lease["epoch"]
+        reset_journals()
+
+
+class TestWatermarkRecovery:
+    def test_recover_watermarks_roundtrip(self, tmp_path):
+        reset_journals()
+        clock = SetClock()
+        sup = ClusterSupervisor(
+            tmp_path, {"workers": 2, "ackEveryOps": 4,
+                       "deterministicIds": True, "ackWatermarkEvery": 1},
+            clock=clock, wall_timers=False, settable_clock=clock,
+            journal_cfg=JOURNAL_CFG)
+        ops = build_ops(CHAOS_SEED, tmp_path)
+        for op in ops[:48]:
+            sup.submit(op)
+        sup.drain()
+        marks = sup.recover_watermarks()
+        with sup._lock:
+            acked = dict(sup._acked)
+        assert marks == acked, "published watermarks mirror the acked map"
+        assert marks, "the storm acked something"
+        sup.stop()
+        reset_journals()
+
+    def test_watermarks_off_by_default(self, tmp_path):
+        reset_journals()
+        clock = SetClock()
+        sup = ClusterSupervisor(
+            tmp_path, {"workers": 2, "ackEveryOps": 4,
+                       "deterministicIds": True},
+            clock=clock, wall_timers=False, settable_clock=clock,
+            journal_cfg=JOURNAL_CFG)
+        ops = build_ops(CHAOS_SEED, tmp_path)
+        for op in ops[:24]:
+            sup.submit(op)
+        sup.drain()
+        # PR-9 escape hatch: the spine carries route events ONLY
+        assert sup.recover_watermarks() == {}
+        assert all(e.type == "cluster.route"
+                   for e in sup.transport.fetch(">"))
+        sup.stop()
+        reset_journals()

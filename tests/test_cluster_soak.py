@@ -1,0 +1,61 @@
+"""100k-workspace cluster soak (ISSUE 12, slow marker — the CI soak job).
+
+One seeded run of ``bench.bench_cluster_soak``: zipf draws over a
+100 000-workspace id space through a real 3-worker cluster while chaos
+storms (seeded journal/lifecycle faults, a worker kill with failover,
+replacement join and planned rebalance), planned handoffs, and LRU
+hibernation churn interleave. The gates are the acceptance criteria:
+
+- **zero verdict losses** — every op produced its final observation and
+  every expected denial/redaction was observed;
+- **bounded heap** — growth *decelerates* across windows (the route-log
+  ring is retention-capped; what remains is zipf tail discovery) and the
+  resident tracker count respects the hibernation cap;
+- **bounded journal/cold growth** — per-window disk deltas stay flat
+  (steady append is healthy; acceleration is the leak signal) and the
+  cold tier stays capped;
+- **bounded p99 drift** — the last window's p99 stays within a small
+  factor of the post-warmup window's.
+
+Thresholds are deliberately generous for a shared CI container: they
+catch the O(history) failure modes this PR exists to prevent (unbounded
+resident trackers, unshipped wal accumulation, quadratic route-log
+scans), not millisecond noise.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import bench
+
+pytestmark = pytest.mark.slow
+
+
+def test_100k_workspace_soak_bounded_and_lossless():
+    rec = bench.bench_cluster_soak(n_ops=1600, id_space=100_000,
+                                   workers=3, max_resident=48,
+                                   handoff_every=160, windows=4)
+    assert rec["metric"] == "cluster_soak", rec
+
+    # the churn really happened: chaos, movement, hibernation
+    assert rec["failovers"] >= 1, rec
+    assert rec["handoffs"] >= 3, rec
+    assert rec["hibernation_wakes"] > 0, rec
+    assert rec["faults_fired"] > 0, rec
+    assert rec["distinct_workspaces"] > 200, rec
+
+    # zero verdict losses, nothing fenced (no zombie ever wrote)
+    assert rec["verdict_losses"] == 0, rec
+    assert rec["fenced_records"] == 0, rec
+
+    # bounded heap: growth decelerating, hibernation cap respected
+    assert rec["heap_delta_ratio"] <= 1.5, rec
+    assert rec["resident_trackers_max"] <= 3 * 48 + 8, rec
+
+    # bounded journal/cold growth: flat per-window deltas, capped cold tier
+    assert rec["disk_delta_ratio"] <= 2.0, rec
+    assert rec["cold_mb_by_window"][-1] <= 64.0, rec
+
+    # bounded p99 drift past warmup
+    assert rec["p99_drift_ratio"] <= 8.0, rec

@@ -1,0 +1,194 @@
+"""Route-log transport contract (ISSUE 12 satellite): the cluster treats
+its transport as a *replayable schedule* — ``publish`` advances
+``last_sequence()`` by exactly one, and ``fetch(subject,
+start_seq=watermark)`` returns exactly the matching events past the
+watermark, in publish order, with ``event.seq`` carrying the next
+watermark. This suite pins those semantics IDENTICALLY across
+MemoryTransport, FileTransport and the JetStream adapter (scripted fake
+broker — no live NATS in CI), so a ``cluster.routeTransport`` swap can
+never silently change route-log replay behavior."""
+
+from __future__ import annotations
+
+import pytest
+
+from fake_nats import FakeJetStreamState, install
+
+from vainplex_openclaw_tpu.events.envelope import ClawEvent
+
+SUBJECTS = ("cluster.route.t0", "cluster.route.t1", "cluster.ack.t0")
+
+
+def _event(i: int, subject: str) -> ClawEvent:
+    # The supervisor's route-event shape: op payload, internal visibility.
+    return ClawEvent(
+        id=f"route:{i}", ts=1_753_772_400_000.0 + i, agent="cluster",
+        session="cluster", type="cluster.route", canonical_type=None,
+        legacy_type=None, schema_version=1,
+        source={"component": "cluster-supervisor"}, actor={}, scope={},
+        trace={}, visibility="internal",
+        payload={"i": i, "subject": subject})
+
+
+class _NatsRig:
+    """Owns the fake broker install for the lifetime of one transport."""
+
+    def __init__(self):
+        self.state = FakeJetStreamState()
+        self.uninstall = install(self.state)
+        from vainplex_openclaw_tpu.events.nats_adapter import NatsTransport
+
+        self.transport = NatsTransport("nats://broker.example:4222",
+                                       stream="CLAW_ROUTES", prefix="cluster")
+        assert self.transport.connect()
+
+    def close(self):
+        self.transport.drain()
+        self.uninstall()
+
+
+@pytest.fixture(params=["memory", "file", "nats"])
+def transport(request, tmp_path):
+    if request.param == "memory":
+        from vainplex_openclaw_tpu.events.transport import MemoryTransport
+
+        yield MemoryTransport()
+        return
+    if request.param == "file":
+        from vainplex_openclaw_tpu.events.transport import FileTransport
+
+        t = FileTransport(tmp_path / "route-log")
+        yield t
+        t.drain()
+        return
+    rig = _NatsRig()
+    yield rig.transport
+    rig.close()
+
+
+def _publish_script(t, n: int = 12) -> None:
+    """Round-robin the three subjects; every transport sees byte-identical
+    publish order."""
+    for i in range(n):
+        assert t.publish(SUBJECTS[i % 3], _event(i, SUBJECTS[i % 3]))
+
+
+def _rows(events) -> list:
+    return [(e.seq, e.payload["i"]) for e in events]
+
+
+class TestRouteTransportContract:
+    def test_publish_advances_last_sequence_by_one(self, transport):
+        assert transport.last_sequence() == 0
+        for i in range(5):
+            before = transport.last_sequence()
+            event = _event(i, SUBJECTS[0])
+            assert transport.publish(SUBJECTS[0], event)
+            # the publisher learns its op's TRUE sequence from the event
+            # itself (memory/file stamp locally, NATS from the PubAck) —
+            # the watermark a shared-stream peer cannot skew
+            assert event.seq == before + 1
+            assert transport.last_sequence() == before + 1
+
+    def test_fetch_all_in_publish_order_with_seqs(self, transport):
+        _publish_script(transport)
+        rows = _rows(transport.fetch(">"))
+        assert [i for _s, i in rows] == list(range(12))
+        seqs = [s for s, _i in rows]
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs)  # dense, strictly monotone
+        assert seqs[-1] == transport.last_sequence()
+
+    def test_subject_filter_exact_and_wildcards(self, transport):
+        _publish_script(transport)
+        exact = _rows(transport.fetch("cluster.route.t0"))
+        assert [i for _s, i in exact] == [0, 3, 6, 9]
+        star = _rows(transport.fetch("cluster.route.*"))
+        assert [i for _s, i in star] == [0, 1, 3, 4, 6, 7, 9, 10]
+        rest = _rows(transport.fetch("cluster.>"))
+        assert [i for _s, i in rest] == list(range(12))
+        assert _rows(transport.fetch("cluster.nothing.here")) == []
+
+    def test_redelivery_watermark_semantics(self, transport):
+        """THE cluster contract: everything past the acked watermark for
+        one workspace's subject, nothing at or before it."""
+        _publish_script(transport)
+        full = _rows(transport.fetch("cluster.route.t1"))
+        assert [i for _s, i in full] == [1, 4, 7, 10]
+        watermark = full[1][0]  # acked through op 4
+        replay = _rows(transport.fetch("cluster.route.t1",
+                                       start_seq=watermark))
+        assert [i for _s, i in replay] == [7, 10]
+        assert all(s > watermark for s, _i in replay)
+        # watermark == head: nothing to redeliver
+        assert _rows(transport.fetch("cluster.route.t1",
+                                     start_seq=full[-1][0])) == []
+
+    def test_batch_paging_resumes_from_seq(self, transport):
+        _publish_script(transport)
+        page1 = _rows(transport.fetch("cluster.route.*", batch=3))
+        assert len(page1) == 3
+        page2 = _rows(transport.fetch("cluster.route.*",
+                                      start_seq=page1[-1][0], batch=3))
+        assert len(page2) == 3
+        assert [i for _s, i in page1 + page2] == [0, 1, 3, 4, 6, 7]
+
+    def test_payload_roundtrip(self, transport):
+        _publish_script(transport, n=3)
+        events = list(transport.fetch("cluster.route.t0"))
+        assert events[0].payload == {"i": 0, "subject": "cluster.route.t0"}
+        assert events[0].type == "cluster.route"
+        assert events[0].agent == "cluster"
+
+
+def test_nats_fetch_broker_error_is_visible_not_silent():
+    """A broker failure mid-fetch must never read as a clean end-of-stream
+    (failover redelivery would silently truncate): the error lands in
+    ``stats.last_error`` even though the generator ends without raising."""
+    rig = _NatsRig()
+    try:
+        _publish_script(rig.transport, n=6)
+        rig.state.fetch_error = RuntimeError("broker went away")
+        rig.transport.stats.last_error = None
+        out = list(rig.transport.fetch("cluster.route.*"))
+        assert out == []
+        assert "broker went away" in (rig.transport.stats.last_error or "")
+        # broker back: the same call serves the full stream again
+        rig.state.fetch_error = None
+        assert len(list(rig.transport.fetch(">"))) == 6
+    finally:
+        rig.close()
+
+
+def test_cross_transport_replay_identical(tmp_path):
+    """One publish script, three transports: the (order, payload) view of
+    full fetches AND post-watermark replays must be indistinguishable."""
+    from vainplex_openclaw_tpu.events.transport import (FileTransport,
+                                                        MemoryTransport)
+
+    views = {}
+    replays = {}
+    rigs = []
+
+    def harvest(name, t):
+        _publish_script(t)
+        full = [(e.payload["i"], SUBJECTS[e.payload["i"] % 3])
+                for e in t.fetch(">")]
+        t1 = _rows(t.fetch("cluster.route.t1"))
+        mark = t1[1][0]
+        views[name] = full
+        replays[name] = [e.payload["i"]
+                         for e in t.fetch("cluster.route.t1",
+                                          start_seq=mark)]
+
+    harvest("memory", MemoryTransport())
+    ft = FileTransport(tmp_path / "rl")
+    harvest("file", ft)
+    ft.drain()
+    rig = _NatsRig()
+    try:
+        harvest("nats", rig.transport)
+    finally:
+        rig.close()
+    assert views["memory"] == views["file"] == views["nats"]
+    assert replays["memory"] == replays["file"] == replays["nats"]

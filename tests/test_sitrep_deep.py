@@ -237,6 +237,38 @@ class TestGenerateAndRotate:
                                  clock=FakeClock())
         assert report["collectors"]["goals"]["status"] == "ok"
 
+    def test_cluster_collector_item_shape_pin(self, tmp_path):
+        """The cluster item's key set is an operator contract (/ops and
+        the slo report both read it): ISSUE 12 added the route-log
+        transport view, lastHandoff and the admission surface — a key
+        silently dropped here would blank a dashboard panel, not fail."""
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = {
+            "workers": {"w0": {"alive": True,
+                               "breaker": {"state": "closed"}}},
+            "membership": {"live": ["w0"], "dead": []},
+            "leases": {"/x/tenant0": {"owner": "w0", "epoch": 2}},
+            "routed": 5, "redelivered": 0, "routeFaults": 0, "inflight": 0,
+            "fencedRecords": 0, "lastFailover": None, "failovers": [],
+            "handoffAborts": 0, "ingressShed": 3,
+            "admission": {"enabled": True, "shed": 3},
+            "lastHandoff": {"ws": "tenant0", "from": "w0", "to": "w1",
+                            "replayedRecords": 0, "durationMs": 2.5},
+            "routeLog": {"kind": "memory", "published": 5,
+                         "publishFailures": 0, "healthy": True,
+                         "outboxDepth": 0},
+        }
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "ok"
+        assert set(out["items"][0]) == {
+            "membership", "workers", "leaseEpochs", "lastFailover",
+            "lastHandoff", "handoffAborts", "ingressShed", "admission",
+            "routed", "redelivered", "routeFaults", "inflight",
+            "fencedRecords", "routeLog"}
+        assert out["items"][0]["routeLog"]["kind"] == "memory"
+        assert "last handoff: tenant0 w0→w1" in out["summary"]
+
     def test_custom_collectors_namespaced(self, tmp_path):
         cfg = self.config()
         cfg["customCollectors"] = [{"id": "disk", "command": "echo '[]'"}]

@@ -194,12 +194,17 @@ GUARDED: tuple = (
     # lease table is the fencing source of truth — both hot (delivery and
     # lease grants must never convoy behind blocking work under the lock;
     # journal/fence I/O happens outside the critical sections).
+    # Handoff/admission state (ISSUE 12): the handoff record list and the
+    # abort/shed counters join the same dispatch lock; the ack-watermark
+    # publish throttle (_ack_unpub) is mutated inside _note_ack's critical
+    # section, with the actual transport publish deliberately OUTSIDE it.
     GuardSpec(
         module="vainplex_openclaw_tpu/cluster/supervisor.py",
         cls="ClusterSupervisor",
-        locks={"_lock": ("_workers", "_acked", "_inflight", "_backlog",
-                         "_failovers", "routed", "redelivered",
-                         "route_faults")},
+        locks={"_lock": ("_workers", "_acked", "_ack_unpub", "_inflight",
+                         "_backlog", "_failovers", "_handoffs", "_retired",
+                         "routed", "redelivered", "route_faults",
+                         "handoff_aborts", "ingress_shed")},
         hot=("_lock",),
     ),
     GuardSpec(

@@ -7,7 +7,8 @@ infrastructure. See docs/cluster.md for the design walkthrough.
 """
 
 from .ring import FENCE_FILE, HashRing, LeaseTable
-from .supervisor import CLUSTER_DEFAULTS, ClusterSupervisor
+from .supervisor import (CLUSTER_DEFAULTS, SHEDDABLE_KINDS,
+                         ClusterSupervisor, build_route_transport)
 from .worker import (InProcessWorker, ProcessWorker, WorkerCrashed,
                      build_worker_gateway, dispatch_op)
 
@@ -19,7 +20,9 @@ __all__ = [
     "InProcessWorker",
     "LeaseTable",
     "ProcessWorker",
+    "SHEDDABLE_KINDS",
     "WorkerCrashed",
+    "build_route_transport",
     "build_worker_gateway",
     "dispatch_op",
 ]

@@ -148,6 +148,12 @@ class LeaseTable:
             self.journal = None  # read-only root: in-memory leases only
         if self.journal is not None:
             self.journal.register_snapshot(self.STREAM, self.path, indent=None)
+            # Supervisor restart/adoption (ISSUE 12): grants from a previous
+            # supervisor generation are durable in the wal the moment
+            # ``grant`` committed, but ``leases.json`` only advances on
+            # compaction — fold the replayed records in BEFORE the read, or
+            # a replacement supervisor would adopt a stale ownership table.
+            self.journal.compact(self.STREAM)
         data = read_json(self.path, None)
         if isinstance(data, dict):
             for ws, lease in (data.get("leases") or {}).items():

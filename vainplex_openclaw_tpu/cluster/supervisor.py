@@ -36,6 +36,7 @@ from typing import Any, Callable, Optional
 from ..events.envelope import ClawEvent
 from ..resilience.faults import FaultError, maybe_fail
 from ..resilience.policy import CircuitBreaker, RetryPolicy
+from ..storage.journal import peek_journal
 from ..utils.stage_timer import StageTimer
 from .ring import HashRing, LeaseTable
 from .worker import InProcessWorker, ProcessWorker, WorkerCrashed
@@ -57,7 +58,84 @@ CLUSTER_DEFAULTS = {
     # keeps the max-loaded worker within 15% of fair share — the balance
     # term that dominates measured scaling efficiency.
     "loadFactor": 1.15,
+    # Route-log transport behind the EventTransport seam (ISSUE 12):
+    # "memory" keeps the PR-9 single-box behavior byte-for-byte; "file"
+    # gives a single machine a durable replayable schedule; "nats" puts
+    # the schedule on JetStream so supervisors on DIFFERENT machines share
+    # it (outbox/replay/breaker resilience inherited from the PR-4
+    # adapter). A missing nats client degrades to memory, loudly.
+    "routeTransport": "memory",
+    "routeNatsUrl": "nats://localhost:4222",
+    "routeStream": "CLAW_ROUTES",
+    # JetStream stream subjects are "<routePrefix>.>" — both the route
+    # subjects (cluster.route.<ws>) and the ack-watermark subjects
+    # (cluster.ack.<ws>) must live under it.
+    "routePrefix": "cluster",
+    # Acked watermarks as spine events: every Nth per-workspace watermark
+    # advancement is published to ``<ackSubject>.<ws>`` so a peer (or
+    # replacement) supervisor can recover redelivery positions from the
+    # schedule itself instead of from this process's memory. 0 = off —
+    # the PR-9 escape hatch: the spine carries route events only and
+    # sequence numbers are byte-identical to the old behavior.
+    "ackSubject": "cluster.ack",
+    "ackWatermarkEvery": 0,
+    # Supervisor-side admission (ISSUE 12 satellite, PR-9 named follow-up):
+    # a dict ({"enabled": True, "highWatermark": …}) arms the PR-6
+    # AdmissionController at INGRESS — sheddable op kinds are dropped
+    # before they enter the route log when the reported queue depth says
+    # the cluster is saturated. None keeps ingress unconditional.
+    "admission": None,
+    # Planned handoff: how long drain() may wait for a workspace's
+    # in-flight ops (process mode) before the handoff aborts.
+    "handoffDrainTimeoutS": 30.0,
+    # Worker-id prefix ("w" → w0, w1, …). A second supervisor adopting the
+    # same root names its workers distinctly (e.g. "b") so lease history
+    # reads unambiguously across supervisor generations.
+    "workerPrefix": "w",
 }
+
+# Ingress kinds the supervisor may shed under admission pressure: message
+# ingest feeds observability/cortex work (the single-process path sheds the
+# same work via ADMISSION_SHEDDABLE_HOOKS); verdict-bearing tool ops are
+# never consulted — mirroring NEVER_SHED_HOOKS one level up.
+SHEDDABLE_KINDS = frozenset({"msg_in", "msg_out"})
+
+
+def build_route_transport(cfg: dict, root: Path, clock, logger=None):
+    """The route log's transport behind the ``EventTransport`` seam:
+    ``(transport, kind)`` per ``cluster.routeTransport``. The TACCL stance
+    made concrete: the route log IS the cross-shard communication schedule,
+    so which wire carries it is a *config choice with a contract* —
+    ``fetch(subject, start_seq=watermark)`` replay semantics are pinned
+    identical across all three kinds by tests/test_route_transport_contract
+    — not an accident of whatever transport happened to be handy."""
+    kind = str(cfg.get("routeTransport", "memory"))
+    if kind == "nats":
+        from ..events.transport import create_nats_transport
+
+        transport = create_nats_transport(
+            str(cfg.get("routeNatsUrl", "nats://localhost:4222")),
+            stream=str(cfg.get("routeStream", "CLAW_ROUTES")),
+            prefix=str(cfg.get("routePrefix", "cluster")),
+            logger=logger)
+        if transport is not None:
+            transport.connect()  # failure is fine: outbox + reconnect probes
+            return transport, "nats"
+        if logger is not None:
+            logger.warn("[cluster] routeTransport=nats but no nats client; "
+                        "route log degrades to memory (single-box only)")
+        kind = "memory"
+    if kind == "file":
+        from ..events.transport import FileTransport
+
+        path = Path(root) / "route-log"
+        path.mkdir(parents=True, exist_ok=True)
+        return FileTransport(path, clock=clock), "file"
+    if kind != "memory":
+        raise ValueError(f"unknown cluster.routeTransport {kind!r}")
+    from ..events.transport import MemoryTransport
+
+    return MemoryTransport(clock=clock), "memory"
 
 
 class _WorkerState:
@@ -96,7 +174,8 @@ class ClusterSupervisor:
                  worker_mode: str = "inproc", wall_timers: bool = True,
                  settable_clock: Any = None, journal_cfg: Any = True,
                  lifecycle_cfg: Any = True,
-                 on_result: Optional[Callable[[dict, dict], None]] = None):
+                 on_result: Optional[Callable[[dict, dict], None]] = None,
+                 adopt: bool = False):
         cfg = dict(CLUSTER_DEFAULTS)
         cfg.update(config or {})
         self.cfg = cfg
@@ -117,11 +196,25 @@ class ClusterSupervisor:
         self.leases = LeaseTable(self.root / "cluster", clock=clock,
                                  logger=logger)
         if transport is None:
-            from ..events.transport import MemoryTransport
-
-            transport = MemoryTransport(clock=clock)
+            transport, kind = build_route_transport(cfg, self.root,
+                                                    clock=clock, logger=logger)
+        else:
+            # Explicitly-injected transports map to the same kind
+            # vocabulary the routeLog stats/sitrep summary document —
+            # dashboards match on "memory"/"file"/"nats", never on a
+            # Python class name.
+            kind = {"MemoryTransport": "memory", "FileTransport": "file",
+                    "NatsTransport": "nats"}.get(type(transport).__name__,
+                                                 type(transport).__name__)
         self.transport = transport
+        self.route_transport_kind = kind
         self._route_subject = str(cfg.get("routeSubject", "cluster.route"))
+        self._ack_subject = str(cfg.get("ackSubject", "cluster.ack"))
+        self._ack_pub_every = int(cfg.get("ackWatermarkEvery", 0))
+        from ..resilience.admission import AdmissionController
+
+        self.admission = AdmissionController.from_config(
+            cfg.get("admission") or None)
         self._recover_retry = RetryPolicy(
             max_attempts=int(cfg.get("recoverRetries", 3)),
             base_delay_s=0.0, jitter=0.0, sleep=lambda _s: None)
@@ -138,15 +231,22 @@ class ClusterSupervisor:
         self._lock = threading.Lock()
         self._workers: dict[str, _WorkerState] = {}
         self._acked: dict[str, int] = {}      # ws -> route-log watermark
+        self._ack_unpub: dict[str, int] = {}  # ws -> advancements since pub
         self._inflight: dict[int, str] = {}   # route seq -> ws
         self._backlog: list[tuple[int, dict]] = []
         self._failovers: list[dict] = []
+        self._handoffs: list[dict] = []
+        self._retired: list[str] = []
         self.routed = 0
         self.redelivered = 0
         self.route_faults = 0
+        self.handoff_aborts = 0
+        self.ingress_shed = 0
 
         for i in range(int(cfg.get("workers", 2))):
-            self.add_worker(f"w{i}")
+            self.add_worker(f"{str(cfg.get('workerPrefix', 'w'))}{i}")
+        if adopt:
+            self._adopt_cluster()
 
     # ── membership ───────────────────────────────────────────────────
 
@@ -201,6 +301,12 @@ class ClusterSupervisor:
             trace={}, visibility="internal", payload=dict(op))
         if not self.transport.publish(self._subject(op), event):
             return -1
+        # Every transport stamps the event's TRUE sequence at publish
+        # (memory/file locally, NATS from the PubAck) — prefer it over
+        # last_sequence(), which on a broker stream shared by peer
+        # supervisors could already reflect someone else's later publish.
+        if event.seq is not None:
+            return event.seq
         return self.transport.last_sequence()
 
     def _placement(self, incoming: int = 1) -> tuple[dict, int]:
@@ -241,10 +347,31 @@ class ClusterSupervisor:
         self.timer.add("recover", (t0() - start) * 1000.0)
         return new_owner
 
+    def note_queue_depth(self, depth: int) -> None:
+        """Ingress backpressure signal (whoever owns the arrival queue
+        reports it — the SLO harness's open-loop driver, a front-end's
+        accept loop). Forwards to the admission controller when armed."""
+        if self.admission is not None:
+            self.admission.note_queue_depth(depth)
+
     def submit(self, op: dict) -> Optional[dict]:
         """Route one op: publish to the route log, deliver to the owner.
         Returns the op's observation when delivery was synchronous (the
-        in-process shape); process-mode results arrive via ``tick()``."""
+        in-process shape); process-mode results arrive via ``tick()``.
+
+        With ``cluster.admission`` armed, sheddable op kinds are consulted
+        BEFORE the route publish: a shed op never enters the schedule (no
+        seq, no redelivery debt), completes immediately with a ``shed``
+        observation, and verdict-bearing kinds are never consulted — the
+        workers-mode twin of the single-process hook-level shedding."""
+        if self.admission is not None and op.get("kind") in SHEDDABLE_KINDS:
+            if not self.admission.admit(str(op.get("wsKey")
+                                            or op.get("ws") or "")):
+                with self._lock:
+                    self.ingress_shed += 1
+                obs = {"shed": True}
+                self.on_result(op, obs)
+                return obs
         self._drain_backlog()
         pc = time.perf_counter
         t0 = pc()
@@ -285,11 +412,97 @@ class ClusterSupervisor:
         return obs
 
     def _note_ack(self, seqs: list) -> None:
+        to_publish: list[tuple[str, int]] = []
         with self._lock:
             for seq in seqs:
                 ws = self._inflight.pop(seq, None)
                 if ws is not None and seq > self._acked.get(ws, 0):
                     self._acked[ws] = seq
+                    if self._ack_pub_every > 0:
+                        n = self._ack_unpub.get(ws, 0) + 1
+                        if n >= self._ack_pub_every:
+                            self._ack_unpub[ws] = 0
+                            to_publish.append((ws, seq))
+                        else:
+                            self._ack_unpub[ws] = n
+        # Publish OUTSIDE the dispatch lock: the transport may do I/O.
+        for ws, mark in to_publish:
+            self._publish_watermark(ws, mark)
+
+    def _publish_watermark(self, ws: str, mark: int) -> None:
+        """Acked watermark as a spine event (``cluster.ack.<ws>``): the
+        redelivery position becomes part of the shared schedule, so a peer
+        supervisor recovers it from the transport instead of from this
+        process's memory. Publish failures degrade a peer's recovered
+        watermark backwards (it redelivers MORE, never less) — safe, and
+        counted by the transport like any publish failure."""
+        event = ClawEvent(
+            id=f"ack:{Path(ws).name}", ts=self.clock() * 1000.0,
+            agent="cluster", session="cluster", type="cluster.ack",
+            canonical_type=None, legacy_type=None, schema_version=1,
+            source={"component": "cluster-supervisor"}, actor={}, scope={},
+            trace={}, visibility="internal",
+            payload={"ws": ws, "watermark": mark})
+        self.transport.publish(f"{self._ack_subject}.{Path(ws).name}", event)
+
+    def recover_watermarks(self) -> dict:
+        """Rebuild ``ws -> acked watermark`` from the schedule's ack events
+        (max per workspace). What a replacement/peer supervisor starts
+        redelivery from; a workspace with no published ack recovers to 0 —
+        full route-log replay, the conservative direction."""
+        marks: dict[str, int] = {}
+        for event in self.transport.fetch(
+                subject_filter=f"{self._ack_subject}.>"):
+            payload = event.payload or {}
+            ws = payload.get("ws")
+            try:
+                mark = int(payload.get("watermark") or 0)
+            except (TypeError, ValueError):
+                continue
+            if ws and mark > marks.get(ws, 0):
+                marks[ws] = mark
+        return marks
+
+    def _adopt_cluster(self) -> None:
+        """Take over a cluster root from another (presumed-partitioned or
+        retired) supervisor: recover redelivery watermarks from the shared
+        schedule, then re-grant every persisted lease to this supervisor's
+        own workers — each grant is failover-shaped (epoch++, durable
+        fence, recovery on the new owner, route-log catch-up), so any
+        still-running writer of the previous supervisor generation is
+        fenced at the journal boundary from the first adopted commit on."""
+        pc = time.perf_counter
+        t0 = pc()
+        marks = self.recover_watermarks()
+        with self._lock:
+            self._acked.update(marks)
+        adopted = sorted(self.leases.snapshot())
+        loads, cap = self._placement(incoming=len(adopted))
+        replayed_records = 0
+        redelivered = 0
+        for ws in adopted:
+            new_owner = self.ring.owner(self._ws_key(ws), loads, cap)
+            loads[new_owner] = loads.get(new_owner, 0) + 1
+            epoch = self.leases.grant(ws, new_owner)
+            state = self._worker(new_owner)
+            t_rec = pc()
+            replay = self._recover_retry.call(
+                lambda: state.handle.add_workspace(ws, epoch),
+                retry_on=(FaultError, OSError))
+            self.timer.add("recover", (pc() - t_rec) * 1000.0)
+            replayed_records += (replay or {}).get("records", 0)
+            redelivered += self._redeliver(ws, state)
+        if not adopted:
+            return
+        with self._lock:
+            self.redelivered += redelivered
+            self._failovers.append({
+                "at": self.clock(), "worker": "(adopted)",
+                "reason": "supervisor adoption",
+                "workspacesMoved": len(adopted),
+                "replayedRecords": replayed_records,
+                "redelivered": redelivered,
+                "durationMs": round((pc() - t0) * 1000.0, 3)})
 
     def _drain_backlog(self) -> None:
         with self._lock:
@@ -369,6 +582,11 @@ class ClusterSupervisor:
                 self.on_result({"i": _i}, obs)
             elif kind == "ack":
                 self._note_ack(msg[2])
+            elif kind == "released" and state is not None:
+                state.handle.released[msg[2]] = msg[3]
+            elif kind == "release_failed" and self.logger is not None:
+                self.logger.warn(f"[cluster] release of {msg[2]} on "
+                                 f"{worker_id} failed: {msg[3]}")
             elif kind == "stats" and state is not None:
                 # The child's parting gift: final counters + mergeable
                 # stage-timer states for the cross-worker quantile view.
@@ -472,6 +690,243 @@ class ClusterSupervisor:
                     self._note_ack(acked)
         return count
 
+    # ── planned handoff (ISSUE 12): failover's zero-downtime peer ────
+
+    def _pick_handoff_target(self, ws_key: str, source: str) -> Optional[str]:
+        """Least-loaded live worker other than the source (ties broken by
+        id for determinism). Bounded-load placement applies on the grant
+        like everywhere else; this only picks the candidate."""
+        loads, _cap = self._placement()
+        best = None
+        for wid in self.ring.members():
+            if wid == source:
+                continue
+            state = self._worker(wid)
+            if state is None or not state.alive:
+                continue
+            key = (loads.get(wid, 0), wid)
+            if best is None or key < best[0]:
+                best = (key, wid)
+        return best[1] if best else None
+
+    def _wait_ws_drained(self, ws: str, state: _WorkerState) -> bool:
+        """Drain the source's in-flight ops for ``ws`` to the ack boundary.
+        Sync workers flush inline; process workers flush over the queue and
+        we pump results until no in-flight seq maps to ``ws`` (bounded by
+        ``handoffDrainTimeoutS``)."""
+        if state.handle.sync:
+            self._note_ack(state.handle.flush())
+            with self._lock:
+                return ws not in self._inflight.values()
+        state.handle.flush()
+        deadline = time.time() + float(
+            self.cfg.get("handoffDrainTimeoutS", 30.0))
+        while time.time() < deadline:
+            self._drain_results()
+            with self._lock:
+                if ws not in self._inflight.values():
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def _wait_released(self, ws: str, state: _WorkerState) -> bool:
+        """Pump results until the child confirms the barrier ran (or the
+        drain budget runs out / the child dies — both abort the handoff)."""
+        deadline = time.time() + float(
+            self.cfg.get("handoffDrainTimeoutS", 30.0))
+        while time.time() < deadline:
+            self._drain_results()
+            if ws in state.handle.released:
+                return bool(state.handle.released.pop(ws))
+            if not state.handle.alive:
+                return False
+            time.sleep(0.005)
+        return False
+
+    def handoff(self, ws: str, target: Optional[str] = None,
+                reason: str = "planned") -> Optional[dict]:
+        """Move ``ws`` to ``target`` with no journal replay and no
+        redelivery: **drain** the source's in-flight ops to the ack
+        boundary → **barrier** (journal group-commit + snapshot ship, so
+        the shipped snapshot IS current state and the wal tail is empty) →
+        **regrant** (epoch++, durable fence — the commit point) →
+        **resume** on the target. Everything before the regrant aborts
+        cleanly (the source keeps serving, ``handoffAborts`` counts it);
+        after the regrant the resume is retried like failover recovery.
+
+        This is what rebalancing, rolling restarts (``retire_worker``) and
+        lifecycle-driven moves ride instead of the crash path: failover
+        pays fence + journal replay + route-log redelivery; a handoff pays
+        fence + an already-shipped snapshot open — no replay, nothing past
+        the watermark to redeliver."""
+        pc = time.perf_counter
+        t0 = pc()
+        source = self.leases.owner(ws)
+        if source is None:
+            return None
+        src_state = self._worker(source)
+        if src_state is None or not src_state.alive:
+            return None  # dead owner: that move is failover's job
+        ws_key = self._ws_key(ws)
+        if target is None:
+            target = self._pick_handoff_target(ws_key, source)
+        tgt_state = self._worker(target) if target else None
+        if (tgt_state is None or not tgt_state.alive or target == source):
+            return None
+        stages: dict[str, float] = {}
+        journal = peek_journal(ws)
+        try:
+            # 1 — drain: in-flight ops for ws reach the ack boundary
+            # (committed + acked), so nothing is owed past the watermark.
+            t = pc()
+            maybe_fail("cluster.handoff.drain")
+            self._drain_backlog()
+            if not self._wait_ws_drained(ws, src_state):
+                raise FaultError("handoff drain timed out")
+            stages["drain"] = (pc() - t) * 1000.0
+            # 2 — barrier: group-commit + snapshot ship ON THE OWNER (the
+            # journal lives in the child in process mode). After this the
+            # legacy files ARE the state and the live wal is rotated empty
+            # — a fresh open on the target replays nothing.
+            t = pc()
+            maybe_fail("cluster.handoff.barrier")
+            if src_state.handle.sync:
+                self._note_ack(src_state.handle.release_workspace(ws))
+            else:
+                src_state.handle.release_workspace(ws)
+                if not self._wait_released(ws, src_state):
+                    raise FaultError("handoff barrier: release not confirmed")
+            stages["barrier"] = (pc() - t) * 1000.0
+            # 3 — regrant precheck (the last abortable instant).
+            maybe_fail("cluster.handoff.regrant")
+        except (FaultError, OSError) as exc:
+            with self._lock:
+                self.handoff_aborts += 1
+            if src_state.alive and ws not in src_state.handle.shard:
+                # barrier partially ran: re-arm the source's ownership so
+                # it keeps serving at its (unchanged) epoch.
+                self._recover_retry.call(
+                    lambda: src_state.handle.add_workspace(
+                        ws, self.leases.epoch(ws)),
+                    retry_on=(FaultError, OSError))
+            if self.logger is not None:
+                self.logger.warn(f"[cluster] handoff of {ws_key} aborted "
+                                 f"pre-grant: {exc}")
+            return None
+        t = pc()
+        try:
+            epoch = self.leases.grant(ws, target)  # commit point: durable fence
+        except (FaultError, OSError) as exc:
+            # The regrant did not complete durably (fence write failed —
+            # possibly with the lease table already advanced to the
+            # target). Never admit an owner behind an unwritten fence:
+            # fall back to the SOURCE with a fresh grant, which restores a
+            # consistent (owner, fence) pair at a newer epoch, then re-arm
+            # it — an abort, just one epoch later. A persistent lease
+            # failure here propagates, exactly like failover's grants.
+            with self._lock:
+                self.handoff_aborts += 1
+            epoch_back = self.leases.grant(ws, source)
+            self._recover_retry.call(
+                lambda: src_state.handle.add_workspace(ws, epoch_back),
+                retry_on=(FaultError, OSError))
+            if self.logger is not None:
+                self.logger.warn(f"[cluster] handoff of {ws_key} aborted at "
+                                 f"regrant: {exc}")
+            return None
+        stages["regrant"] = (pc() - t) * 1000.0
+        # 4 — resume: the target opens the shipped snapshot (no replay) and
+        # catches up from the route log (nothing past the watermark after a
+        # clean drain). Post-commit faults are retried like recovery.
+        t = pc()
+
+        def _resume():
+            maybe_fail("cluster.handoff.resume")
+            return tgt_state.handle.add_workspace(ws, epoch)
+
+        self._recover_retry.call(_resume, retry_on=(FaultError, OSError))
+        # Replay accounting: the barrier closed the source's journal, so
+        # the target's open is FRESH and its replay stats are exactly what
+        # the takeover replayed — 0 when the ship did its job. (Process
+        # mode recovers in the child; its replay report rides the
+        # ``recovered`` message like failover's and reads 0 here.)
+        replayed = 0
+        new_journal = peek_journal(ws)
+        if new_journal is not None and new_journal is not journal:
+            try:
+                replayed = int(new_journal.stats()["replay"]["records"])
+            except (KeyError, TypeError, ValueError):
+                replayed = 0
+        redelivered = self._redeliver(ws, tgt_state)
+        stages["resume"] = (pc() - t) * 1000.0
+        total = (pc() - t0) * 1000.0
+        self.timer.add("handoff", total)
+        record = {"at": self.clock(), "ws": ws_key, "from": source,
+                  "to": target, "reason": reason, "epoch": epoch,
+                  "replayedRecords": replayed,
+                  "redelivered": redelivered,
+                  "stagesMs": {k: round(v, 3) for k, v in stages.items()},
+                  "durationMs": round(total, 3)}
+        with self._lock:
+            self.redelivered += redelivered
+            self._handoffs.append(record)
+        return record
+
+    def rebalance(self) -> list:
+        """Planned-handoff sweep: move workspaces off any worker above the
+        bounded-load cap until every live worker is within it. Returns the
+        handoff records (empty when already balanced)."""
+        records = []
+        while True:
+            loads, cap = self._placement()
+            over = sorted((w for w, n in loads.items() if n > cap),
+                          key=lambda w: (-loads[w], w))
+            if not over:
+                return records
+            moved_any = False
+            for wid in over:
+                owned = self.leases.owned_by(wid)
+                if not owned:
+                    continue
+                rec = self.handoff(owned[0], reason="rebalance")
+                if rec is not None:
+                    records.append(rec)
+                    moved_any = True
+            if not moved_any:
+                return records  # every candidate aborted: stop, don't spin
+
+    def retire_worker(self, worker_id: str, reason: str = "retire") -> dict:
+        """Rolling-restart primitive: hand every owned workspace off (each
+        a planned, zero-replay move), then stop the worker and remove it
+        from the ring. Workspaces whose handoff aborted stay owned and are
+        moved by the failover path when the worker actually goes away."""
+        moved, aborted = 0, 0
+        for ws in self.leases.owned_by(worker_id):
+            rec = self.handoff(ws, reason=reason)
+            if rec is not None:
+                moved += 1
+            else:
+                aborted += 1
+        state = self._worker(worker_id)
+        if state is not None and state.alive and aborted == 0:
+            self.ring.remove(worker_id)
+            try:
+                if state.handle.sync:
+                    self._note_ack(state.handle.flush())
+                state.handle.stop()
+            except Exception as exc:  # noqa: BLE001 — stop paths can't raise
+                if self.logger is not None:
+                    self.logger.warn(f"[cluster] retire stop failed: {exc}")
+            with self._lock:
+                # A cleanly retired worker leaves membership entirely —
+                # listing it "dead" would latch the sitrep collector to
+                # warn forever over a PLANNED operation. It is remembered
+                # in membership["retired"] instead.
+                self._workers.pop(worker_id, None)
+                self._retired.append(worker_id)
+        return {"worker": worker_id, "moved": moved, "aborted": aborted,
+                "retired": aborted == 0}
+
     # ── lifecycle / observability ────────────────────────────────────
 
     def drain(self, timeout_s: float = 30.0) -> None:
@@ -570,13 +1025,17 @@ class ClusterSupervisor:
             membership = {"live": [w for w, s in self._workers.items()
                                    if s.alive],
                           "dead": [w for w, s in self._workers.items()
-                                   if not s.alive]}
+                                   if not s.alive],
+                          "retired": list(self._retired)}
             failovers = list(self._failovers)
+            handoffs = list(self._handoffs)
             counters = {"routed": self.routed,
                         "redelivered": self.redelivered,
                         "routeFaults": self.route_faults,
                         "inflight": len(self._inflight),
-                        "backlog": len(self._backlog)}
+                        "backlog": len(self._backlog),
+                        "handoffAborts": self.handoff_aborts,
+                        "ingressShed": self.ingress_shed}
         # handle.stats() probes per-workspace journals (path resolution,
         # registry lock) — filesystem-adjacent work that must not run
         # under the hot dispatch lock (GL-LOCK-BLOCKING's rationale, even
@@ -599,12 +1058,37 @@ class ClusterSupervisor:
         stats["leases"] = self.leases.snapshot()
         stats["failovers"] = failovers
         stats["lastFailover"] = failovers[-1] if failovers else None
-        stats["routeLog"] = {
-            "published": self.transport.stats.published,
-            "publishFailures": self.transport.stats.publish_failures,
-        }
+        stats["handoffs"] = handoffs
+        stats["lastHandoff"] = handoffs[-1] if handoffs else None
+        stats["routeLog"] = self._route_log_stats()
+        if self.admission is not None:
+            stats["admission"] = self.admission.stats()
         if self.leases.journal is not None:
             stats["leaseJournal"] = {
                 k: self.leases.journal.stats()[k]
                 for k in ("commits", "pendingRecords", "lastError")}
         return stats
+
+    def _route_log_stats(self) -> dict:
+        """Transport kind + health for the schedule's wire (ISSUE 12): the
+        sitrep collector warns on a backed-up outbox or an open breaker —
+        a degraded route log narrows redelivery coverage, which an
+        operator should see BEFORE the next failover needs it."""
+        t = self.transport
+        out = {
+            "kind": self.route_transport_kind,
+            "published": t.stats.published,
+            "publishFailures": t.stats.publish_failures,
+            "replayed": t.stats.replayed,
+            "outboxDropped": t.stats.outbox_dropped,
+            "healthy": bool(t.healthy()),
+        }
+        deep = getattr(t, "stats_dict", None)
+        if deep is not None:  # NATS adapter: outbox depth + breaker state
+            d = deep()
+            out["outboxDepth"] = d.get("outbox_len", 0)
+            out["connected"] = d.get("connected")
+            out["breaker"] = (d.get("breaker") or {}).get("state")
+        else:
+            out["outboxDepth"] = 0
+        return out

@@ -46,6 +46,11 @@ from .ring import FENCE_FILE
 #   cluster.recover      — workspace recovery on the new owner fails once
 #   cluster.route        — transient routing fault in the supervisor
 #   cluster.lease        — lease/fence persistence fault (ring.py)
+# Planned-handoff stages (ISSUE 12, supervisor.py): drain/barrier/regrant
+# faults abort the handoff cleanly pre-grant; resume faults are retried
+# post-grant like failover recovery:
+#   cluster.handoff.drain / cluster.handoff.barrier /
+#   cluster.handoff.regrant / cluster.handoff.resume
 
 
 class WorkerCrashed(RuntimeError):
@@ -136,6 +141,11 @@ class InProcessWorker:
         self.acked = 0
         self._since_ack: list[int] = []   # route-log seqs awaiting ack
         self._touched: set[str] = set()   # workspaces dirty since last ack
+        # Committed-and-acked seqs whose REPORT was lost to a failed
+        # release barrier (the commit landed; the OSError preempted the
+        # return) — they ride out with the next successful ack, or the
+        # supervisor's _inflight entries for them would leak forever.
+        self._unreported_acks: list[int] = []
         self.gw, self.cortex, self.gov = build_worker_gateway(
             self.root, worker_id, clock=clock, wall_timers=wall_timers,
             journal_cfg=journal_cfg, lifecycle_cfg=lifecycle_cfg,
@@ -179,6 +189,38 @@ class InProcessWorker:
 
     def drop_workspace(self, ws: str) -> None:
         self.shard.pop(ws, None)
+
+    def release_workspace(self, ws: str) -> list:
+        """Planned-handoff barrier, worker side (ISSUE 12): group-commit
+        everything buffered (the ack boundary), then evict the workspace
+        through the hibernation seam — flush, durable snapshot ship,
+        journal close, tracker cache drop — so the legacy files ARE the
+        state, the live wal is rotated empty (the target opens with **zero
+        replay**), and this worker retains no stale tracker state to flush
+        over the new owner's later. Raises on a failed ship so the
+        supervisor aborts the handoff and this worker keeps serving."""
+        acked = self._ack()
+        try:
+            if not self.cortex.release_workspace(ws):
+                raise OSError("handoff barrier: release/ship failed")
+            journal = peek_journal(ws)
+            if journal is not None:
+                # Non-cortex streams (audit, events) on a still-open
+                # journal: ship them too so nothing is left to replay.
+                ok = (journal.ship_snapshot()
+                      if journal.lifecycle is not None else journal.compact())
+                if not ok:
+                    raise OSError(journal.last_error
+                                  or "handoff barrier: snapshot ship failed")
+        except OSError:
+            # The group commit above already landed and cleared
+            # _since_ack; losing these seqs with the raise would leak the
+            # supervisor's _inflight entries (drains would time out
+            # forever). Park them for the next successful ack instead.
+            self._unreported_acks.extend(acked)
+            raise
+        self.shard.pop(ws, None)
+        return acked
 
     # ── delivery / ack ───────────────────────────────────────────────
 
@@ -252,8 +294,10 @@ class InProcessWorker:
         if not ok:
             return []  # seqs + touched set retained; next boundary retries
         self._touched.clear()
-        acked, self._since_ack = self._since_ack, []
-        self.acked += len(acked)
+        fresh, self._since_ack = self._since_ack, []
+        self.acked += len(fresh)
+        acked = self._unreported_acks + fresh
+        self._unreported_acks = []
         return acked
 
     def flush(self) -> list:
@@ -303,11 +347,16 @@ class InProcessWorker:
             journal = peek_journal(ws)
             if journal is not None:
                 fenced += journal.fence_rejected
-        return {"workerId": self.worker_id, "alive": self.alive,
-                "kind": "inproc", "workspaces": len(self.shard),
-                "delivered": self.delivered, "acked": self.acked,
-                "unacked": len(self._since_ack),
-                "fencedRecords": fenced}
+        out = {"workerId": self.worker_id, "alive": self.alive,
+               "kind": "inproc", "workspaces": len(self.shard),
+               "delivered": self.delivered, "acked": self.acked,
+               "unacked": len(self._since_ack),
+               "fencedRecords": fenced}
+        lc = self.cortex.lifecycle
+        if lc is not None:
+            out["lifecycle"] = {"wakes": lc.wakes, "evictions": lc.evictions,
+                                "hibernateFailures": lc.hibernate_failures}
+        return out
 
 
 # ── real-process worker (the scaling bench shape) ────────────────────
@@ -369,6 +418,15 @@ def _process_worker_main(worker_id: str, root: str, ack_every: int,
                 out_q.put(("ack", worker_id, acked))
         elif kind == "flush":
             out_q.put(("ack", worker_id, worker.flush()))
+        elif kind == "release":
+            _k, ws = msg
+            try:
+                acked = worker.release_workspace(ws)
+                out_q.put(("ack", worker_id, acked))
+                out_q.put(("released", worker_id, ws, True))
+            except OSError as exc:
+                out_q.put(("released", worker_id, ws, False))
+                out_q.put(("release_failed", worker_id, ws, str(exc)))
         elif kind == "stop":
             acked = worker.flush()
             out_q.put(("ack", worker_id, acked))
@@ -415,6 +473,30 @@ class ProcessWorker:
 
     def drop_workspace(self, ws: str) -> None:
         self.shard.pop(ws, None)
+
+    def release_workspace(self, ws: str) -> list:
+        """Asynchronous shape of the handoff barrier: enqueue the release;
+        the child acks + ships and answers with a ``released`` message the
+        supervisor's result pump records in ``self.released``."""
+        if not self.proc.is_alive():
+            raise WorkerCrashed(f"{self.worker_id} process is dead")
+        # A confirmation from an earlier, timed-out-and-aborted handoff of
+        # this workspace may still be parked here; consuming it for THIS
+        # release would regrant before the child ran the barrier.
+        self.released.pop(ws, None)
+        self.shard.pop(ws, None)
+        self._in_q.put(("release", ws))
+        return []
+
+    # ws -> bool, filled by the supervisor when it drains ("released", …)
+    # messages; the handoff barrier polls it (single-reader: the
+    # supervisor's dispatch thread, so no lock needed).
+    @property
+    def released(self) -> dict:
+        out = getattr(self, "_released", None)
+        if out is None:
+            out = self._released = {}
+        return out
 
     def deliver(self, seq: int, op: dict) -> tuple[Optional[dict], None]:
         if not self.proc.is_alive():

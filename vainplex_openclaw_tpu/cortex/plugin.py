@@ -321,6 +321,24 @@ class CortexPlugin:
                 self.lifecycle.hibernate(victim)
         return tr
 
+    def release_workspace(self, ws: str) -> bool:
+        """Planned-handoff barrier (ISSUE 12): flush-ship-close the
+        workspace's trackers and drop them from this plugin's cache —
+        hibernation's eviction path invoked *deliberately*, so ownership
+        can move to another worker with zero replay and this plugin keeps
+        no stale tracker state to flush over the new owner's later. A
+        workspace that was never woken here is already released."""
+        ws = str(ws)
+        if ws not in self._trackers:
+            return True
+        if self.lifecycle is not None:
+            return self.lifecycle.hibernate(ws)
+        try:
+            self._hibernate_workspace(ws)
+            return True
+        except OSError:
+            return False
+
     def _hibernate_workspace(self, ws: str) -> None:
         """LifecycleManager eviction callback: flush-ship-close the
         workspace's trackers and drop every per-workspace registry entry so

@@ -22,12 +22,12 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Callable, Optional
+from typing import Callable, Iterator, Optional
 
 from ..resilience.faults import maybe_fail
 from ..resilience.policy import CircuitBreaker, RetryPolicy
 from .envelope import ClawEvent
-from .transport import TransportStats, parse_nats_url
+from .transport import TransportStats, _SubjectFilter, parse_nats_url
 
 OUTBOX_MAX = 1_000     # bounded: a dead broker must not grow RSS forever
 LOG_EVERY = 100        # log failure #1, #101, #201, … per failure run
@@ -256,7 +256,16 @@ class NatsTransport:  # contract-tested via tests/fake_nats.py (no live broker i
                 self.flush_outbox()
                 if self._outbox:
                     raise OSError(self.stats.last_error or "outbox replay stalled")
-            self._submit(self._js.publish(subject, payload), timeout=self.publish_timeout_s)
+            ack = self._submit(self._js.publish(subject, payload),
+                               timeout=self.publish_timeout_s)
+            # The PubAck's stream sequence is authoritative — stamp it like
+            # MemoryTransport/FileTransport stamp seq at publish, so a
+            # route-log caller reads its op's TRUE sequence without a
+            # stream_info round-trip (which, on a stream shared by several
+            # supervisors, could also return a peer's later sequence).
+            seq = getattr(ack, "seq", None)
+            if isinstance(seq, int) and seq > 0:
+                event.seq = seq
             self.stats.published += 1
             self._failure_run = 0
             self.breaker.record_success()
@@ -266,6 +275,131 @@ class NatsTransport:  # contract-tested via tests/fake_nats.py (no live broker i
             self.breaker.record_failure(str(exc))
             self._enqueue(subject, payload)
             return False
+
+    # ── consume (the EventTransport seam's read half, ISSUE 12) ──────
+    #
+    # The cluster route log treats its transport as a *replayable schedule*:
+    # ``fetch(subject, start_seq=watermark)`` must return exactly the events
+    # past the acked watermark, in publish order, with ``event.seq`` carrying
+    # the stream sequence the next watermark advances to. MemoryTransport and
+    # FileTransport had this from PR 4/9; giving the JetStream adapter the
+    # same read half is what lets supervisors on different machines share one
+    # schedule — contract-pinned identical across all three transports in
+    # tests/test_route_transport_contract.py (fake broker, no live NATS).
+
+    def fetch(self, subject_filter: str = ">", start_seq: int = 0,
+              batch: Optional[int] = None,
+              page_size: int = 500) -> Iterator[ClawEvent]:
+        """Replay stream events past ``start_seq`` whose subject matches.
+
+        One ephemeral pull consumer per fetch, positioned at
+        ``start_seq + 1`` (the NatsTraceSource pagination discipline: a
+        fresh consumer per page would restart from the stream head).
+        Subject filtering is client-side with the shared NATS-pattern
+        matcher so a filter behaves byte-identically to MemoryTransport's.
+        Events still sitting in the disconnect outbox are not yet part of
+        the broker's schedule and are not returned — the caller's watermark
+        semantics only ever cover *published* sequences."""
+        if self._js is None and not self._maybe_reconnect():
+            return
+        if self._outbox:
+            # Readers see through the outbox where possible: a replayed
+            # prefix joins the schedule before this fetch snapshots it.
+            self.flush_outbox()
+
+        async def make_sub():
+            from nats.js.api import ConsumerConfig, DeliverPolicy  # type: ignore
+
+            cfg = ConsumerConfig(
+                deliver_policy=DeliverPolicy.BY_START_SEQUENCE,
+                opt_start_seq=start_seq + 1,
+            )
+            return await self._js.pull_subscribe("", durable=None,
+                                                 stream=self.stream, config=cfg)
+
+        async def pull(sub, n):
+            msgs = await sub.fetch(n, timeout=self.publish_timeout_s)
+            out = []
+            for m in msgs:
+                out.append((m.metadata.sequence.stream, m.subject, m.data))
+                await m.ack()
+            return out
+
+        try:
+            sub = self._submit(make_sub(), timeout=10.0)
+        except Exception as exc:  # noqa: BLE001 — stream empty or gone
+            self.stats.last_error = str(exc)
+            if self.logger is not None:
+                self.logger.warn(f"nats fetch: consumer create failed: {exc}")
+            return
+        filt = _SubjectFilter(subject_filter)
+        matches = filt.matches
+        yielded = 0
+        import concurrent.futures as _cf
+
+        while True:
+            try:
+                rows = self._submit(pull(sub, page_size),
+                                    timeout=self.publish_timeout_s + 5)
+            except (asyncio.TimeoutError, _cf.TimeoutError, TimeoutError):
+                return  # drained: the pull timing out empty is end-of-stream
+            except Exception as exc:  # noqa: BLE001
+                # A broker error mid-stream is NOT end-of-stream: the
+                # caller (failover redelivery) would read a truncated
+                # schedule as "nothing left". Record + log so a degraded
+                # redelivery is visible, never silent.
+                self.stats.last_error = str(exc)
+                if self.logger is not None:
+                    self.logger.warn(f"nats fetch failed mid-stream after "
+                                     f"{yielded} events: {exc}")
+                return
+            if not rows:
+                return
+            for seq, subject, data in rows:
+                if seq <= start_seq or not matches(subject):
+                    continue
+                try:
+                    rec = json.loads(data.decode())
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                event = ClawEvent.from_dict(rec)
+                event.seq = seq  # stream sequence IS the watermark unit
+                yield event
+                yielded += 1
+                if batch is not None and yielded >= batch:
+                    return
+
+    def last_sequence(self) -> int:
+        """Broker-side stream sequence — the same monotone counter
+        MemoryTransport/FileTransport expose, read from stream_info."""
+        if self._js is None and not self._maybe_reconnect():
+            return 0
+
+        async def get():
+            info = await self._js.stream_info(self.stream)
+            return info.state.last_seq
+
+        try:
+            return int(self._submit(get(), timeout=5.0))
+        except Exception as exc:  # noqa: BLE001
+            self.stats.last_error = str(exc)
+            return 0
+
+    def event_count(self) -> int:
+        if self._js is None and not self._maybe_reconnect():
+            return 0
+
+        async def get():
+            info = await self._js.stream_info(self.stream)
+            return info.state.messages
+
+        try:
+            return int(self._submit(get(), timeout=5.0))
+        except Exception as exc:  # noqa: BLE001
+            self.stats.last_error = str(exc)
+            return 0
 
     # ── introspection ────────────────────────────────────────────────
 

@@ -260,13 +260,16 @@ def collect_journal(config: dict, ctx: dict) -> dict:
 
 
 def collect_cluster(config: dict, ctx: dict) -> dict:
-    """Sharded-gateway health (ISSUE 9): membership, per-worker liveness/
-    breaker state/heartbeat misses, lease epochs, and the last failover
-    (duration, workspaces moved, replayed records, redeliveries). Warns on
-    any fencing rejection (a zombie tried to write — the fence held, but an
-    operator should know a partitioned worker is still running) and on any
-    worker not closed (dead, OR a breaker half-open/open: a worker being
-    probed is a current condition, not history)."""
+    """Sharded-gateway health (ISSUE 9 + 12): membership, per-worker
+    liveness/breaker state/heartbeat misses, lease epochs, the last
+    failover AND the last planned handoff, plus the route log's transport
+    kind/health. Warns on any fencing rejection (a zombie tried to write —
+    the fence held, but an operator should know a partitioned worker is
+    still running), on any worker not closed (dead, OR a breaker
+    half-open/open), and on a degraded route log (unhealthy transport,
+    backed-up outbox, open/half-open breaker — a degraded schedule narrows
+    redelivery coverage, which matters BEFORE the next failover needs
+    it)."""
     status_fn = ctx.get("cluster_status")
     if status_fn is None:
         return {"status": "skipped", "items": [],
@@ -280,6 +283,8 @@ def collect_cluster(config: dict, ctx: dict) -> dict:
                  if (row.get("breaker") or {}).get("state", "closed")
                  != "closed"]
     last = s.get("lastFailover")
+    last_handoff = s.get("lastHandoff")
+    route_log = s.get("routeLog") or {}
     worries = []
     if fenced:
         worries.append(f"fencedRecords={fenced}")
@@ -288,22 +293,43 @@ def collect_cluster(config: dict, ctx: dict) -> dict:
             f"{wid}.breaker={(workers[wid].get('breaker') or {}).get('state')}")
     if dead:
         worries.append(f"dead={dead}")
+    if route_log:
+        rl_kind = route_log.get("kind", "?")
+        if route_log.get("healthy") is False:
+            worries.append(f"routeLog({rl_kind}) unhealthy")
+        if route_log.get("outboxDepth"):
+            worries.append(
+                f"routeLog outbox={route_log.get('outboxDepth')}")
+        rl_breaker = route_log.get("breaker")
+        if rl_breaker and rl_breaker != "closed":
+            worries.append(f"routeLog breaker={rl_breaker}")
     epochs = {ws: lease.get("epoch")
               for ws, lease in (s.get("leases") or {}).items()}
     items = [{"membership": membership, "workers": workers,
               "leaseEpochs": epochs, "lastFailover": last,
+              "lastHandoff": last_handoff,
+              "handoffAborts": s.get("handoffAborts"),
+              "ingressShed": s.get("ingressShed"),
+              "admission": s.get("admission"),
               "routed": s.get("routed"), "redelivered": s.get("redelivered"),
               "routeFaults": s.get("routeFaults"),
               "inflight": s.get("inflight"),
-              "fencedRecords": fenced, "routeLog": s.get("routeLog")}]
+              "fencedRecords": fenced, "routeLog": route_log}]
     live = membership.get("live") or []
     summary = (f"{len(live)} live / {len(dead)} dead workers, "
                f"{len(epochs)} leases, routed={s.get('routed', 0)}")
+    if route_log.get("kind"):
+        summary += f", routeLog={route_log['kind']}"
     if last:
         summary += (f", last failover: {last.get('worker')} "
                     f"({last.get('workspacesMoved')} ws, "
                     f"{last.get('replayedRecords')} replayed, "
                     f"{last.get('durationMs')}ms)")
+    if last_handoff:
+        summary += (f", last handoff: {last_handoff.get('ws')} "
+                    f"{last_handoff.get('from')}→{last_handoff.get('to')} "
+                    f"({last_handoff.get('replayedRecords')} replayed, "
+                    f"{last_handoff.get('durationMs')}ms)")
     if worries:
         summary += " — " + ", ".join(worries)
     return {"status": "warn" if worries else "ok", "items": items,

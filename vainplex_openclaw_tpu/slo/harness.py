@@ -169,7 +169,8 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
         if mode != "wall":
             raise ValueError("workers mode requires mode='wall'")
         return _run_cluster_report(seed, n_ops, tenants, saturation,
-                                   int(workers), watermark)
+                                   int(workers), watermark,
+                                   admission=admission)
     ops = generate_workload(seed, n_ops, tenants)
     digest = workload_digest(ops)
 
@@ -323,12 +324,18 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
 
 def _run_cluster_report(seed: int, n_ops: int, tenants: int,
                         saturation: float, workers: int,
-                        watermark: int) -> dict:
+                        watermark: int, admission: bool = True) -> dict:
     """The ``workers > 0`` branch: same seeded workload, offered open-loop
     at ``saturation`` × single-process capacity, routed through a real
     :class:`..cluster.ClusterSupervisor` over in-process workers. Verdict
     accounting keys by op index so an op redelivered after a failover
-    counts once, with its final observation."""
+    counts once, with its final observation.
+
+    Supervisor-side admission (ISSUE 12, the PR-9 named follow-up): the
+    driver reports arrival backlog to the supervisor exactly like the
+    single-process loop reports it to the gateway's controller, and the
+    supervisor sheds sheddable op KINDS at ingress — verdict kinds are
+    never consulted, so ``losses`` stays the invariant it always was."""
     from ..cluster import ClusterSupervisor
     from .workload import generate_workload, workload_digest
 
@@ -345,7 +352,11 @@ def _run_cluster_report(seed: int, n_ops: int, tenants: int,
     with tempfile.TemporaryDirectory() as tmp:
         root = Path(tmp)
         sup = ClusterSupervisor(
-            root, {"workers": workers}, wall_timers=True,
+            root, {"workers": workers,
+                   "admission": ({"enabled": True,
+                                  "highWatermark": watermark}
+                                 if admission else None)},
+            wall_timers=True,
             on_result=lambda op, obs: results.__setitem__(op.get("i"), obs))
         # Supervisor-side gateway: hosts sitrep so /ops renders the cluster
         # collector exactly as a deployment would see it.
@@ -360,12 +371,17 @@ def _run_cluster_report(seed: int, n_ops: int, tenants: int,
 
         arrivals = [op.arrival / rate for op in ops]
         t0 = time.perf_counter()
+        arrived = 0
         for i, op in enumerate(ops):
             sched = t0 + arrivals[i]
             now = time.perf_counter()
             while now < sched:
                 time.sleep(min(sched - now, 0.0005))
                 now = time.perf_counter()
+            if sup.admission is not None:
+                while arrived < len(ops) and t0 + arrivals[arrived] <= now:
+                    arrived += 1
+                sup.note_queue_depth(arrived - i)
             sup.submit({"i": op.index, "ws": str(root / f"tenant{op.tenant}"),
                         "wsKey": f"tenant{op.tenant}", "kind": op.kind,
                         "content": op.content})
@@ -411,9 +427,9 @@ def _run_cluster_report(seed: int, n_ops: int, tenants: int,
         "workers": workers,
         "saturation": saturation,
         "tenants": tenants,
-        "admission": {"enabled": False,
-                      "note": "cluster mode: per-worker gateways, no "
-                              "supervisor-side admission yet"},
+        "admission": (cluster_stats.get("admission")
+                      or {"enabled": False}),
+        "ingress_shed": cluster_stats.get("ingressShed", 0),
         "capacity_ops_s": round(capacity, 1),
         "offered_ops_s": round(rate, 1),
         "workload": digest,
