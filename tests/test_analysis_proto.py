@@ -1,0 +1,635 @@
+"""protolint suite (ISSUE 13): fixture corpus pinning every GL-PROTO rule
+verdict, the three-gate runner seams (--only / --json / per-gate summary
+lines), the ProtocolWitness, the interleaving explorer (enumeration,
+clean sweep, goes-red mutations, deterministic replay), and the real
+protocol bug the explorer's design surfaced (grant durability).
+
+Same discipline as the graftlint/tracelint corpora: each rule family gets
+known-good and known-bad snippets so a refactor that blinds a pass — or
+one that starts flagging idioms the protocol code depends on — fails here
+before it reaches the CI gate.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from vainplex_openclaw_tpu.analysis import explore, proto
+from vainplex_openclaw_tpu.analysis.findings import (GATES, LintReport,
+                                                     gate_of)
+from vainplex_openclaw_tpu.analysis.proto_table import (ACK_RULES,
+                                                        EXPLORER_CONFIGS,
+                                                        ORDER_RULES,
+                                                        AckRule,
+                                                        ExplorerConfig,
+                                                        FenceRule, OrderRule,
+                                                        explorer_config)
+from vainplex_openclaw_tpu.analysis.witness import ProtocolWitness
+from vainplex_openclaw_tpu.cluster.ring import FENCE_FILE, LeaseTable
+from vainplex_openclaw_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                     installed)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def fixture(body: str) -> str:
+    return textwrap.dedent(body)
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+def details_of(findings):
+    return sorted(f.detail for f in findings)
+
+
+# ── GL-PROTO-EPOCH fixture corpus ────────────────────────────────────
+
+
+class TestEpochLint:
+    def test_equality_comparison_flagged(self):
+        src = fixture("""
+            class S:
+                def check(self, ws, epoch):
+                    if self.leases.epoch(ws) != epoch:
+                        return
+            """)
+        found = proto.check_epoch_source(src, "f.py")
+        assert rules_of(found) == ["GL-PROTO-EPOCH"]
+        assert "S.check" in found[0].detail
+
+    def test_double_equals_flagged(self):
+        src = fixture("""
+            def stale(fence_epoch, lease):
+                return fence_epoch == lease["epoch"]
+            """)
+        assert rules_of(proto.check_epoch_source(src, "f.py")) \
+            == ["GL-PROTO-EPOCH"]
+
+    def test_ordered_comparisons_clean(self):
+        src = fixture("""
+            class S:
+                def check(self, ws, epoch):
+                    if self.leases.epoch(ws) > epoch:
+                        return
+                    if epoch >= self.fence_epoch:
+                        pass
+                    if epoch < current.get("epoch", 0):
+                        pass
+            """)
+        assert proto.check_epoch_source(src, "f.py") == []
+
+    def test_non_epoch_equality_clean(self):
+        src = fixture("""
+            def f(owner, worker_id, seq, mark):
+                return owner == worker_id and seq != mark
+            """)
+        assert proto.check_epoch_source(src, "f.py") == []
+
+    def test_exemption_with_rationale_suppresses(self):
+        src = fixture("""
+            class S:
+                def identity(self, a, b):
+                    return a.epoch == b.epoch
+            """)
+        found = proto.check_epoch_source(
+            src, "f.py", exempt=(("S.identity", "same-grant identity "
+                                  "check, not a staleness check"),))
+        assert found == []
+
+    def test_exemption_without_rationale_is_a_finding(self):
+        src = fixture("""
+            class S:
+                def identity(self, a, b):
+                    return a.epoch == b.epoch
+            """)
+        found = proto.check_epoch_source(src, "f.py",
+                                         exempt=(("S.identity", ""),))
+        assert rules_of(found) == ["GL-PROTO-EPOCH"]
+        assert found[0].detail.startswith("no-rationale:")
+
+    def test_stale_exemption_reported(self):
+        src = fixture("""
+            def clean(epoch, fence):
+                return epoch > fence
+            """)
+        found = proto.check_epoch_source(src, "f.py",
+                                         exempt=(("S.gone", "was real"),))
+        assert details_of(found) == ["stale-exempt:S.gone"]
+
+
+# ── GL-PROTO-FENCE fixture corpus ────────────────────────────────────
+
+FENCE_RULE = FenceRule(module="f.py", cls="J",
+                       write_calls=("sink", "replace"),
+                       fence_checks=("_fenced", "_fence_ok"))
+
+
+class TestFenceLint:
+    def test_unfenced_write_flagged(self):
+        src = fixture("""
+            class J:
+                def compact(self):
+                    self.sink(self.batch)
+            """)
+        found = proto.check_fence_source(src, "f.py", FENCE_RULE)
+        assert rules_of(found) == ["GL-PROTO-FENCE"]
+        assert "J.compact" in found[0].detail
+
+    def test_fence_check_before_write_clean(self):
+        src = fixture("""
+            class J:
+                def compact(self):
+                    if self.fence_epoch is not None and not self._fence_ok():
+                        return False
+                    self.sink(self.batch)
+            """)
+        assert proto.check_fence_source(src, "f.py", FENCE_RULE) == []
+
+    def test_fence_check_after_write_still_flagged(self):
+        src = fixture("""
+            class J:
+                def compact(self):
+                    self.sink(self.batch)
+                    if self._fenced:
+                        return False
+            """)
+        assert rules_of(proto.check_fence_source(src, "f.py", FENCE_RULE)) \
+            == ["GL-PROTO-FENCE"]
+
+    def test_guarded_with_rationale_suppresses(self):
+        src = fixture("""
+            class J:
+                def _write_meta(self):
+                    self.replace(self.meta)
+            """)
+        rule = FenceRule(module="f.py", cls="J", write_calls=("replace",),
+                        guarded=(("_write_meta", "callers hold the commit "
+                                  "lock and re-checked the fence"),))
+        assert proto.check_fence_source(src, "f.py", rule) == []
+
+    def test_guarded_without_rationale_is_a_finding(self):
+        src = fixture("""
+            class J:
+                def _write_meta(self):
+                    self.replace(self.meta)
+            """)
+        rule = FenceRule(module="f.py", cls="J", write_calls=("replace",),
+                        guarded=(("_write_meta", " "),))
+        found = proto.check_fence_source(src, "f.py", rule)
+        assert details_of(found) == ["no-rationale:J._write_meta"]
+
+    def test_stale_guarded_entry_reported(self):
+        src = fixture("""
+            class J:
+                def harmless(self):
+                    return 1
+            """)
+        rule = FenceRule(module="f.py", cls="J", write_calls=("replace",),
+                        guarded=(("gone", "used to write"),))
+        found = proto.check_fence_source(src, "f.py", rule)
+        assert details_of(found) == ["stale-guarded:J.gone"]
+
+    def test_missing_class_is_stale_table(self):
+        found = proto.check_fence_source("x = 1\n", "f.py", FENCE_RULE)
+        assert details_of(found) == ["missing:J"]
+
+    def test_write_fault_site_counts_as_write(self):
+        src = fixture("""
+            class J:
+                def commit(self):
+                    write_with_faults("journal.append", self.fh.write, data)
+            """)
+        rule = FenceRule(module="f.py", cls="J", write_calls=(),
+                        write_fault_sites=("journal.append",))
+        assert rules_of(proto.check_fence_source(src, "f.py", rule)) \
+            == ["GL-PROTO-FENCE"]
+
+
+# ── GL-PROTO-ORDER fixture corpus ────────────────────────────────────
+
+
+def order_rule(**kw):
+    base = dict(module="f.py", qualname="S.handoff", first="release",
+                then="grant", forbid_early=True,
+                invariant="barrier-before-regrant")
+    base.update(kw)
+    return OrderRule(**base)
+
+
+class TestOrderLint:
+    def test_then_before_first_flagged(self):
+        src = fixture("""
+            class S:
+                def handoff(self, ws):
+                    epoch = self.leases.grant(ws, target)
+                    self.release(ws)
+            """)
+        found = proto.check_order_source(src, "f.py", [order_rule()])
+        # two findings: the early grant itself, and no grant at-or-after
+        # the barrier (the inverted body has nothing after release)
+        assert rules_of(found) == ["GL-PROTO-ORDER"] * 2
+        assert "grant-before-release" in found[0].detail
+
+    def test_correct_order_clean(self):
+        src = fixture("""
+            class S:
+                def handoff(self, ws):
+                    self.release(ws)
+                    epoch = self.leases.grant(ws, target)
+            """)
+        assert proto.check_order_source(src, "f.py", [order_rule()]) == []
+
+    def test_missing_then_flagged(self):
+        src = fixture("""
+            class S:
+                def handoff(self, ws):
+                    self.release(ws)
+            """)
+        found = proto.check_order_source(src, "f.py", [order_rule()])
+        assert details_of(found) == ["S.handoff:missing-grant"]
+
+    def test_missing_first_is_stale_row(self):
+        src = fixture("""
+            class S:
+                def handoff(self, ws):
+                    self.leases.grant(ws, target)
+            """)
+        found = proto.check_order_source(src, "f.py", [order_rule()])
+        assert details_of(found) == ["stale-first:S.handoff:release"]
+
+    def test_missing_site_is_stale_table(self):
+        found = proto.check_order_source("x = 1\n", "f.py", [order_rule()])
+        assert details_of(found) == ["missing:S.handoff"]
+
+    def test_without_forbid_early_prefix_call_tolerated(self):
+        # wake-refences shape: trackers() may appear twice; only "a
+        # set_fence at-or-after the first trackers" is required.
+        src = fixture("""
+            class W:
+                def wake(self, ws):
+                    self.set_fence(ws)
+                    t = self.trackers(ws)
+                    self.set_fence(ws)
+            """)
+        rule = order_rule(qualname="W.wake", first="trackers",
+                          then="set_fence", forbid_early=False,
+                          invariant="wake-refences")
+        assert proto.check_order_source(src, "f.py", [rule]) == []
+
+
+# ── GL-PROTO-ACK fixture corpus ──────────────────────────────────────
+
+
+class TestAckLint:
+    RELEASE = AckRule("f.py", "W.ack", kind="commit-before-release")
+    MARK = AckRule("f.py", "S.note", kind="monotonic-watermark",
+                   attr="_acked")
+
+    def test_release_before_commit_flagged(self):
+        src = fixture("""
+            class W:
+                def ack(self):
+                    if self.fast_path:
+                        return self.seqs
+                    self.journal.commit()
+                    return self.seqs
+            """)
+        found = proto.check_ack_source(src, "f.py", [self.RELEASE])
+        assert details_of(found) == ["W.ack:release-before-commit"]
+
+    def test_empty_return_before_commit_clean(self):
+        src = fixture("""
+            class W:
+                def ack(self):
+                    if not self.seqs:
+                        return []
+                    self.journal.commit()
+                    return self.seqs
+            """)
+        assert proto.check_ack_source(src, "f.py", [self.RELEASE]) == []
+
+    def test_no_commit_at_all_flagged(self):
+        src = fixture("""
+            class W:
+                def ack(self):
+                    return self.seqs
+            """)
+        found = proto.check_ack_source(src, "f.py", [self.RELEASE])
+        assert details_of(found) == ["W.ack:no-commit"]
+
+    def test_unguarded_watermark_flagged(self):
+        src = fixture("""
+            class S:
+                def note(self, ws, seq):
+                    self._acked[ws] = seq
+            """)
+        found = proto.check_ack_source(src, "f.py", [self.MARK])
+        assert details_of(found) == ["S.note:unguarded-watermark"]
+
+    def test_ordered_guard_clean(self):
+        src = fixture("""
+            class S:
+                def note(self, ws, seq):
+                    if seq > self._acked.get(ws, 0):
+                        self._acked[ws] = seq
+            """)
+        assert proto.check_ack_source(src, "f.py", [self.MARK]) == []
+
+    def test_missing_site_is_stale_table(self):
+        found = proto.check_ack_source("x = 1\n", "f.py",
+                                       [self.RELEASE, self.MARK])
+        assert details_of(found) == ["missing:S.note", "missing:W.ack"]
+
+
+# ── the repo gate + runner seams ─────────────────────────────────────
+
+
+class TestRepoGateAndRunner:
+    def test_repo_proto_pass_clean(self):
+        findings, scanned = proto.run(REPO_ROOT)
+        assert findings == [], [f.render() for f in findings]
+        assert scanned == 5  # the five PROTO_MODULES all parsed
+
+    def test_gate_of_routes_rule_families(self):
+        assert gate_of("GL-PROTO-EPOCH") == "protolint"
+        assert gate_of("GL-PROTO-SCHED") == "protolint"
+        assert gate_of("GL-TRACE-HOSTSYNC") == "tracelint"
+        assert gate_of("GL-LOCK-GUARD") == "graftlint"
+        assert gate_of("GL-REDOS") == "graftlint"
+        assert [g for g, _p in GATES] \
+            == ["graftlint", "tracelint", "protolint"]
+
+    def test_summary_has_one_line_per_gate(self):
+        report = LintReport(files_scanned=140, schedules=77,
+                            gate_files={"protolint": 5})
+        lines = report.summary().splitlines()
+        assert lines[0].startswith("graftlint: files=140 ")
+        assert lines[1].startswith("tracelint: files=140 ")
+        assert lines[2] == ("protolint: files=5 schedules=77 "
+                            "active=0 suppressed=0 stale=0")
+
+    def test_only_filter_scopes_summary_and_baseline(self, tmp_path):
+        from vainplex_openclaw_tpu.analysis import run_analysis
+        report = run_analysis(REPO_ROOT, only=["GL-PROTO-EPOCH"])
+        assert report.gates_run == ("protolint",)
+        assert report.active == []
+        lines = report.summary().splitlines()
+        assert len(lines) == 1 and lines[0].startswith("protolint: ")
+        # families that did not run contribute neither suppressions nor
+        # stale keys (the graftlint baseline entries must not read stale)
+        assert report.suppressed == [] and report.stale_keys == []
+
+    def test_cli_json_artifact_and_exit_code(self, tmp_path):
+        from vainplex_openclaw_tpu.analysis.__main__ import main
+        out = tmp_path / "findings.json"
+        rc = main(["--root", str(REPO_ROOT), "--only", "GL-PROTO-EPOCH",
+                   "--json", str(out)])
+        assert rc == 0
+        data = json.loads(out.read_text(encoding="utf-8"))
+        assert set(data["gates"]) == {"protolint"}
+        assert data["gates"]["protolint"]["active"] == 0
+        assert data["gates"]["protolint"]["files"] == 5
+
+    def test_cli_comma_separated_only(self, capsys):
+        from vainplex_openclaw_tpu.analysis.__main__ import main
+        rc = main(["--root", str(REPO_ROOT),
+                   "--only", "GL-PROTO-EPOCH,GL-PROTO-ORDER"])
+        assert rc == 0
+        outerr = capsys.readouterr()
+        assert outerr.out.splitlines()[-1].startswith("protolint: files=5 ")
+
+
+# ── ProtocolWitness ──────────────────────────────────────────────────
+
+
+class TestProtocolWitness:
+    def test_clean_sequence_has_no_violations(self):
+        w = ProtocolWitness()
+        w.note("grant", "/ws/a", epoch=1, owner="w0")
+        w.note("recover", "/ws/a", epoch=1)
+        w.note("deliver", "/ws/a", seq=1, content="x")
+        w.note("grant", "/ws/a", epoch=2, owner="w1")
+        w.note("recover", "/ws/a", epoch=2)
+        w.note("deliver", "/ws/a", seq=2, content="y")
+        assert w.violations() == []
+        w.assert_clean()
+
+    def test_non_advancing_grant_flagged(self):
+        w = ProtocolWitness()
+        w.note("grant", "/ws/a", epoch=2, owner="w0")
+        w.note("grant", "/ws/a", epoch=2, owner="w1")
+        assert [inv for inv, _m in w.violations()] == ["epoch-monotonic"]
+        with pytest.raises(AssertionError, match="epoch-monotonic"):
+            w.assert_clean()
+
+    def test_deliver_before_recovery_flagged(self):
+        w = ProtocolWitness()
+        w.note("grant", "/ws/a", epoch=2, owner="w1")
+        w.note("deliver", "/ws/a", seq=7, content="x")
+        assert [inv for inv, _m in w.violations()] \
+            == ["fence-before-traffic"]
+
+    def test_handoff_regrant_before_release_flagged(self):
+        w = ProtocolWitness()
+        w.note("grant", "/ws/a", epoch=1, owner="w0")
+        w.note("recover", "/ws/a", epoch=1)
+        w.note("handoff", "/ws/a")
+        w.note("grant", "/ws/a", epoch=2, owner="w1")
+        w.note("release", "/ws/a")
+        w.note("handoff-end", "/ws/a")
+        assert [inv for inv, _m in w.violations()] \
+            == ["barrier-before-regrant"]
+
+    def test_handoff_with_barrier_first_clean(self):
+        w = ProtocolWitness()
+        w.note("grant", "/ws/a", epoch=1, owner="w0")
+        w.note("recover", "/ws/a", epoch=1)
+        w.note("handoff", "/ws/a")
+        w.note("release", "/ws/a")
+        w.note("grant", "/ws/a", epoch=2, owner="w1")
+        w.note("handoff-end", "/ws/a")
+        w.note("recover", "/ws/a", epoch=2)
+        assert w.violations() == []
+
+    def test_overlapping_handoffs_tracked_per_workspace(self):
+        # two concurrent handoffs interleave their events; each window's
+        # release must bind to ITS workspace, not to a shared stack top
+        def seed(w, ws, epoch):
+            w.note("grant", ws, epoch=epoch, owner="w0")
+            w.note("recover", ws, epoch=epoch)
+
+        w = ProtocolWitness()
+        seed(w, "/ws/a", 1)
+        seed(w, "/ws/b", 1)
+        w.note("handoff", "/ws/a")
+        w.note("handoff", "/ws/b")
+        w.note("release", "/ws/a")       # A's barrier, while B tops any stack
+        w.note("grant", "/ws/a", epoch=2, owner="w1")   # legitimate
+        w.note("grant", "/ws/b", epoch=2, owner="w1")   # BEFORE B's release
+        w.note("release", "/ws/b")
+        w.note("handoff-end", "/ws/b")
+        w.note("handoff-end", "/ws/a")
+        violations = w.violations()
+        assert [inv for inv, _m in violations] == ["barrier-before-regrant"]
+        assert "/ws/b" in violations[0][1]
+
+
+# ── the interleaving explorer ────────────────────────────────────────
+
+
+class TestScheduleEnumeration:
+    def test_counts_match_multinomials(self):
+        # interleavings of disjoint ordered streams = multinomial coeffs
+        assert len(explore.schedules(explorer_config("failover-crash"))) \
+            == 4        # C(4,1): [a0 a1 a2] x [K]
+        assert len(explore.schedules(
+            explorer_config("failover-partition"))) == 10   # C(5,2)
+        assert len(explore.schedules(explorer_config("failover-2ws"))) \
+            == 30       # 5!/(2!·2!·1!)
+        assert len(explore.schedules(explorer_config("adoption"))) \
+            == 15       # C(6,2): [a0..a3] x [G Z]
+        total = sum(len(explore.schedules(c)) for c in EXPLORER_CONFIGS)
+        assert total == 77  # the CI gate's exhaustive universe
+
+    def test_stream_internal_order_preserved(self):
+        for schedule in explore.schedules(explorer_config("failover-2ws")):
+            toks = schedule.split(".")
+            a = [t for t in toks if t.startswith("a")]
+            b = [t for t in toks if t.startswith("b")]
+            assert a == ["a0", "a1"] and b == ["b0", "b1"]
+            assert len(toks) == 5
+
+    def test_commuting_reduction_drops_swapped_twins(self):
+        full = ExplorerConfig("x", workspaces=("A", "B"), ops=(2, 2),
+                              controls=())
+        reduced = ExplorerConfig("x", workspaces=("A", "B"), ops=(2, 2),
+                                 controls=(), commuting=("A", "B"))
+        full_s = explore.schedules(full)
+        red_s = explore.schedules(reduced)
+        assert len(full_s) == 6 and len(red_s) < 6
+        # every dropped schedule differs from a kept one only by an
+        # adjacent A/B swap (the equivalence the reduction claims)
+        assert set(red_s) <= set(full_s)
+
+    def test_unknown_config_raises(self):
+        with pytest.raises(KeyError, match="unknown explorer config"):
+            explorer_config("nope")
+
+
+class TestExplorerRuns:
+    def test_failover_crash_sweep_clean(self, tmp_path):
+        report = explore.run_config("failover-crash", base_dir=tmp_path)
+        assert report["schedules"] == 4
+        assert report["violations"] == []
+
+    def test_handoff_sweep_clean(self, tmp_path):
+        report = explore.run_config("handoff", base_dir=tmp_path)
+        assert report["schedules"] == 4
+        assert report["violations"] == []
+
+    @pytest.mark.parametrize("mutation,config", [
+        ("frozen-epoch", "failover-crash"),
+        ("skip-fence-write", "failover-crash"),
+        ("ack-without-commit", "failover-crash"),
+        ("skip-barrier", "handoff"),
+    ])
+    def test_each_mutation_goes_red(self, tmp_path, mutation, config):
+        report = explore.run_config(config, base_dir=tmp_path,
+                                    mutation=mutation)
+        assert report["violations"], (
+            f"explorer is blind to {mutation}: every {config} schedule "
+            f"passed with the protocol deliberately broken")
+
+    def test_violation_replays_deterministically(self, tmp_path):
+        import re
+
+        def norm(violations):
+            # each run executes in its own temporary root; the violation
+            # CONTENT is deterministic modulo that root
+            return [(inv, re.sub(r"\S+/tenants/", "<root>/tenants/", msg))
+                    for inv, msg in violations]
+
+        report = explore.run_config("failover-crash", base_dir=tmp_path,
+                                    mutation="skip-fence-write")
+        schedule, invariant, _msg = report["violations"][0]
+        first = explore.run_schedule("failover-crash", schedule,
+                                     base_dir=tmp_path,
+                                     mutation="skip-fence-write")
+        second = explore.run_schedule("failover-crash", schedule,
+                                      base_dir=tmp_path,
+                                      mutation="skip-fence-write")
+        assert first and norm(first) == norm(second)
+        assert invariant in [inv for inv, _m in first]
+
+    def test_finding_carries_replay_string(self, tmp_path):
+        findings, executed = explore.run(
+            configs=(explorer_config("failover-crash"),),
+            mutation="skip-fence-write")
+        assert executed == 4
+        assert findings and all(f.rule == "GL-PROTO-SCHED"
+                                for f in findings)
+        assert "replay: failover-crash@" in findings[0].message
+
+
+# ── the real bug the explorer's design surfaced, pinned ──────────────
+
+
+class FakeClock:
+    def __init__(self, t: float = 1_700_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestRegressionsFromProtolint:
+    """``LeaseTable.grant`` used to stamp the new-epoch fence even when the
+    wal write for the grant failed — lease durability did NOT precede the
+    fence. A crash after the stamp left the fence one epoch ahead of the
+    durable table; adoption folded the wal back to the old epoch and
+    re-issued it, so the old grantee's journal passed the fence check
+    alongside the new one's (split-brain). grant now retries the commit
+    and aborts UNFENCED on persistent failure."""
+
+    def test_failed_grant_commit_never_stamps_the_fence(self, tmp_path):
+        table = LeaseTable(tmp_path / "cluster", clock=FakeClock())
+        ws = str(tmp_path / "tenant0")
+        assert table.grant(ws, "w0") == 1
+        plan = FaultPlan([FaultSpec("journal.append", rate=1.0)], seed=0)
+        with installed(plan):
+            with pytest.raises(OSError):
+                table.grant(ws, "w1")
+        # the fence still advertises the last DURABLE epoch
+        assert LeaseTable.read_fence(ws)["epoch"] == 1
+        # and the abort is complete: the LIVE table rolled back too — a
+        # supervisor surviving the raise must not see the aborted grantee
+        # as owner (it was never fenced or recovered)
+        assert table.owner(ws) == "w0" and table.epoch(ws) == 1
+        table.close()
+        # adoption agrees: the replacement folds the wal to epoch 1
+        adopted = LeaseTable(tmp_path / "cluster", clock=FakeClock())
+        assert adopted.epoch(ws) == 1
+        # and the next grant is a NEW epoch past the failed one — the two
+        # grantees can never share a number
+        assert adopted.grant(ws, "w1") >= 2
+        assert LeaseTable.read_fence(ws)["epoch"] == adopted.epoch(ws)
+        adopted.close()
+
+    def test_transient_torn_commit_retries_and_lands(self, tmp_path):
+        table = LeaseTable(tmp_path / "cluster", clock=FakeClock())
+        ws = str(tmp_path / "tenant0")
+        plan = FaultPlan([FaultSpec("journal.append", steps=(1,),
+                                    mode="torn")], seed=0)
+        with installed(plan):
+            assert table.grant(ws, "w0") == 1  # retry self-repairs the tail
+        assert LeaseTable.read_fence(ws)["epoch"] == 1
+        table.close()
+        reopened = LeaseTable(tmp_path / "cluster", clock=FakeClock())
+        assert reopened.epoch(ws) == 1 and reopened.owner(ws) == "w0"
+        reopened.close()

@@ -15,7 +15,8 @@ from pathlib import Path
 
 import pytest
 
-from vainplex_openclaw_tpu.analysis.witness import LockOrderWitness
+from vainplex_openclaw_tpu.analysis.witness import (LockOrderWitness,
+                                                    ProtocolWitness)
 from vainplex_openclaw_tpu.cluster import ClusterSupervisor
 from vainplex_openclaw_tpu.cluster.ring import FENCE_FILE, LeaseTable
 from vainplex_openclaw_tpu.core.api import list_logger
@@ -99,6 +100,11 @@ def run_storm(root: Path, seed: int, kill_step=None,
         witness.wrap_attr(sup.leases.journal, "_buffer_lock",
                           "Journal._buffer_lock")
     witness.wrap_attr(sup.timer, "_lock", "ClusterSupervisor.timer._lock")
+    # protolint's dynamic half (ISSUE 13): the storm's whole grant/
+    # recover/deliver/release sequence must honor the PROTOCOL_TABLE
+    # order invariants — schedule-independent, like the lock witness.
+    proto_witness = ProtocolWitness()
+    proto_witness.arm_supervisor(sup)
 
     ops = build_ops(seed, root)
     specs = [
@@ -133,6 +139,7 @@ def run_storm(root: Path, seed: int, kill_step=None,
     }
     sup.stop()
     witness.assert_acyclic()
+    proto_witness.assert_clean()
     reset_journals()
     return summary
 

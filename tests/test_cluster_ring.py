@@ -154,6 +154,63 @@ class TestLeaseTable:
         table.close()
 
 
+class TestAdoptionCrashPointProperty:
+    """ISSUE 13 satellite: a grant is durable in the wal the moment
+    ``grant`` returns, but ``leases.json`` only advances at compaction.
+    A supervisor that dies anywhere in that window leaves committed-but-
+    uncompacted grants for the replacement to fold at open — the adoption
+    edge PR 12 added. Property: for EVERY crash point in a seeded grant
+    history (kill -9 via ``Journal.abandon``: no farewell compaction, no
+    meta), the recovered table equals the oracle of the grants that
+    returned — exactly, owners and epochs both."""
+
+    HISTORY_LEN = 12
+
+    def _history(self, seed: int):
+        import random
+        rng = random.Random(seed)
+        return [(f"ws{rng.randrange(4)}", f"w{rng.randrange(3)}")
+                for _ in range(self.HISTORY_LEN)]
+
+    def _run_crash_point(self, root, history, crash_after: int,
+                         compact_every=None) -> None:
+        clock = FakeClock()
+        table = LeaseTable(root / "cluster", clock=clock)
+        oracle: dict[str, dict] = {}
+        for i, (ws_key, worker) in enumerate(history[:crash_after]):
+            ws = str(root / ws_key)
+            epoch = table.grant(ws, worker)
+            oracle[ws] = {"owner": worker, "epoch": epoch}
+            if compact_every and (i + 1) % compact_every == 0:
+                table.journal.compact()  # leases.json catches up mid-run
+        if table.journal is not None:
+            table.journal.abandon()  # kill -9: wal prefix only
+        recovered = LeaseTable(root / "cluster", clock=clock)
+        assert recovered.snapshot() == oracle, \
+            f"crash point {crash_after}: recovered table != grant oracle"
+        # epochs keep moving from the recovered base (fencing across the
+        # generation boundary): a post-adoption grant supersedes every
+        # pre-crash epoch for that workspace
+        if oracle:
+            ws = sorted(oracle)[0]
+            assert recovered.grant(ws, "w9") == oracle[ws]["epoch"] + 1
+        recovered.close()
+
+    def test_every_crash_point_recovers_the_oracle(self, tmp_path):
+        history = self._history(seed=7)
+        for crash_after in range(self.HISTORY_LEN + 1):
+            self._run_crash_point(tmp_path / f"crash{crash_after}",
+                                  history, crash_after)
+
+    def test_crash_points_with_interleaved_compaction(self, tmp_path):
+        # same property when leases.json partially caught up mid-history:
+        # the fold must apply only the wal suffix past the compacted state
+        history = self._history(seed=11)
+        for crash_after in range(self.HISTORY_LEN + 1):
+            self._run_crash_point(tmp_path / f"cc{crash_after}", history,
+                                  crash_after, compact_every=3)
+
+
 class TestJournalFencing:
     """The race the fence exists for: a stale-epoch writer (zombie) against
     the new owner. The journal must reject the stale write, count it, and

@@ -3,8 +3,21 @@
 entries <1 ms, cortex agent tools <100 ms, pattern matching <2 ms (already
 enforced in test_cortex_trackers R-033). Generous CI multipliers: budgets
 are checked at 4x to keep slow shared runners from flaking while still
-catching order-of-magnitude regressions."""
+catching order-of-magnitude regressions.
 
+The redaction scans additionally scale their budget by a machine factor
+measured in the same run (ISSUE 13 deflake): the published budgets are
+absolute wall-clock numbers for the reference hardware, and this suite's
+containers run both slower (a pristine-tree A/B measured the 100 KB scan
+at ~97% of its 4x budget on an idle box) and noisier (co-tenant load
+jitters wall time up to 2x). A fixed pure-regex probe is timed best-of-N
+right next to the workload; its ratio to the reference-machine nominal
+scales the budget, so sustained load and slow containers inflate probe
+and scan alike while a genuine order-of-magnitude regression still fails
+— the assertion stays wall-clock (the published contract), it just stops
+charging the container's speed to the code under test."""
+
+import re
 import time
 
 from vainplex_openclaw_tpu.governance.redaction import (
@@ -17,6 +30,14 @@ from vainplex_openclaw_tpu.storage.atomic import write_json_atomic
 
 SLACK = 4.0  # CI multiplier over the published budget
 
+# Reference-machine nominal for the calibration probe below (~100 KB of
+# word-shaped text through one compiled character-class regex). On the
+# hardware class the BASELINE.md budgets describe this measures ~3 ms;
+# quiet CI containers measure ~5-6 ms (factor ~1.8), loaded ones more.
+PROBE_BASELINE_MS = 3.0
+_PROBE_TEXT = "a quick brown fox jumps over 0123456789 lazy dogs; " * 2000
+_PROBE_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
 
 def timed_ms(fn, n=3):
     best = float("inf")
@@ -25,6 +46,15 @@ def timed_ms(fn, n=3):
         fn()
         best = min(best, (time.perf_counter() - t0) * 1000)
     return best
+
+
+def machine_factor(n=5):
+    """How much slower this machine runs CPU-bound regex work than the
+    budget-publishing reference, measured now (never < 1 — a fast machine
+    does not tighten the published budget)."""
+    probe_ms = timed_ms(
+        lambda: sum(1 for _ in _PROBE_RE.finditer(_PROBE_TEXT)), n=n)
+    return max(1.0, probe_ms / PROBE_BASELINE_MS)
 
 
 def make_engine():
@@ -43,15 +73,19 @@ class TestRedactionBudgets:
         engine = make_engine()
         text = self.payload(100_000)
         engine.scan_string(text)  # warm regex caches
-        ms = timed_ms(lambda: engine.scan_string(text))
-        assert ms < 5.0 * SLACK, f"100KB scan took {ms:.1f} ms"
+        factor = machine_factor()
+        ms = timed_ms(lambda: engine.scan_string(text), n=5)
+        assert ms < 5.0 * SLACK * factor, \
+            f"100KB scan took {ms:.1f} ms (machine factor {factor:.2f})"
 
     def test_1mb_scan_under_budget(self):
         engine = make_engine()
         text = self.payload(1_000_000)
         engine.scan_string(text)
-        ms = timed_ms(lambda: engine.scan_string(text))
-        assert ms < 50.0 * SLACK, f"1MB scan took {ms:.1f} ms"
+        factor = machine_factor()
+        ms = timed_ms(lambda: engine.scan_string(text), n=5)
+        assert ms < 50.0 * SLACK * factor, \
+            f"1MB scan took {ms:.1f} ms (machine factor {factor:.2f})"
 
     def test_vault_1000_entries_resolution_under_budget(self):
         vault = RedactionVault()

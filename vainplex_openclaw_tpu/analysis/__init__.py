@@ -1,29 +1,35 @@
-"""graftlint: repo-wide concurrency + pattern-safety + JAX compilation
-static analysis (ISSUE 8, ISSUE 10).
+"""Static-analysis gates: graftlint + tracelint + protolint (ISSUE 8,
+ISSUE 10, ISSUE 13).
 
-Seven passes, one gate:
+One runner, one shared baseline, one exit code; three gates, each with its
+own greppable summary line:
 
-- :mod:`.locks` — lock-discipline checker over the declarative guarded-
-  state table (GL-LOCK-GUARD, GL-LOCK-BLOCKING);
-- :mod:`.lock_order` — static lock-acquisition graph + cycle detection
-  (GL-LOCK-ORDER), paired with the runtime :mod:`.witness` the chaos
-  suites arm;
-- :mod:`.redos` — catastrophic-backtracking screening (GL-REDOS), wired
-  into the governance policy planner and cortex pattern banks at compile
-  time and run here over the shipped default packs;
-- :mod:`.drift` — cross-file contract lints (GL-DRIFT-*);
-- :mod:`.tracing` — trace-safety over the :mod:`.jit_table` entries
-  (GL-TRACE-HOSTSYNC / -CONTROLFLOW / -IMPURE / -TABLE);
-- :mod:`.retrace` — recompilation hazards (GL-RETRACE-UNBUCKETED,
-  GL-RETRACE-DTYPE), paired with the runtime
-  :class:`~.witness.RetraceWitness` the bench/equivalence suites arm;
-- :mod:`.sharding` — mesh/PartitionSpec contracts (GL-SHARD-AXIS,
-  GL-SHARD-DONATE, GL-SHARD-RULE).
+- **graftlint** — concurrency + pattern-safety + contract drift:
+  :mod:`.locks` (GL-LOCK-GUARD/-BLOCKING over the guarded-state table),
+  :mod:`.lock_order` (GL-LOCK-ORDER, paired with the runtime
+  :class:`~.witness.LockOrderWitness` the chaos storms arm),
+  :mod:`.redos` (GL-REDOS over the shipped packs/policies),
+  :mod:`.drift` (GL-DRIFT-*).
+- **tracelint** — JAX compilation honesty off the declarative
+  :mod:`.jit_table`: :mod:`.tracing` (GL-TRACE-*), :mod:`.retrace`
+  (GL-RETRACE-*, paired with the :class:`~.witness.RetraceWitness`),
+  :mod:`.sharding` (GL-SHARD-*).
+- **protolint** — distributed-protocol invariants off the declarative
+  :mod:`.proto_table`: :mod:`.proto` (GL-PROTO-EPOCH/-FENCE/-ORDER/-ACK
+  AST lints over cluster/ + storage/), and :mod:`.explore` — the
+  systematic interleaving explorer (GL-PROTO-SCHED), which exhaustively
+  enumerates every schedule of the table's small configurations through
+  the real supervisor/worker/lease/journal stack, asserting the invariant
+  catalog at every step and emitting a replayable schedule string on
+  violation; paired with the :class:`~.witness.ProtocolWitness` the
+  cluster storms arm.
 
 Run as ``python -m vainplex_openclaw_tpu.analysis`` (exit 1 on any
-non-baselined finding, 2 on crash) or import :func:`run_analysis` from
-tests. Suppressions live in ``analysis/baseline.json`` — one entry per
-finding key, each with a rationale (see docs/static-analysis.md).
+non-baselined finding, 2 on crash). ``--only <rule-prefix>[,...]`` runs a
+subset of rule families — the seam that lets CI run the slow explorer
+independently of the fast AST lints. Suppressions live in
+``analysis/baseline.json`` — one entry per finding key, each with a
+rationale (see docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -31,14 +37,18 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Optional
 
-from . import drift, lock_order, locks, redos, retrace, sharding, tracing
-from .findings import Finding, LintReport, apply_baseline, load_baseline
+from . import (drift, explore, lock_order, locks, proto, redos, retrace,
+               sharding, tracing)
+from .findings import (GATES, Finding, LintReport, apply_baseline, gate_of,
+                       load_baseline)
 from .jit_table import JIT_TABLE, JitEntry
-from .witness import LockOrderWitness, RetraceWitness
+from .proto_table import EXPLORER_CONFIGS, PROTO_MODULES
+from .witness import LockOrderWitness, ProtocolWitness, RetraceWitness
 
 __all__ = [
-    "Finding", "LintReport", "LockOrderWitness", "RetraceWitness",
-    "JIT_TABLE", "JitEntry", "run_analysis",
+    "Finding", "LintReport", "LockOrderWitness", "ProtocolWitness",
+    "RetraceWitness", "JIT_TABLE", "JitEntry", "GATES", "gate_of",
+    "EXPLORER_CONFIGS", "run_analysis",
     "collect_findings", "default_pack_findings", "load_baseline",
 ]
 
@@ -111,33 +121,108 @@ def _builtin_policies() -> list:
     return get_builtin_policies({k: True for k in sorted(keys)})
 
 
-def collect_findings(root: str | Path) -> tuple[list, int]:
-    """All seven passes over ``root``; → (findings, files_scanned).
-    ``files_scanned`` stays pinned to the lock-order pass's full-package
-    file count: the retrace/sharding passes traverse the package too, but
-    reporting ONE canonical traversal keeps the CI-greppable ``files=``
-    number stable and still catches a scan that stopped walking (every
-    whole-tree pass globs the same package)."""
+# Each pass with the rule families it can emit — what ``--only`` filters
+# against. A pass runs when the filter could match any of its rules;
+# findings are additionally filtered per rule id, so ``--only
+# GL-PROTO-EPOCH`` runs the proto pass but reports only that family.
+_PASS_RULES = {
+    "locks": ("GL-LOCK-GUARD", "GL-LOCK-BLOCKING"),
+    "lock_order": ("GL-LOCK-ORDER",),
+    "drift": ("GL-DRIFT-SHED", "GL-DRIFT-FAULTSITE", "GL-DRIFT-CONFIG",
+              "GL-DRIFT-BENCH"),
+    "redos": ("GL-REDOS",),
+    "tracing": ("GL-TRACE-HOSTSYNC", "GL-TRACE-CONTROLFLOW",
+                "GL-TRACE-IMPURE", "GL-TRACE-TABLE"),
+    "retrace": ("GL-RETRACE-UNBUCKETED", "GL-RETRACE-DTYPE"),
+    "sharding": ("GL-SHARD-AXIS", "GL-SHARD-DONATE", "GL-SHARD-RULE"),
+    "proto": ("GL-PROTO-EPOCH", "GL-PROTO-FENCE", "GL-PROTO-ORDER",
+              "GL-PROTO-ACK"),
+    "explore": ("GL-PROTO-SCHED",),
+}
+
+
+def _wanted(only, rules) -> bool:
+    if only is None:
+        return True
+    return any(r.startswith(o) or o.startswith(r)
+               for o in only for r in rules)
+
+
+def _matches(only, rule: str) -> bool:
+    return only is None or any(rule.startswith(o) for o in only)
+
+
+def _collect(root: str | Path, only=None) -> tuple:
+    """(findings, scanned, proto_files, schedules). ``scanned`` stays
+    pinned to the lock-order pass's full-package file count: the JAX
+    passes traverse the package too, but reporting ONE canonical
+    traversal keeps the CI-greppable ``files=`` number stable and still
+    catches a scan that stopped walking. The explorer (the one slow
+    family) runs only when the filter reaches GL-PROTO-SCHED."""
     findings: list = []
-    lock_f, _ = locks.run(root)
-    order_f, scanned = lock_order.run(root)
-    drift_f, _ = drift.run(root)
-    trace_f, _ = tracing.run(root)
-    retrace_f, _ = retrace.run(root)
-    shard_f, _ = sharding.run(root)
-    findings.extend(lock_f)
-    findings.extend(order_f)
-    findings.extend(drift_f)
-    findings.extend(trace_f)
-    findings.extend(retrace_f)
-    findings.extend(shard_f)
-    findings.extend(default_pack_findings())
+    # The canonical package traversal backs the files= number on the
+    # graftlint/tracelint lines; skip it entirely when the filter selects
+    # neither gate (e.g. the explorer-only CI step) — those lines don't
+    # print, so parsing the whole package would buy nothing.
+    fast = ("locks", "lock_order", "drift", "redos", "tracing", "retrace",
+            "sharding")
+    scanned = 0
+    if any(_wanted(only, _PASS_RULES[p]) for p in fast):
+        order_f, scanned = lock_order.run(root)
+        if _wanted(only, _PASS_RULES["lock_order"]):
+            findings.extend(order_f)
+    if _wanted(only, _PASS_RULES["locks"]):
+        findings.extend(locks.run(root)[0])
+    if _wanted(only, _PASS_RULES["drift"]):
+        findings.extend(drift.run(root)[0])
+    if _wanted(only, _PASS_RULES["redos"]):
+        findings.extend(default_pack_findings())
+    if _wanted(only, _PASS_RULES["tracing"]):
+        findings.extend(tracing.run(root)[0])
+    if _wanted(only, _PASS_RULES["retrace"]):
+        findings.extend(retrace.run(root)[0])
+    if _wanted(only, _PASS_RULES["sharding"]):
+        findings.extend(sharding.run(root)[0])
+    proto_files = 0
+    if _wanted(only, _PASS_RULES["proto"]):
+        proto_f, proto_files = proto.run(root)
+        findings.extend(proto_f)
+    schedules = 0
+    if _wanted(only, _PASS_RULES["explore"]):
+        explore_f, schedules = explore.run(root)
+        findings.extend(explore_f)
+    if only is not None:
+        findings = [f for f in findings if _matches(only, f.rule)]
+    return findings, scanned, proto_files, schedules
+
+
+def collect_findings(root: str | Path) -> tuple[list, int]:
+    """All passes over ``root``; → (findings, files_scanned). Kept as the
+    historical two-tuple surface; :func:`run_analysis` carries the
+    per-gate accounting."""
+    findings, scanned, _proto_files, _schedules = _collect(root)
     return findings, scanned
 
 
 def run_analysis(root: str | Path,
-                 baseline_path: Optional[str | Path] = None) -> LintReport:
-    findings, scanned = collect_findings(root)
-    report = LintReport(files_scanned=scanned)
-    apply_baseline(findings, load_baseline(baseline_path), report)
+                 baseline_path: Optional[str | Path] = None,
+                 only=None) -> LintReport:
+    findings, scanned, proto_files, schedules = _collect(root, only)
+    gates_run = tuple(
+        gate for gate, prefixes in GATES
+        if only is None or any(_wanted(only, rules)
+                               and gate_of(rules[0]) == gate
+                               for rules in _PASS_RULES.values()))
+    report = LintReport(
+        files_scanned=scanned,
+        gate_files={"protolint": proto_files},
+        schedules=schedules,
+        gates_run=gates_run)
+    baseline = load_baseline(baseline_path)
+    if only is not None:
+        # Scope the baseline to the families that ran: entries for
+        # skipped families are neither suppressions nor stale this run.
+        baseline = {k: r for k, r in baseline.items()
+                    if _matches(only, k.split("::", 1)[0])}
+    apply_baseline(findings, baseline, report)
     return report

@@ -23,6 +23,34 @@ from typing import Optional
 
 BASELINE_NAME = "baseline.json"
 
+# The three gates one runner hosts (ISSUE 8/10/13): rule-id prefix →
+# which summary line a finding lands on. One shared baseline, one exit
+# code; per-gate greppable lines so CI and humans see which discipline
+# regressed. GL-BASELINE (a suppression without rationale) counts against
+# the gate that owns the suppressed rule.
+GATES: tuple = (
+    ("graftlint", ("GL-LOCK", "GL-REDOS", "GL-DRIFT")),
+    ("tracelint", ("GL-TRACE", "GL-RETRACE", "GL-SHARD")),
+    ("protolint", ("GL-PROTO",)),
+)
+
+
+def gate_of(rule: str) -> str:
+    for gate, prefixes in GATES:
+        if any(rule.startswith(p) for p in prefixes):
+            return gate
+    return "graftlint"  # GL-BASELINE with no parsable owner, unknown rules
+
+
+def gate_of_finding(finding) -> str:
+    """Like :func:`gate_of`, but a GL-BASELINE finding (a suppression
+    without rationale) is attributed to the gate that owns the SUPPRESSED
+    rule, which rides in its ``no-rationale:<original key>`` detail."""
+    if finding.rule.startswith("GL-BASELINE") \
+            and finding.detail.startswith("no-rationale:"):
+        return gate_of(finding.detail[len("no-rationale:"):])
+    return gate_of(finding.rule)
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -42,28 +70,62 @@ class Finding:
 
 @dataclass
 class LintReport:
-    """The outcome of one graftlint run over a tree."""
+    """The outcome of one analysis run (all three gates, or the subset a
+    ``--only`` filter selected — ``gates_run`` names them)."""
 
     files_scanned: int = 0
     active: list = field(default_factory=list)       # findings not baselined
     suppressed: list = field(default_factory=list)   # (finding, rationale)
     stale_keys: list = field(default_factory=list)   # baseline entries unmatched
+    # gate → files its passes parsed; gates absent fall back to
+    # files_scanned (the canonical package traversal).
+    gate_files: dict = field(default_factory=dict)
+    schedules: int = 0   # explorer schedules executed (protolint line)
+    gates_run: tuple = ("graftlint", "tracelint", "protolint")
 
     @property
     def ok(self) -> bool:
         return not self.active
 
+    def _gate_counts(self, gate: str) -> tuple:
+        a = sum(1 for f in self.active if gate_of_finding(f) == gate)
+        s = sum(1 for f, _r in self.suppressed
+                if gate_of_finding(f) == gate)
+        t = sum(1 for k in self.stale_keys
+                if gate_of(k.split("::", 1)[0]) == gate)
+        return a, s, t
+
     def summary(self) -> str:
-        # The CI parse smoke greps this exact shape: a crashing analyzer
-        # prints no summary line and fails loud instead of passing silent.
-        return (f"graftlint: files={self.files_scanned} "
-                f"active={len(self.active)} "
-                f"suppressed={len(self.suppressed)} "
-                f"stale={len(self.stale_keys)}")
+        # The CI parse smokes grep these exact shapes (one line per gate,
+        # graftlint first): a crashing analyzer prints no summary lines
+        # and exits 2 — it can never read as a passing gate.
+        lines = []
+        for gate, _prefixes in GATES:
+            if gate not in self.gates_run:
+                continue
+            a, s, t = self._gate_counts(gate)
+            files = self.gate_files.get(gate, self.files_scanned)
+            extra = (f" schedules={self.schedules}"
+                     if gate == "protolint" else "")
+            lines.append(f"{gate}: files={files}{extra} "
+                         f"active={a} suppressed={s} stale={t}")
+        return "\n".join(lines)
 
     def to_dict(self) -> dict:
+        gates = {}
+        for gate, _prefixes in GATES:
+            if gate not in self.gates_run:
+                continue
+            a, s, t = self._gate_counts(gate)
+            gates[gate] = {
+                "files": self.gate_files.get(gate, self.files_scanned),
+                "active": a, "suppressed": s, "stale": t,
+            }
+            if gate == "protolint":
+                gates[gate]["schedules"] = self.schedules
         return {
             "filesScanned": self.files_scanned,
+            "gates": gates,
             "active": [vars(f) | {"key": f.key} for f in self.active],
             "suppressed": [vars(f) | {"key": f.key, "rationale": r}
                            for f, r in self.suppressed],

@@ -1,7 +1,16 @@
 """Runtime witnesses: the dynamic half of the static passes.
 
-Two witnesses live here. :class:`LockOrderWitness` (below) closes the
+Three witnesses live here. :class:`LockOrderWitness` (below) closes the
 lock-order pass's callback/cross-object gap at test time.
+:class:`ProtocolWitness` does the same for the protolint order rules
+(ISSUE 13): the static pass proves first-occurrence lexical order inside
+one function; the witness records the *dynamic* grant/recover/deliver/
+release/handoff event sequence of a real run and asserts the
+PROTOCOL_TABLE's order invariants over it — grants advance the epoch
+strictly, traffic never precedes recovery at the granted epoch, and a
+handoff never regrants before the release barrier returned. Armed in the
+cluster chaos storms and driven schedule-by-schedule by the interleaving
+explorer (:mod:`.explore`).
 :class:`RetraceWitness` does the same for the retrace pass: static
 analysis proves the *discipline* (shapes bucketed, jit construction
 memoized); the witness proves the *outcome* — that a same-bucket request
@@ -154,6 +163,148 @@ class LockOrderWitness:
             raise AssertionError(
                 f"lock acquisition order has cycles: {pretty} "
                 f"(edges: {sorted(self.edges())})")
+
+
+class ProtocolWitness:
+    """Records the cluster's protocol event sequence and answers whether
+    the dynamic order honored the PROTOCOL_TABLE invariants.
+
+    Wrap with :meth:`arm_supervisor` (leases + every current worker handle
+    + the handoff entry point); call it again after membership changes
+    that build new handles (a second supervisor generation). Recording is
+    append-only and lock-cheap — test/storm freight, like the other
+    witnesses; nothing imports this at serving time.
+
+    Events recorded (each ``(kind, ws, info)``):
+
+    - ``grant``    — LeaseTable.grant returned ``info["epoch"]``
+    - ``recover``  — a worker's add_workspace(ws, epoch) returned
+    - ``deliver``  — a worker finished delivering ``info["seq"]``
+    - ``release``  — release_workspace(ws) returned (barrier success)
+    - ``handoff``/``handoff-end`` — the supervisor's handoff window
+    """
+
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self.events: list = []
+
+    def note(self, kind: str, ws, **info) -> None:
+        with self._mutex:
+            self.events.append((kind, str(ws) if ws is not None else None,
+                                info))
+
+    # ── arming ───────────────────────────────────────────────────────
+
+    def _wrap(self, obj, attr, record):
+        fn = getattr(obj, attr)
+        if getattr(fn, "_proto_witnessed", False):
+            return
+
+        def wrapped(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            record(out, *args, **kwargs)
+            return out
+
+        wrapped._proto_witnessed = True
+        wrapped.__wrapped__ = fn
+        setattr(obj, attr, wrapped)
+
+    def arm_supervisor(self, sup) -> None:
+        """Wrap the supervisor's lease grants, handoff window, and every
+        CURRENT worker handle's protocol methods. Idempotent per object;
+        re-call after adding workers or adopting a new generation."""
+        self._wrap(sup.leases, "grant",
+                   lambda epoch, ws, wid: self.note("grant", ws,
+                                                    epoch=epoch, owner=wid))
+        fn = sup.handoff
+        if not getattr(fn, "_proto_witnessed", False):
+            def handoff(ws, *a, _fn=fn, **kw):
+                self.note("handoff", ws)
+                try:
+                    return _fn(ws, *a, **kw)
+                finally:
+                    self.note("handoff-end", ws)
+            handoff._proto_witnessed = True
+            handoff.__wrapped__ = fn
+            sup.handoff = handoff
+        for state in sup.workers().values():
+            self.arm_worker(state.handle)
+
+    def arm_worker(self, handle) -> None:
+        self._wrap(handle, "add_workspace",
+                   lambda out, ws, epoch: self.note("recover", ws,
+                                                    epoch=epoch))
+        self._wrap(handle, "deliver",
+                   lambda out, seq, op: self.note(
+                       "deliver", op.get("ws"), seq=seq,
+                       content=op.get("content"),
+                       worker=handle.worker_id))
+        self._wrap(handle, "release_workspace",
+                   lambda out, ws: self.note("release", ws))
+
+    # ── the order rules ──────────────────────────────────────────────
+
+    def violations(self) -> list:
+        """Order-invariant breaches over the recorded sequence, each a
+        ``(invariant, message)`` pair; empty list = the dynamic schedule
+        honored the table."""
+        with self._mutex:
+            events = list(self.events)
+        out: list = []
+        last_epoch: dict = {}        # ws -> last granted epoch
+        recovered_at: dict = {}      # ws -> epoch recovery last returned for
+        # Handoff windows are tracked PER WORKSPACE (a depth count plus a
+        # released-in-window mark), not as one LIFO stack: concurrent
+        # handoffs of different workspaces interleave their events, and a
+        # shared stack would attribute ws A's release to whichever window
+        # happened to be on top.
+        open_windows: dict = {}      # ws -> open handoff window depth
+        released: set = set()        # ws whose open window saw its release
+        for kind, ws, info in events:
+            if kind == "grant":
+                epoch = info.get("epoch")
+                prev = last_epoch.get(ws)
+                if prev is not None and epoch <= prev:
+                    out.append((
+                        "epoch-monotonic",
+                        f"grant({ws}) returned epoch {epoch} after {prev} — "
+                        f"epochs must advance strictly"))
+                last_epoch[ws] = epoch
+                if open_windows.get(ws, 0) > 0 and ws not in released:
+                    out.append((
+                        "barrier-before-regrant",
+                        f"handoff({ws}) regranted before the release "
+                        f"barrier returned"))
+            elif kind == "recover":
+                recovered_at[ws] = info.get("epoch")
+            elif kind == "deliver":
+                if ws in last_epoch \
+                        and recovered_at.get(ws) != last_epoch[ws]:
+                    out.append((
+                        "fence-before-traffic",
+                        f"deliver({ws}, seq={info.get('seq')}) before "
+                        f"recovery at epoch {last_epoch[ws]} returned "
+                        f"(recovered at {recovered_at.get(ws)})"))
+            elif kind == "release":
+                if open_windows.get(ws, 0) > 0:
+                    released.add(ws)
+            elif kind == "handoff":
+                open_windows[ws] = open_windows.get(ws, 0) + 1
+                released.discard(ws)
+            elif kind == "handoff-end":
+                depth = open_windows.get(ws, 0)
+                if depth <= 1:
+                    open_windows.pop(ws, None)
+                else:
+                    open_windows[ws] = depth - 1
+                released.discard(ws)
+        return out
+
+    def assert_clean(self) -> None:
+        violations = self.violations()
+        if violations:
+            pretty = "; ".join(f"[{inv}] {msg}" for inv, msg in violations)
+            raise AssertionError(f"protocol order violated: {pretty}")
 
 
 class RetraceWitness:

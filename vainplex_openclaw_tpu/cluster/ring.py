@@ -134,6 +134,17 @@ class LeaseTable:
         self.clock = clock
         self.logger = logger
         self._lock = threading.Lock()
+        # Serializes the whole mutate→journal→(rollback|fence) sequence of
+        # one grant against other GRANTS only (readers stay on the hot
+        # ``_lock``). Without it, two interleaved grants break the abort
+        # path both ways: drop_pending() on a failed grant would discard
+        # the OTHER grant's buffered payload (its commit then vacuously
+        # "succeeds" and stamps a fence for an epoch that never became
+        # durable), and the full-table payload snapshotted after a
+        # concurrent — later rolled back — mutation would persist the
+        # aborted entry. Blocking (group commit + fsync) under this lock
+        # is the point: a grant IS a durable control-plane write.
+        self._grant_lock = threading.Lock()
         self._leases: dict[str, list] = {}  # ws -> [owner, epoch]
         self.path = self.root / "leases.json"
         try:
@@ -187,17 +198,57 @@ class LeaseTable:
     def grant(self, ws: str, worker_id: str) -> int:
         """Move/establish ownership of ``ws``; returns the new epoch. The
         fence write is the linearization point of the failover — it must
-        land before the new owner opens the workspace journal."""
-        with self._lock:
-            lease = self._leases.get(ws)
-            epoch = (lease[1] if lease else 0) + 1
-            self._leases[ws] = [worker_id, epoch]
-            payload = {"leases": {w: list(l)
-                                  for w, l in sorted(self._leases.items())}}
-        if self.journal is not None:
-            self.journal.append(self.STREAM, payload)
-            self.journal.commit()  # lease durability precedes the fence
-        self.write_fence(ws, epoch, worker_id)
+        land before the new owner opens the workspace journal. Grants
+        serialize on ``_grant_lock`` (see __init__) so the abort path
+        below only ever touches its OWN buffered payload and snapshot."""
+        with self._grant_lock:
+            with self._lock:
+                lease = self._leases.get(ws)
+                prior = list(lease) if lease else None
+                epoch = (lease[1] if lease else 0) + 1
+                self._leases[ws] = [worker_id, epoch]
+                payload = {"leases": {w: list(l)
+                                      for w, l in sorted(self._leases.items())}}
+            if self.journal is not None:
+                accepted = self.journal.append(self.STREAM, payload)
+                committed = False
+                for _attempt in range(3):
+                    if self.journal.commit():
+                        committed = True
+                        break
+                if not (accepted and committed):
+                    # Lease durability PRECEDES the fence — enforced, not
+                    # just stated (ISSUE 13; found by the adoption
+                    # crash-point property test): stamping a fence for an
+                    # uncommitted grant opens a crash window where a
+                    # replacement supervisor folds the wal back to the OLD
+                    # epoch while the fence advertises the new one, then
+                    # re-issues that epoch — the old and new grantees
+                    # would share it and both pass the journal's fence
+                    # check. Transient write faults are retried (a torn
+                    # wal tail self-repairs on the next commit);
+                    # persistent failure aborts the grant UNFENCED — the
+                    # same contract as a fence-write fault below. The
+                    # abort is complete: the buffered payload is dropped
+                    # (left in place, the NEXT successful commit — even
+                    # close()'s farewell one — would make the aborted
+                    # epoch durable behind the old fence) and the
+                    # in-memory entry rolls back to the durable lease
+                    # (left advanced, owner() would report the aborted
+                    # grantee, so a supervisor that survives the raise
+                    # would route traffic to an owner that was never
+                    # fenced or recovered). The epoch number is reusable:
+                    # it was never durable, never fenced, never returned
+                    # to any caller.
+                    self.journal.drop_pending()
+                    with self._lock:
+                        if prior is None:
+                            self._leases.pop(ws, None)
+                        else:
+                            self._leases[ws] = prior
+                    raise OSError(self.journal.last_error
+                                  or "lease grant commit failed")
+            self.write_fence(ws, epoch, worker_id)
         return epoch
 
     def write_fence(self, ws: str, epoch: int, worker_id: str) -> None:

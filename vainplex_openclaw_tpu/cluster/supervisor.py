@@ -175,7 +175,8 @@ class ClusterSupervisor:
                  settable_clock: Any = None, journal_cfg: Any = True,
                  lifecycle_cfg: Any = True,
                  on_result: Optional[Callable[[dict, dict], None]] = None,
-                 adopt: bool = False):
+                 adopt: bool = False,
+                 worker_factory: Optional[Callable[[str, Path], Any]] = None):
         cfg = dict(CLUSTER_DEFAULTS)
         cfg.update(config or {})
         self.cfg = cfg
@@ -186,6 +187,11 @@ class ClusterSupervisor:
         self.wall_timers = wall_timers
         self.settable_clock = settable_clock
         self.journal_cfg = journal_cfg
+        # Handle-construction seam (ISSUE 13): protolint's interleaving
+        # explorer drives the REAL supervisor/lease/journal protocol stack
+        # with a protocol-faithful worker whose op executor is a stub —
+        # None keeps the production InProcessWorker/ProcessWorker builds.
+        self.worker_factory = worker_factory
         # Workspace lifecycle (ISSUE 11): with the default settings a new
         # owner's recovery loads the last shipped snapshot + wal tail —
         # failover cost tracks the ship cadence, not the journal's age.
@@ -252,6 +258,8 @@ class ClusterSupervisor:
 
     def _make_handle(self, worker_id: str):
         worker_root = self.root / "workers" / worker_id
+        if self.worker_factory is not None:
+            return self.worker_factory(worker_id, worker_root)
         if self.worker_mode == "process":
             return ProcessWorker(worker_id, worker_root, self._result_q,
                                  ack_every=int(self.cfg.get("ackEveryOps", 16)),
@@ -629,8 +637,12 @@ class ClusterSupervisor:
             # re-grants everything it owned — including grants from THIS
             # list). A superseded grant must not be applied: add_workspace
             # at the stale epoch would re-fence the third owner's live
-            # journal backwards and drop its buffer.
-            if self.leases.epoch(ws) != epoch:
+            # journal backwards and drop its buffer. Ordered comparison,
+            # not `!=`: epochs are monotonic (grant is the only mutation),
+            # so "superseded" IS "a newer epoch exists" — protolint
+            # GL-PROTO-EPOCH pins every epoch staleness check to the
+            # ordered form.
+            if self.leases.epoch(ws) > epoch:
                 continue  # re-granted by a nested failover; it owns recovery
             new_state = self._worker(new_owner)
             if new_state is None or not new_state.alive:

@@ -128,7 +128,7 @@ class InProcessWorker:
                  deterministic_ids: bool = False,
                  settable_clock: Any = None,
                  journal_cfg: Any = True, lifecycle_cfg: Any = True,
-                 logger=None):
+                 logger=None, gateway_builder: Optional[Callable] = None):
         self.worker_id = worker_id
         self.root = Path(root)
         self.clock = clock
@@ -146,7 +146,14 @@ class InProcessWorker:
         # return) — they ride out with the next successful ack, or the
         # supervisor's _inflight entries for them would leak forever.
         self._unreported_acks: list[int] = []
-        self.gw, self.cortex, self.gov = build_worker_gateway(
+        # gateway_builder is the protocol/payload seam (ISSUE 13): every
+        # protocol-bearing method on this class (deliver/ack/fence/crash/
+        # release) runs verbatim over whatever stack the builder returns —
+        # protolint's interleaving explorer substitutes a stub executor
+        # here so exhaustive schedule enumeration doesn't pay a full
+        # governance+cortex build per schedule.
+        self.gw, self.cortex, self.gov = (gateway_builder
+                                          or build_worker_gateway)(
             self.root, worker_id, clock=clock, wall_timers=wall_timers,
             journal_cfg=journal_cfg, lifecycle_cfg=lifecycle_cfg,
             logger=logger)
