@@ -1722,9 +1722,24 @@ def _dense_infeasibility(B: int, H: int, L: int, error: str) -> dict:
             "dense_error_kind": kind}
 
 
+# Per-length child budgets for the flash-vs-dense sweep (ISSUE 14): the
+# driver runs one child PER LENGTH so a wedged 16k compile can no longer
+# take the 128/2048 points down with it — the r05 capture's single 300 s
+# child timed out at 16k and threw away every point that HAD finished.
+# Budgets cover compile+warmup+rounds through the axon tunnel (the 16k
+# flash compile is the long pole; dense above dense_skip_above never
+# compiles at all). Unknown lengths get the ceiling.
+FLASH_LEN_BUDGETS = {128: 120.0, 2048: 180.0, 16384: 420.0}
+
+
+def flash_len_budget(L: int) -> float:
+    return FLASH_LEN_BUDGETS.get(L, max(FLASH_LEN_BUDGETS.values()))
+
+
 def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
                          steps: int = 10, rounds: int = 5,
-                         dense_skip_above: "int | None" = 8192) -> list[dict]:
+                         dense_skip_above: "int | None" = 8192,
+                         budget_s_per_len: "float | None" = None) -> list[dict]:
     """Pallas flash kernel vs XLA dense attention across sequence lengths
     (VERDICT r1 #3: the kernel must earn its flagship slot). TPU-only — the
     interpreter path is not a meaningful timing. Each timed run chains
@@ -1744,7 +1759,15 @@ def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
     dense at L=16384 die in remote compile (HTTP 500 after minutes): the
     [B,H,L,L] scores tensor is 32 GB against a 16 GB chip, so burning
     minutes of a scarce healthy tunnel window re-proving it starves the
-    measurements that CAN complete. Pass None to force the attempt."""
+    measurements that CAN complete. Pass None to force the attempt.
+
+    ``budget_s_per_len`` (ISSUE 14): per-length wall budget measured from
+    warmup start. On expiry mid-sampling the length keeps what it measured
+    (``rounds_completed`` < rounds, ``partial: true``) instead of losing
+    the point; at least one timed round always runs once warmup finished.
+    The driver pairs this with one CHILD per length (flash_len_budget) so
+    a wedge inside compile — where no in-process check can fire — is also
+    contained to its own length."""
     import statistics
 
     import jax
@@ -1760,6 +1783,7 @@ def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
     out = []
     B, H, Dh = 4, 8, 64
     for L in seq_lens:
+        t_len = time.perf_counter()
         key = jax.random.PRNGKey(L)
         q0, k, v = (jax.random.normal(kk, (B, H, L, Dh), jnp.bfloat16)
                     for kk in jax.random.split(key, 3))
@@ -1797,11 +1821,17 @@ def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
                 errors[name] = str(exc)
 
         samples: dict = {name: [] for name in runners}
-        for _ in range(rounds):
+        rounds_done, budget_hit = 0, False
+        for r in range(rounds):
+            if (budget_s_per_len and r > 0
+                    and time.perf_counter() - t_len > budget_s_per_len):
+                budget_hit = True
+                break  # keep the partial rounds — they are real data
             for name, run in runners.items():  # interleaved A/B
                 t0 = time.perf_counter()
                 jax.block_until_ready(run(q0))
                 samples[name].append((time.perf_counter() - t0) / steps * 1e3)
+            rounds_done = r + 1
 
         def side(name):
             if name not in samples or not samples[name]:
@@ -1815,6 +1845,9 @@ def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
         rec = {"metric": "flash_vs_dense", "seq_len": L, "rounds": rounds,
                "flash_ms": flash_ms, "flash_spread": flash_spread,
                "dense_ms": dense_ms, "dense_spread": dense_spread}
+        if budget_hit:
+            rec.update({"rounds_completed": rounds_done, "partial": True,
+                        "budget_s": budget_s_per_len})
         if flash_ms and dense_ms:
             rec["speedup"] = round(dense_ms / flash_ms, 2)
             if max(flash_spread, dense_spread) > 0.30:
@@ -1826,6 +1859,285 @@ def bench_flash_vs_dense(seq_lens: tuple = (128, 2048, 16384),
         out.append(rec)
     peak = _device_peak()[2]
     return validate_flash_sweep(out, peak, B=B, H=H, Dh=Dh)
+
+
+def serve_stage_records(stage_quantiles: dict) -> list[dict]:
+    """Per-stage quantile lines for the serve path (queue/batch/prefill/
+    decode) — same pre-attributed discipline as every other stage family."""
+    return [{"metric": "serve_stage_ms", "stage": stage, **qs}
+            for stage, qs in (stage_quantiles or {}).items()]
+
+
+def bench_serve_latency(n_requests: int = 96, concurrency: int = 8,
+                        seed: int = 0, max_batch: int = 16,
+                        window_ms: float = 1.0) -> dict:
+    """Continuous-batching serve path vs the one-shot oracle (ISSUE 14).
+
+    A seeded mix of validator prompts is served twice on the SAME process
+    and checkpoint: serially through the legacy one-shot ``call_llm`` path
+    (the equivalence oracle), then through the ContinuousBatcher under
+    ``concurrency`` submitter threads. The record carries per-request e2e
+    quantiles, queue/batch/prefill/decode stage attribution, the batched-
+    vs-one-shot throughput ratio (= the MFU ratio on this path: identical
+    FLOPs/token, so tokens/s IS the MFU axis — docs/serving-perf.md), a
+    verdict-equivalence count (must be 0 mismatches), and a RetraceWitness
+    pin: after the pow2 bucket warmup, the measured phase must compile
+    NOTHING (retraces: 0)."""
+    import threading
+
+    import numpy as np
+
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+    from vainplex_openclaw_tpu.governance.validation.llm_validator import build_prompt
+    from vainplex_openclaw_tpu.models import encoder as encoder_mod
+    from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+    from vainplex_openclaw_tpu.models.pretrained import load_pretrained
+    from vainplex_openclaw_tpu.models.serve import (
+        _extract_message as _extract, make_local_call_llm)
+    from vainplex_openclaw_tpu.ops.similarity import pow2_bucket
+    from vainplex_openclaw_tpu.resilience.admission import AdmissionController
+
+    rng = np.random.default_rng(seed)
+    subjects = ("deploy", "quarterly report", "incident", "migration",
+                "customer email", "release", "audit", "benchmark")
+    verbs = ("completed", "failed", "regressed", "crashed", "improved",
+             "shipped", "stalled", "recovered")
+    prompts = [build_prompt(
+        f"The {rng.choice(subjects)} {rng.choice(verbs)} with code "
+        f"{int(rng.integers(0, 500))}; "
+        f"throughput changed {int(rng.integers(-60, 90))}% and "
+        f"{'secret token sk-' + str(int(rng.integers(1e6))) if rng.random() < 0.2 else 'no credentials involved'}.",
+        []) for _ in range(n_requests)]
+
+    oneshot = make_local_call_llm(serve_cfg={"continuousBatching": False},
+                                  force=True)
+    loaded = load_pretrained(None)
+    cfg = loaded[0]
+    flops_per_token = encoder_flops_per_token(cfg)
+
+    batcher = ContinuousBatcher(
+        max_batch=max_batch, window_ms=window_ms,
+        admission=AdmissionController.from_config(
+            {"highWatermark": max(64, n_requests)}))
+    try:
+        # Warm every pow2 batch bucket the run can form (plus batch 1 for
+        # the oracle) so the measured phase is compile-free by construction.
+        from vainplex_openclaw_tpu.models import encode_texts, forward
+        from vainplex_openclaw_tpu.ops.similarity import pad_rows
+
+        params = loaded[1]
+        b = 1
+        while b <= pow2_bucket(max_batch):
+            toks = pad_rows(encode_texts(["warmup"], cfg.seq_len,
+                                         cfg.vocab_size), b)
+            np.asarray(forward(params, toks, cfg)["severity"])
+            b *= 2
+        oneshot(prompts[0])
+
+        witness = RetraceWitness()
+        witness.probe("serve_forward", encoder_mod.forward)
+        base = witness.baseline()  # snapshot once, BEFORE the timed phase
+
+        t0 = time.perf_counter()
+        ref = [oneshot(p) for p in prompts]
+        oneshot_s = time.perf_counter() - t0
+
+        results: list = [None] * n_requests
+        latencies: list = [0.0] * n_requests
+        errors: list = [None] * n_requests
+        next_idx = {"i": 0}
+        idx_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = next_idx["i"]
+                    if i >= n_requests:
+                        return
+                    next_idx["i"] = i + 1
+                t = time.perf_counter()
+                try:
+                    results[i] = batcher.submit(_extract(prompts[i]))
+                except Exception as exc:  # noqa: BLE001 — surfaced below
+                    errors[i] = exc
+                latencies[i] = (time.perf_counter() - t) * 1e3
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(max(1, concurrency))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batched_s = time.perf_counter() - t0
+        failed = [(i, e) for i, e in enumerate(errors) if e is not None]
+        if failed:
+            # A submit failure is a bench FAILURE with the real exception,
+            # never a silent mismatch + deflated latency in the record.
+            i, exc = failed[0]
+            raise RuntimeError(
+                f"serve_latency: {len(failed)}/{n_requests} submits "
+                f"raised; first at request {i}") from exc
+        retraces = (witness.traces("serve_forward")
+                    - base.get("serve_forward", 0))
+        mismatches = sum(1 for a, b2 in zip(results, ref) if a != b2)
+
+        # Forward-only batch-amortization A/B (interleaved, same tokens):
+        # the MFU axis of the acceptance — e2e on the CPU tiny model is
+        # tokenizer/thread-bound, but the ENCODER cost per request is what
+        # the TPU dispatch floor amortizes (docs/serving-perf.md projection).
+        bucket = pow2_bucket(max_batch)
+        toksN = pad_rows(encode_texts([_extract(p) for p in
+                                       prompts[:max_batch]],
+                                      cfg.seq_len, cfg.vocab_size), bucket)
+        toks1 = toksN[:1]
+        reps1, repsN = 32, max(2, 32 // bucket)
+        fwd = {}
+        for name, toks, reps in (("b1", toks1, reps1),
+                                 ("batched", toksN, repsN)):
+            np.asarray(forward(params, toks, cfg)["severity"])  # warm
+            f0 = time.perf_counter()
+            for _ in range(reps):
+                np.asarray(forward(params, toks, cfg)["severity"])
+            dt = time.perf_counter() - f0
+            fwd[name] = reps * (1 if name == "b1" else max_batch) / dt
+    finally:
+        batcher.close()
+
+    lat = sorted(latencies)
+
+    def _q(q: float) -> float:
+        return round(lat[min(len(lat) - 1, int(q * (len(lat) - 1)))], 3)
+
+    platform, kind, _ = _device_peak()
+    tokens = n_requests * cfg.seq_len
+    stats = batcher.stats()
+    rec = {"metric": "serve_latency", "value": _q(0.5), "unit": "ms",
+           "p50": _q(0.5), "p95": _q(0.95), "p99": _q(0.99),
+           "n_requests": n_requests, "concurrency": concurrency,
+           "seed": seed, "max_batch": max_batch, "window_ms": window_ms,
+           "throughput_rps": round(n_requests / batched_s, 1),
+           "oneshot_rps": round(n_requests / oneshot_s, 1),
+           "speedup_vs_oneshot": round(oneshot_s / batched_s, 2),
+           "tokens_per_s": round(tokens / batched_s, 0),
+           "oneshot_tokens_per_s": round(tokens / oneshot_s, 0),
+           "achieved_tflops": round(tokens / batched_s * flops_per_token / 1e12, 4),
+           "batches": stats["batches"], "mean_batch": stats["meanBatch"],
+           "forward_rps_b1": round(fwd["b1"], 1),
+           "forward_rps_batched": round(fwd["batched"], 1),
+           "forward_batch_amortization": round(fwd["batched"] / fwd["b1"], 2),
+           "verdict_mismatches": mismatches,
+           "retraces": int(retraces),
+           "admission": stats.get("admission"),
+           "serve_stage_quantiles": batcher.timer.quantiles(),
+           "device": platform, "device_kind": kind}
+    return rec
+
+
+def _serve_cli(argv: list) -> dict:
+    """``python bench.py serve_latency [--requests N] [--concurrency N]
+    [--seed N] [--max-batch N] [--window-ms X]``"""
+    kwargs: dict = {}
+    flags = {"--requests": ("n_requests", int),
+             "--concurrency": ("concurrency", int), "--seed": ("seed", int),
+             "--max-batch": ("max_batch", int),
+             "--window-ms": ("window_ms", float)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"serve_latency: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    return bench_serve_latency(**kwargs)
+
+
+def bench_kernel_search(seq_lens: tuple = (128,), blocks: "tuple | None" = None,
+                        steps: int = 3, rounds: int = 3, seed: int = 0,
+                        state_path: "str | None" = None,
+                        write_table_path: "str | None" = None,
+                        budget_s_per_len: "float | None" = None) -> dict:
+    """Measurement-driven flash block search (ISSUE 14): sweeps
+    (block_q, block_k) per (family, dtype, seq bucket) with the bench
+    anti-elision harness as the fitness signal, gated on "faster than the
+    incumbent default AND zero retraces" (ops/kernel_search.py). Seeded,
+    resumable via ``state_path``, and only a table that passes
+    ``validate_table`` may be written — the regression-gate discipline."""
+    from vainplex_openclaw_tpu.ops import kernel_search as ks
+    from vainplex_openclaw_tpu.ops.flash_attention import (
+        TABLE_PATH, clear_table_cache, load_block_table)
+
+    t0 = time.perf_counter()
+    kwargs = {"steps": steps, "rounds": rounds, "seed": seed,
+              "state_path": state_path, "budget_s_per_len": budget_s_per_len}
+    if blocks:
+        kwargs["blocks"] = tuple(blocks)
+    results = ks.search(tuple(seq_lens), **kwargs)
+    platform, kind, peak = _device_peak()
+    B, H, Dh = 4, 8, 64
+    buckets = {}
+    measured = retraces = 0
+    for key, res in results.items():
+        for c in res["candidates"]:
+            if c.get("ms") is not None:
+                measured += 1
+                retraces += int(c.get("retraces") or 0)
+        best, base = res.get("best"), res.get("baseline")
+        if not best or best.get("ms") is None:
+            buckets[key] = {"error": (base or {}).get("error", "no measurement")}
+            continue
+        flops = attention_flops(B, H, res["seq_len"], Dh)
+        buckets[key] = {
+            "block_q": best["block_q"], "block_k": best["block_k"],
+            "ms": best["ms"], "baseline_ms": (base or {}).get("ms"),
+            "speedup_vs_default": round((base["ms"] / best["ms"]), 3)
+            if base and base.get("ms") and best.get("ms") else None,
+            "improved": res["improved"],
+            "mfu": round(flops / (best["ms"] / 1e3) / peak, 4) if peak else None,
+        }
+    table = ks.to_table(results, base_table=load_block_table(TABLE_PATH))
+    findings = ks.validate_table(table)
+    written = None
+    if write_table_path and not findings:
+        written = ks.write_table(table, write_table_path)
+        clear_table_cache()
+    rec = {"metric": "kernel_search", "value": measured, "unit": "points",
+           "seed": seed, "steps": steps, "rounds": rounds,
+           "seq_lens": list(seq_lens), "buckets": buckets,
+           "improved_buckets": sum(1 for b in buckets.values()
+                                   if b.get("improved")),
+           "retraces": retraces,
+           "partial": any(r.get("partial") for r in results.values()),
+           "table_findings": findings, "table_written": written,
+           "resumable_state": state_path,
+           "elapsed_s": round(time.perf_counter() - t0, 1),
+           "device": platform, "device_kind": kind}
+    return rec
+
+
+def _kernel_search_cli(argv: list) -> dict:
+    """``python bench.py kernel_search [--seq-lens 128,2048] [--blocks
+    128,256,512] [--steps N] [--rounds N] [--seed N] [--state PATH]
+    [--write-table PATH] [--budget-s X]``"""
+    kwargs: dict = {}
+
+    def csv_ints(s):
+        return tuple(int(x) for x in s.split(",") if x)
+    flags = {"--seq-lens": ("seq_lens", csv_ints),
+             "--blocks": ("blocks", csv_ints), "--steps": ("steps", int),
+             "--rounds": ("rounds", int), "--seed": ("seed", int),
+             "--state": ("state_path", str),
+             "--write-table": ("write_table_path", str),
+             "--budget-s": ("budget_s_per_len", float)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"kernel_search: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    return bench_kernel_search(**kwargs)
 
 
 def _run_child(code: str, timeout: float):
@@ -1965,39 +2277,74 @@ def _accelerator_benches() -> list[str]:
     lines.append(out if err is None else json.dumps(
         {"metric": "encoder_throughput", "skipped": True, "reason": err}))
 
-    mfu_code = ("import json, bench; "
-                "print(json.dumps(bench.bench_encoder_mfu()))")
-    # The live child runs the level-0 shape, so it gets that shape's OWN
-    # compile budget — a hardcoded 420 s here had already drifted below
-    # MFU_SHAPES[0]'s 480 s (ADVICE r5: the call site must not be able to
-    # diverge from the ladder).
-    out, err, _ = _run_child(mfu_code, timeout=MFU_SHAPES[0]["budget_s"])
-    rec = None
-    if err is None:
-        try:
-            rec = json.loads(out)
-        except (TypeError, ValueError):
-            err = f"unparseable mfu record: {str(out)[:120]}"
-    if rec is not None and not rec.get("skipped") and rec.get("value") is not None:
-        lines.append(out)
-    else:
-        # The level-0 compile rarely fits a live window — fall back to the
-        # freshest ladder capture from the round's opportunistic log, with
-        # the live failure preserved on the replayed line. A child that
-        # exits 0 with a SKIPPED record (e.g. wrong backend) takes the same
-        # fallback, its skip reason riding along as live_error — appending
-        # it as-is was masking valid captures (ADVICE r5).
+    # ISSUE 14: walk the MFU bisect ladder LIVE instead of all-or-nothing
+    # on level 0 — each level's child gets that shape's OWN budget (the
+    # call site still cannot diverge from the ladder, ADVICE r5), and a
+    # level-0 timeout now degrades to a level-1/2 measurement before the
+    # replay fallback. A smaller-shape live MFU beats a day-old level-0
+    # capture at answering "did THIS change regress utilization".
+    rec, ladder_errors = None, []
+    for level, shape in enumerate(MFU_SHAPES):
+        mfu_code = ("import json, bench; "
+                    f"print(json.dumps(bench.bench_encoder_mfu(level={level})))")
+        out, err, _ = _run_child(mfu_code, timeout=shape["budget_s"])
         if err is None:
-            err = str(rec.get("reason") or "live mfu child returned no value")
-        mfu = _freshest_mfu_line(None, None, live_error=err)
+            try:
+                rec = json.loads(out)
+            except (TypeError, ValueError):
+                err, rec = f"unparseable mfu record: {str(out)[:120]}", None
+        if rec is not None and not rec.get("skipped") \
+                and rec.get("value") is not None:
+            if ladder_errors:
+                rec["ladder_errors"] = ladder_errors  # how far it bisected
+            lines.append(json.dumps(rec))
+            break
+        if rec is not None and rec.get("skipped"):
+            # Deterministic skip (wrong backend): every level repeats it —
+            # record once and stop walking.
+            ladder_errors.append(f"level{level}: {rec.get('reason')}")
+            rec = None
+            break
+        ladder_errors.append(f"level{level}: {err or 'no value'}")
+        rec = None
+    if rec is None:
+        # No level fit a live window — fall back to the freshest ladder
+        # capture from the round's opportunistic log, with the live
+        # failures preserved on the replayed line. A skipped child's reason
+        # rides along the same way — appending it as-is was masking valid
+        # captures (ADVICE r5).
+        live_error = "; ".join(ladder_errors) or "live mfu returned no value"
+        mfu = _freshest_mfu_line(None, None, live_error=live_error)
         lines.append(mfu if mfu is not None else json.dumps(
-            {"metric": "encoder_mfu_large", "skipped": True, "reason": err}))
+            {"metric": "encoder_mfu_large", "skipped": True,
+             "reason": live_error}))
 
-    fvd_code = ("import json, bench; "
-                "print(json.dumps(bench.bench_flash_vs_dense()))")
-    out, err, _ = _run_child(fvd_code, timeout=300)
-    lines.append(out if err is None else json.dumps(
-        {"metric": "flash_vs_dense", "skipped": True, "reason": err}))
+    # ISSUE 14: one child per length, each with its own budget
+    # (flash_len_budget) — the r05 single 300 s child timed out at 16k and
+    # threw away the 128/2048 points that HAD finished. A timed-out length
+    # now yields ITS per-length skip record while every finished length
+    # keeps its measurement; in-child budget_s_per_len additionally keeps
+    # partial rounds when sampling (not compile) is what overruns. The
+    # child timeout gets headroom so the in-process budget fires first.
+    fvd_records = []
+    for L in (128, 2048, 16384):
+        budget = flash_len_budget(L)
+        fvd_code = ("import json, bench; "
+                    "print(json.dumps(bench.bench_flash_vs_dense("
+                    f"seq_lens=({L},), budget_s_per_len={budget})))")
+        out, err, _ = _run_child(fvd_code, timeout=budget + 45)
+        if err is None:
+            try:
+                fvd_records.extend(json.loads(out))
+                continue
+            except (TypeError, ValueError):
+                err = f"unparseable record: {str(out)[:120]}"
+        fvd_records.append({"metric": "flash_vs_dense", "seq_len": L,
+                            "skipped": True, "partial": True,
+                            "budget_s": budget, "reason": err})
+    # Each child validated only its own length — re-validate the MERGED
+    # list so the cross-length monotonicity physics check still fires.
+    lines.append(json.dumps(validate_flash_sweep(fvd_records, peak=None)))
     return lines
 
 
@@ -2043,6 +2390,21 @@ if __name__ == "__main__":
             print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         print(json.dumps(rec, ensure_ascii=False))
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "serve_latency":
+        # Subcommand mode (ISSUE 14): ONE stdout line = the serve record;
+        # per-stage quantile lines ride on stderr like every secondary.
+        rec = _serve_cli(sys.argv[2:])
+        for srec in serve_stage_records(rec.get("serve_stage_quantiles")):
+            print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+        print(json.dumps(rec, ensure_ascii=False))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "kernel_search":
+        # Subcommand mode (ISSUE 14): the offline search loop. ONE stdout
+        # line = the search record (buckets, winners, retraces, table
+        # findings); --state makes it resumable, --write-table commits a
+        # validated table for default_block to consult.
+        print(json.dumps(_kernel_search_cli(sys.argv[2:]), ensure_ascii=False))
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "slo_report":
         # Subcommand mode (ISSUE 6): ONE stdout line = the SLO report;
         # per-stage quantile lines ride on stderr like every secondary.
@@ -2055,11 +2417,15 @@ if __name__ == "__main__":
                bench_policy_eval_deny, bench_policy_eval_degraded,
                bench_policy_eval_journal_ab,
                bench_knowledge_ingest, bench_knowledge_search,
-               bench_cortex_ingest):
+               bench_cortex_ingest, bench_serve_latency):
         try:
             rec = fn()
             print(f"secondary: {json.dumps(rec)}", file=sys.stderr)
-            if rec.get("metric", "").startswith("knowledge_"):
+            if rec.get("metric") == "serve_latency":
+                for srec in serve_stage_records(
+                        rec.get("serve_stage_quantiles")):
+                    print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+            elif rec.get("metric", "").startswith("knowledge_"):
                 for srec in knowledge_stage_records(rec.get("stage_ms")):
                     print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
             elif rec.get("metric") == "cortex_message_throughput":

@@ -148,8 +148,11 @@ class TestFlashAttention:
 
 
 class TestDefaultBlock:
-    """Tuned block picker (FLASH_SWEEP_r04.json): 512-cap up to L=4096,
-    1024-cap beyond, always an MXU-aligned divisor of L."""
+    """Tuned block picker: searched table entries win per (family, dtype,
+    seq bucket); heuristic fallback (FLASH_SWEEP_r04.json) keeps the
+    512-cap up to L=4096 and 1024-cap beyond, an MXU-aligned divisor of L
+    when one exists, else the pow2 roundup the kernel pads to (ISSUE 14:
+    no more dense bail on ragged lengths)."""
 
     @pytest.mark.parametrize("L,expected", [
         (64, 64), (128, 128), (512, 512), (2048, 512), (4096, 512),
@@ -159,11 +162,52 @@ class TestDefaultBlock:
 
         assert default_block(L) == expected
 
-    @pytest.mark.parametrize("L", [131, 100, 7])
-    def test_no_aligned_divisor_returns_none(self, L):
+    @pytest.mark.parametrize("L,expected", [(131, 256), (100, 128), (7, 8)])
+    def test_no_aligned_divisor_pads_to_pow2(self, L, expected):
+        # The retired pre-ISSUE-14 contract returned None here and callers
+        # fell back to dense; now every length gets an aligned block the
+        # kernel pads up to (and the capped pow2 keeps blocks MXU-aligned).
         from vainplex_openclaw_tpu.ops.flash_attention import default_block
 
-        assert default_block(L) is None
+        b = default_block(L)
+        assert b == expected and b % 8 == 0
+
+    def test_table_entry_consulted_for_matching_family(self, tmp_path):
+        # An entry for this backend family redirects default_block; other
+        # families' entries never leak across (the committed table ships
+        # tpu rows — a CPU test run must keep the heuristic).
+        import json as _json
+
+        from vainplex_openclaw_tpu.ops import flash_attention as fa
+
+        table = {"schema": "flash-block-table-v1", "entries": {
+            f"{fa.backend_family()}:bfloat16:2048":
+                {"block_q": 256, "block_k": 128},
+            "othergen:bfloat16:1024": {"block_q": 64, "block_k": 64},
+        }}
+        p = tmp_path / "t.json"
+        p.write_text(_json.dumps(table))
+        fa.clear_table_cache()
+        try:
+            import os as _os
+            _os.environ[fa.TABLE_ENV] = str(p)
+            assert fa.default_block(2048, "bfloat16", side="q") == 256
+            assert fa.default_block(2048, "bfloat16", side="k") == 128
+            # bucket miss → heuristic unchanged
+            assert fa.default_block(1024, "bfloat16") == 512
+        finally:
+            _os.environ.pop(fa.TABLE_ENV, None)
+            fa.clear_table_cache()
+
+    def test_committed_table_parses_and_is_aligned(self):
+        from vainplex_openclaw_tpu.ops import flash_attention as fa
+
+        table = fa.load_block_table(fa.TABLE_PATH)
+        assert table.get("entries"), "committed flash_block_table.json unreadable"
+        for key, ent in table["entries"].items():
+            fam, dtype, bucket = key.split(":")
+            assert int(bucket) == fa._pow2_bucket(int(bucket)), key
+            assert ent["block_q"] % 8 == 0 and ent["block_k"] % 8 == 0, key
 
     def test_default_blocks_used_when_unspecified(self, qkv):
         # Auto blocks (64 at the fixture's L=64) ≡ explicitly pinned blocks.

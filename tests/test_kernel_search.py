@@ -1,0 +1,278 @@
+"""Kernel-search loop + flash block table regression gates (ISSUE 14).
+
+ops/kernel_search.py sweeps (block_q, block_k) per (backend family,
+dtype, pow2 seq bucket); winners land in ops/flash_block_table.json and
+``default_block`` consults that table before its measured heuristic.
+These tests pin: candidate enumeration, the "faster AND zero retraces"
+winner gate, seeded resumability (a killed sweep resumes from its last
+finished point), per-length budgets with partial records, table merge /
+write / load round-trips, the ``validate_table`` regression gate CI runs
+against the committed file, and one real measured point end to end on
+the CPU interpreter path.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from vainplex_openclaw_tpu.ops import kernel_search as ks
+from vainplex_openclaw_tpu.ops import flash_attention as fa
+
+
+def fake_point(ms_by_pair, retraces_by_pair=None, calls=None):
+    """A deterministic measure_point stand-in: (bq, bk) → fixed ms."""
+    def _measure(L, bq, bk, *, dtype="bfloat16", steps=4, rounds=3,
+                 seed=0, clock=None):
+        if calls is not None:
+            calls.append((L, bq, bk))
+        rec = {"seq_len": L, "block_q": bq, "block_k": bk, "dtype": dtype,
+               "steps": steps, "rounds": rounds, "seed": seed}
+        ms = ms_by_pair.get((bq, bk))
+        if ms is None:
+            rec["error"] = "Mosaic rejected the block"
+            return rec
+        rec.update({"ms": ms, "spread": 0.01,
+                    "retraces": (retraces_by_pair or {}).get((bq, bk), 0)})
+        return rec
+    return _measure
+
+
+class TestCandidateEnumeration:
+    def test_incumbent_first_then_clamped_pairs(self):
+        pairs = ks.candidate_pairs(64, blocks=(8, 16, 128))
+        incumbent = (fa.default_block(64, side="q"),
+                     fa.default_block(64, side="k"))
+        assert pairs[0] == incumbent
+        assert len(pairs) == len(set(pairs))  # no duplicates
+        for bq, bk in pairs:
+            assert bq <= 64 and bk <= 64  # clamped to the padded roundup
+            assert bq % 8 == 0 and bk % 8 == 0
+
+    def test_ragged_length_clamps_to_padded_roundup(self):
+        pairs = ks.candidate_pairs(100, blocks=(128, 256))
+        lim = max(b for pair in pairs for b in pair)
+        assert lim == 104  # ceil8(100): a block past one padded L is waste
+
+    def test_bucket_key_is_family_dtype_pow2(self):
+        key = ks.bucket_key(1500, "bfloat16", family="tpu")
+        assert key == "tpu:bfloat16:2048"
+
+
+class TestSearchLoop:
+    def test_winner_must_beat_incumbent(self, monkeypatch):
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (16, 16): 4.0, (16, 32): 6.0, (32, 16): 6.5,
+             (32, 32): 5.0}))
+        res = ks.search((64,), blocks=(16, 32))
+        (key, r), = res.items()
+        assert r["baseline"]["ms"] == 10.0
+        assert (r["best"]["block_q"], r["best"]["block_k"]) == (16, 16)
+        assert r["improved"] is True
+
+    def test_retracing_candidate_never_wins(self, monkeypatch):
+        """The gate: faster AND zero retraces. The fastest pair retraces —
+        the next-fastest clean one wins instead."""
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (16, 16): 3.0, (32, 32): 5.0},
+            retraces_by_pair={(16, 16): 2}))
+        res = ks.search((64,), blocks=(16, 32))
+        (_, r), = res.items()
+        assert (r["best"]["block_q"], r["best"]["block_k"]) == (32, 32)
+
+    def test_error_candidate_is_data_not_fatal(self, monkeypatch):
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (32, 32): 8.0}))  # (16,*) pairs → error recs
+        res = ks.search((64,), blocks=(16, 32))
+        (_, r), = res.items()
+        errors = [c for c in r["candidates"] if c.get("error")]
+        assert errors, "failed candidates must come back as records"
+        assert (r["best"]["block_q"], r["best"]["block_k"]) == (32, 32)
+
+    def test_tie_keeps_incumbent(self, monkeypatch):
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 5.0, (16, 16): 5.0, (32, 32): 5.0}))
+        res = ks.search((64,), blocks=(16, 32))
+        (_, r), = res.items()
+        assert r["improved"] is False
+        assert (r["best"]["block_q"], r["best"]["block_k"]) == (64, 64)
+
+    def test_resume_skips_measured_points(self, tmp_path, monkeypatch):
+        state = tmp_path / "sweep.json"
+        calls: list = []
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (16, 16): 4.0, (16, 32): 6.0, (32, 16): 6.5,
+             (32, 32): 5.0}, calls=calls))
+        first = ks.search((64,), blocks=(16, 32), state_path=str(state))
+        n_first = len(calls)
+        assert n_first > 0 and state.exists()
+        second = ks.search((64,), blocks=(16, 32), state_path=str(state))
+        assert len(calls) == n_first  # nothing re-measured
+        (_, r2), = second.items()
+        assert all(c.get("resumed") for c in r2["candidates"])
+        (_, r1), = first.items()
+        assert (r2["best"]["block_q"], r2["best"]["block_k"]) == \
+            (r1["best"]["block_q"], r1["best"]["block_k"])
+
+    def test_resume_remeasures_error_records(self, tmp_path, monkeypatch):
+        """A persisted error is NOT a finished point: the r04 failure mode
+        is a transient tunnel 500, and resuming it verbatim would
+        permanently ban that candidate from winning its bucket."""
+        state = tmp_path / "sweep.json"
+        calls: list = []
+        # (16, 32)/(32, 16) missing from the table → error records
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (16, 16): 4.0, (32, 32): 5.0}, calls=calls))
+        ks.search((64,), blocks=(16, 32), state_path=str(state))
+        n_first = len(calls)
+        # the "tunnel recovered": every pair now measures
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (16, 16): 4.0, (16, 32): 3.0, (32, 16): 6.5,
+             (32, 32): 5.0}, calls=calls))
+        second = ks.search((64,), blocks=(16, 32), state_path=str(state))
+        assert len(calls) == n_first + 2  # exactly the two error points
+        (_, r2), = second.items()
+        assert not any(c.get("error") for c in r2["candidates"])
+        # the formerly-failed candidate can now win its bucket
+        assert (r2["best"]["block_q"], r2["best"]["block_k"]) == (16, 32)
+
+    def test_resume_state_survives_mid_sweep_kill(self, tmp_path,
+                                                  monkeypatch):
+        """A sweep killed after point k resumes with exactly the remaining
+        points — the FLASH_SWEEP_r04 failure mode (restart from zero)."""
+        state = tmp_path / "sweep.json"
+        calls: list = []
+        good = fake_point({(64, 64): 10.0, (16, 16): 4.0, (32, 32): 5.0},
+                          calls=calls)
+
+        def dies_after_two(L, bq, bk, **kw):
+            if len(calls) >= 2:
+                raise KeyboardInterrupt("wedged tunnel")
+            return good(L, bq, bk, **kw)
+
+        monkeypatch.setattr(ks, "measure_point", dies_after_two)
+        with pytest.raises(KeyboardInterrupt):
+            ks.search((64,), blocks=(16, 32), state_path=str(state))
+        assert len(json.loads(state.read_text())) == 2  # both persisted
+        monkeypatch.setattr(ks, "measure_point", good)
+        calls.clear()
+        res = ks.search((64,), blocks=(16, 32), state_path=str(state))
+        (_, r), = res.items()
+        resumed = [c for c in r["candidates"] if c.get("resumed")]
+        assert len(resumed) == 2 and len(calls) == len(r["candidates"]) - 2
+
+    def test_budget_records_partial_and_next_length_runs(self, monkeypatch):
+        monkeypatch.setattr(ks, "measure_point", fake_point(
+            {(64, 64): 10.0, (16, 16): 4.0, (32, 32): 5.0,
+             (128, 128): 20.0, (16, 32): 6.0, (32, 16): 6.0}))
+        t = {"now": 0.0}
+
+        def clock():
+            t["now"] += 10.0  # every candidate "costs" 10 s
+            return t["now"]
+
+        res = ks.search((64, 128), blocks=(16, 32), budget_s_per_len=15.0,
+                        clock=clock)
+        r64 = res[ks.bucket_key(64)]
+        assert r64["partial"] is True and r64["skipped_candidates"] > 0
+        assert r64["baseline"] is not None  # the incumbent point survived
+        r128 = res[ks.bucket_key(128)]
+        assert r128["candidates"], "budget on one length must not kill the next"
+
+
+class TestTableEmissionAndGate:
+    def results(self):
+        return {"cpu:bfloat16:64": {
+            "seq_len": 64, "dtype": "bfloat16", "family": "cpu",
+            "baseline": {"block_q": 64, "block_k": 64, "ms": 10.0,
+                         "retraces": 0, "seed": 0, "steps": 4, "rounds": 3},
+            "best": {"block_q": 16, "block_k": 16, "ms": 4.0, "retraces": 0,
+                     "seed": 0, "steps": 4, "rounds": 3},
+            "candidates": [], "improved": True,
+            "skipped_candidates": 0, "partial": False}}
+
+    def test_merge_preserves_other_families(self):
+        base = {"schema": "flash-block-table-v1",
+                "entries": {"tpu:bfloat16:8192":
+                            {"block_q": 1024, "block_k": 1024, "ms": 14.8}}}
+        table = ks.to_table(self.results(), base_table=base)
+        assert "tpu:bfloat16:8192" in table["entries"]  # CPU sweep kept it
+        assert table["entries"]["cpu:bfloat16:64"]["block_q"] == 16
+        assert ks.validate_table(table) == []
+
+    def test_write_load_roundtrip_drives_default_block(self, tmp_path,
+                                                       monkeypatch):
+        table = ks.to_table(self.results())
+        path = tmp_path / "table.json"
+        ks.write_table(table, str(path))
+        fa.clear_table_cache()
+        monkeypatch.setenv(fa.TABLE_ENV, str(path))
+        try:
+            fam = fa.backend_family()
+            if fam == "cpu":  # the table row targets the cpu family
+                assert fa.default_block(64, "bfloat16", side="q") == 16
+            loaded = fa.load_block_table(str(path))
+            assert loaded["entries"] == table["entries"]
+        finally:
+            fa.clear_table_cache()
+
+    @pytest.mark.parametrize("mutate,finding", [
+        (lambda t: t.update(schema="v0"), "unknown schema"),
+        (lambda t: t["entries"].clear(), "no entries"),
+        (lambda t: t["entries"].update({"bad-key": {"block_q": 8,
+                                                    "block_k": 8}}),
+         "not family:dtype:bucket"),
+        (lambda t: t["entries"].update({"cpu:bf16:100": {"block_q": 8,
+                                                         "block_k": 8}}),
+         "not a pow2"),
+        (lambda t: t["entries"]["cpu:bfloat16:64"].update(block_q=13),
+         "not an aligned block"),
+        (lambda t: t["entries"]["cpu:bfloat16:64"].update(block_q=512),
+         "exceeds its padded bucket"),
+        (lambda t: t["entries"]["cpu:bfloat16:64"].update(ms=-1.0),
+         "not a positive number"),
+    ])
+    def test_validate_table_catches(self, mutate, finding):
+        table = ks.to_table(self.results())
+        mutate(table)
+        assert any(finding in f for f in ks.validate_table(table)), finding
+
+    def test_committed_table_passes_the_gate(self):
+        """The regression gate CI runs: the checked-in table must always
+        validate clean — a corrupt entry would silently re-route every
+        flash call on the matching family."""
+        table = fa.load_block_table(fa.TABLE_PATH)
+        assert table.get("entries"), "committed table unreadable"
+        assert ks.validate_table(table) == []
+        # and the committed rows are TPU rows: a CPU test run must not be
+        # steered by them (family isolation)
+        assert all(k.startswith("tpu:") for k in table["entries"])
+
+    def test_bench_refuses_to_write_invalid_table(self, tmp_path,
+                                                  monkeypatch):
+        import bench
+
+        monkeypatch.setattr(ks, "search", lambda *a, **k: {
+            "cpu:bfloat16:64": {
+                "seq_len": 64, "dtype": "bfloat16", "family": "cpu",
+                "baseline": None,
+                "best": {"block_q": 13, "block_k": 16, "ms": 1.0},
+                "candidates": [], "improved": True,
+                "skipped_candidates": 0, "partial": False}})
+        out = tmp_path / "t.json"
+        rec = bench.bench_kernel_search(seq_lens=(64,),
+                                        write_table_path=str(out))
+        assert rec["table_findings"], "misaligned block must be a finding"
+        assert rec["table_written"] is None and not out.exists()
+
+
+class TestMeasuredPointEndToEnd:
+    def test_one_real_point_on_cpu_interpreter(self):
+        """One real measured point through the actual flash kernel
+        (interpret mode on CPU): ms lands, zero retraces in the timed
+        rounds, and the record carries its identity fields."""
+        rec = ks.measure_point(16, 16, 16, steps=1, rounds=1, seed=0)
+        assert "error" not in rec, rec.get("error")
+        assert rec["ms"] > 0 and rec["retraces"] == 0
+        assert (rec["seq_len"], rec["block_q"], rec["block_k"]) == (16, 16, 16)

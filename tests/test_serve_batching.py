@@ -1,0 +1,430 @@
+"""Continuous-batching serve path ≡ the one-shot oracle (ISSUE 14).
+
+The governance stage-3 seam now serves concurrent validations through
+models/batching.ContinuousBatcher by default; the legacy one-shot path
+stays behind ``serve.continuousBatching: false`` as the equivalence
+oracle. These tests pin the two paths verdict-BIT-IDENTICAL over seeded
+concurrent request mixes (same checkpoint, same process), the severity-
+class → verdict contract both share through render_verdict, the
+local_triage batched severity/keep path's batch-size independence, the
+admission-shed failure mode, per-request stage attribution, and the
+escape hatch end-to-end through the governance plugin config.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from helpers import make_gateway
+
+
+def serve_all(batcher, texts, poll_s: float = 0.02):
+    """Submit every text from its own thread and drive the batcher from
+    the test thread (``autostart=False`` + step — the deterministic twin
+    of the collector loop). Returns results in submission order."""
+    results: list = [None] * len(texts)
+    errors: list = [None] * len(texts)
+
+    def worker(i):
+        try:
+            results[i] = batcher.submit(texts[i], timeout_s=240.0)
+        except BaseException as exc:  # noqa: BLE001 — surfaced per-index
+            errors[i] = exc
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(texts))]
+    for t in threads:
+        t.start()
+    served = 0
+    deadline = time.monotonic() + 240.0
+    while served < len(texts) and time.monotonic() < deadline:
+        served += batcher.step(wait_s=poll_s)
+    for t in threads:
+        t.join(5.0)
+    for exc in errors:
+        if exc is not None:
+            raise exc
+    return results
+
+
+def seeded_texts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    subjects = ("deploy", "incident", "migration", "quarterly report",
+                "release", "benchmark", "audit", "customer email")
+    verbs = ("completed", "failed", "regressed", "crashed", "improved",
+             "shipped", "stalled", "recovered")
+    return [
+        f"The {rng.choice(subjects)} {rng.choice(verbs)} with code "
+        f"{int(rng.integers(0, 500))}; throughput changed "
+        f"{int(rng.integers(-60, 90))}%."
+        for _ in range(n)
+    ]
+
+
+def make_batcher(**kw):
+    from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+
+    kw.setdefault("autostart", False)
+    return ContinuousBatcher(**kw)
+
+
+class TestBatchingEquivalence:
+    """Batched verdicts must be bit-identical to the one-shot oracle."""
+
+    def oneshot(self):
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        call = make_local_call_llm(
+            force=True, serve_cfg={"continuousBatching": False})
+        assert getattr(call, "batcher", None) is None
+        return call
+
+    @pytest.mark.parametrize("seed,n", [(0, 7), (1, 16), (2, 33)])
+    def test_seeded_concurrent_mix_bit_identical(self, seed, n):
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt)
+        from vainplex_openclaw_tpu.models.serve import _extract_message
+
+        texts = seeded_texts(n, seed)
+        prompts = [build_prompt(t, []) for t in texts]
+        oracle = [self.oneshot()(p) for p in prompts]
+        batcher = make_batcher(max_batch=8, window_ms=0.0)
+        try:
+            got = serve_all(batcher, [_extract_message(p) for p in prompts])
+        finally:
+            batcher.close()
+        assert got == oracle  # bit-identical JSON strings, no tolerance
+        assert batcher.served == n
+        # n=33 under max_batch=8 proves multi-batch formation, not one lump
+        assert batcher.batches >= -(-n // 8)
+
+    def test_varied_batch_sizes_equal_oracle(self):
+        """Every drain size (1, partial, full) renders the same verdict a
+        solo call does — padding rows never leak into real rows."""
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt)
+        from vainplex_openclaw_tpu.models.serve import _extract_message
+
+        texts = seeded_texts(13, seed=3)
+        prompts = [build_prompt(t, []) for t in texts]
+        oracle = [self.oneshot()(p) for p in prompts]
+        for group in ((1,), (3, 5), (13,)):
+            batcher = make_batcher(max_batch=max(group), window_ms=0.0)
+            try:
+                got = []
+                start = 0
+                for size in group:
+                    chunk = prompts[start:start + size]
+                    got.extend(serve_all(
+                        batcher, [_extract_message(p) for p in chunk]))
+                    start += size
+                assert got == oracle[:start]
+            finally:
+                batcher.close()
+
+    def test_collector_thread_path_matches_oracle(self):
+        """The real autostart collector (threaded, windowed) must agree
+        with both the step-driven batcher and the oracle."""
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import (
+            build_prompt)
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+        from vainplex_openclaw_tpu.models.serve import _extract_message
+
+        texts = seeded_texts(12, seed=4)
+        prompts = [build_prompt(t, []) for t in texts]
+        oracle = [self.oneshot()(p) for p in prompts]
+        call = make_local_call_llm(force=True,
+                                   serve_cfg={"maxBatch": 4, "windowMs": 1.0})
+        batcher = call.batcher
+        try:
+            assert batcher is not None
+            got: list = [None] * len(prompts)
+
+            def worker(i):
+                got[i] = call(prompts[i])
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(240.0)
+            assert got == oracle
+            # _extract_message ran inside call(): the batcher saw bodies
+            assert _extract_message(prompts[0]) in texts[0]
+        finally:
+            from vainplex_openclaw_tpu.models.serve import close_batchers
+
+            close_batchers()
+
+    def test_zero_retraces_across_batch_size_mix(self):
+        """pow2 bucketing: once the buckets a traffic mix can form are
+        warm, serving mixed batch sizes compiles NOTHING new."""
+        from vainplex_openclaw_tpu.analysis import RetraceWitness
+        from vainplex_openclaw_tpu.models import encoder as encoder_mod
+
+        texts = seeded_texts(24, seed=5)
+        batcher = make_batcher(max_batch=8, window_ms=0.0)
+        try:
+            serve_all(batcher, texts[:8])   # warm bucket 8
+            serve_all(batcher, texts[:1])   # warm bucket 1
+            serve_all(batcher, texts[:2])   # warm bucket 2
+            serve_all(batcher, texts[:4])   # warm bucket 4
+            witness = RetraceWitness()
+            witness.probe("serve_forward", encoder_mod.forward)
+            base = witness.baseline()
+            for size in (3, 5, 7, 2, 8, 6, 1):  # every size → a warm bucket
+                serve_all(batcher, texts[:size])
+            assert witness.traces("serve_forward") == \
+                base.get("serve_forward", 0)
+        finally:
+            batcher.close()
+
+
+class TestSeverityClassContract:
+    """render_verdict is the ONE severity→verdict renderer both paths
+    share — the two can only disagree through the model, never the JSON."""
+
+    @pytest.mark.parametrize("severity,verdict", [
+        (0, "pass"), (1, "pass"), (2, "flag"), (3, "block"),
+        (7, "block"),  # out-of-range clamps to the last class
+    ])
+    def test_severity_class_mapping(self, severity, verdict):
+        from vainplex_openclaw_tpu.models.batching import render_verdict
+
+        rec = json.loads(render_verdict(severity))
+        assert rec["verdict"] == verdict
+        assert f"severity class {severity}" in rec["reason"]
+        if verdict == "pass":
+            assert rec["issues"] == []
+        else:
+            assert rec["issues"][0]["category"] == "unverifiable_claim"
+
+    def test_serve_module_reuses_renderer(self):
+        from vainplex_openclaw_tpu.models import batching, serve
+
+        assert serve._SEVERITY_TO_VERDICT is batching.SEVERITY_TO_VERDICT
+
+    def test_local_triage_batched_path_batch_size_independent(self):
+        """The local_triage severity/keep path batches findings through
+        the same bucketed forward: a finding's decision must not depend
+        on which batch it rode in (the row-independence the batcher's
+        padding relies on)."""
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.classifier import (
+            local_triage)
+        from vainplex_openclaw_tpu.cortex.trace_analyzer.signals import (
+            FailureSignal)
+
+        findings = [
+            FailureSignal(signal=f"sig_{i}", summary=s, severity=sev,
+                          chain_id=f"c{i}", agent="main", session="s1",
+                          ts=float(i), evidence=[f"line {i}"])
+            for i, (s, sev) in enumerate([
+                ("tool loop detected across 14 calls", "high"),
+                ("benign info notice", "info"),
+                ("permission denied writing audit log", "medium"),
+                ("slow response but completed", "low"),
+                ("credential pasted into prompt", "critical"),
+            ])
+        ]
+        batched = local_triage(findings)
+        singles = [local_triage([f])[0] for f in findings]
+        assert batched == singles
+        # rule floor: rule-severe findings are kept regardless of model
+        assert batched[0] and batched[2] and batched[4]
+
+
+class TestAdmissionAndFailureModes:
+    def test_shed_raises_and_counts_never_fabricates_verdict(self):
+        from vainplex_openclaw_tpu.models.batching import ServeSheddedError
+        from vainplex_openclaw_tpu.resilience.admission import (
+            AdmissionController)
+
+        # highWatermark 1 → shed_all_depth 4: the 5th unqueued submit
+        # (depth 5 > 4) is refused deterministically.
+        batcher = make_batcher(
+            max_batch=8, window_ms=0.0,
+            admission=AdmissionController(high_watermark=1))
+        texts = seeded_texts(4, seed=6)
+        try:
+            blocked = [threading.Thread(target=batcher.submit, args=(t,))
+                       for t in texts]
+            for t in blocked:
+                t.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with batcher._lock:
+                    if len(batcher._queue) == 4:
+                        break
+                time.sleep(0.005)
+            with pytest.raises(ServeSheddedError, match="admission shed"):
+                batcher.submit("one request too many")
+            stats = batcher.stats()
+            assert stats["shed"] == 1
+            assert stats["admission"]["shed"] == 1
+            # the queued four still get REAL verdicts after the shed
+            while batcher.step(wait_s=0.05):
+                pass
+            for t in blocked:
+                t.join(5.0)
+            assert batcher.stats()["served"] == 4
+        finally:
+            batcher.close()
+
+    def test_closed_batcher_refuses_submits(self):
+        batcher = make_batcher()
+        batcher.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            batcher.submit("late request")
+
+    def test_worker_exception_fans_out_to_requests(self, monkeypatch):
+        batcher = make_batcher(max_batch=4, window_ms=0.0)
+        try:
+            monkeypatch.setattr(
+                type(batcher), "_run_batch",
+                lambda self, b: (_ for _ in ()).throw(RuntimeError("boom")))
+            errs: list = [None, None]
+
+            def worker(i):
+                try:
+                    batcher.submit(f"text {i}")
+                except BaseException as exc:  # noqa: BLE001
+                    errs[i] = exc
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            # Wait for BOTH submits to land, then drain OUTSIDE the
+            # condition (holding _nonempty while calling _drain would
+            # self-deadlock on the shared non-reentrant lock — the exact
+            # discipline step()/_collector follow).
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with batcher._nonempty:
+                    if len(batcher._queue) >= 2:
+                        break
+                time.sleep(0.005)
+            batch = batcher._drain()
+            try:
+                batcher._run_batch(batch)
+            except RuntimeError as exc:
+                for req in batch:
+                    req.error = exc
+                    req.done.set()
+            for t in threads:
+                t.join(5.0)
+            assert len(batch) == 2
+            assert all(isinstance(e, RuntimeError) and "boom" in str(e)
+                       for e in errs)
+        finally:
+            monkeypatch.undo()
+            batcher.close()
+
+    def test_missing_checkpoint_refused_at_construction(self, tmp_path):
+        from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+
+        with pytest.raises(RuntimeError, match="no trained checkpoint"):
+            ContinuousBatcher(str(tmp_path / "nope"), autostart=False)
+
+
+class TestStageAttributionAndSharing:
+    def test_stage_timer_counts_every_request(self):
+        batcher = make_batcher(max_batch=4, window_ms=0.0)
+        texts = seeded_texts(9, seed=7)
+        try:
+            serve_all(batcher, texts)
+            snap = batcher.timer.snapshot()
+            for stage in ("queue", "batch", "prefill", "decode"):
+                assert stage in snap["stages_ms"], stage
+            # queue is per-request; batch/prefill/decode are per-batch
+            assert snap["counts"]["queue"] == len(texts)
+            assert snap["counts"]["prefill"] == batcher.batches
+            stats = batcher.stats()
+            assert stats["served"] == len(texts)
+            assert set(stats["stages"]["counts"]) >= {
+                "queue", "batch", "prefill", "decode"}
+        finally:
+            batcher.close()
+
+    def test_shared_batcher_per_config(self):
+        from vainplex_openclaw_tpu.models.serve import (
+            close_batchers, make_local_call_llm)
+
+        try:
+            a = make_local_call_llm(force=True)
+            b = make_local_call_llm(force=True)
+            assert a.batcher is b.batcher  # one queue = batching together
+            c = make_local_call_llm(force=True, serve_cfg={"maxBatch": 4})
+            assert c.batcher is not a.batcher  # different knobs, own queue
+        finally:
+            close_batchers()
+
+    def test_close_batchers_stops_collectors(self):
+        from vainplex_openclaw_tpu.models.serve import (
+            _batchers, close_batchers, make_local_call_llm)
+
+        call = make_local_call_llm(force=True)
+        t = call.batcher._thread
+        assert t is not None and t.is_alive()
+        close_batchers()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert not _batchers
+
+
+class TestEscapeHatchE2E:
+    """serve.continuousBatching:false restores the one-shot path end to
+    end through the governance plugin config (the ISSUE-14 CI satellite)."""
+
+    def load(self, workspace, lcfg):
+        from vainplex_openclaw_tpu.core import list_logger
+        from vainplex_openclaw_tpu.governance import GovernancePlugin
+
+        gw, _ = make_gateway()
+        logger = list_logger()
+        plugin = GovernancePlugin(workspace=str(workspace), clock=gw.clock)
+        gw.load(plugin, plugin_config={
+            "enabled": True, "builtinPolicies": {},
+            "validation": {"enabled": True, "llmValidator": lcfg}},
+            logger=logger)
+        gw.start()
+        return gw, plugin, logger
+
+    def test_default_config_serves_batched(self, workspace, openclaw_home):
+        from vainplex_openclaw_tpu.models.serve import close_batchers
+
+        try:
+            gw, plugin, logger = self.load(
+                workspace, {"enabled": True, "local": True})
+            assert plugin.engine.output_validator.llm_validator is not None
+            assert any("continuous batching" in m
+                       for m in logger.messages("info"))
+            # serve stage timer registered on the gateway quantile registry
+            assert "serve" in gw.stage_timers
+            d = gw.message_sending("status update text",
+                                   {"agent_id": "main",
+                                    "session_key": "agent:main",
+                                    "channel_id": "twitter"})
+            assert hasattr(d, "blocked")
+        finally:
+            close_batchers()
+
+    def test_escape_hatch_restores_oneshot(self, workspace, openclaw_home):
+        gw, plugin, logger = self.load(
+            workspace, {"enabled": True, "local": True,
+                        "serve": {"continuousBatching": False}})
+        assert plugin.engine.output_validator.llm_validator is not None
+        assert any("one-shot" in m for m in logger.messages("info"))
+        assert "serve" not in gw.stage_timers
+        # and the oracle path still answers the verdict contract
+        d = gw.message_sending("status update text",
+                               {"agent_id": "main",
+                                "session_key": "agent:main",
+                                "channel_id": "twitter"})
+        assert hasattr(d, "blocked")

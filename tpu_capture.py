@@ -77,19 +77,43 @@ def attempt_capture(probe_timeout: float) -> dict:
     # Capture-time sweep drops L=128: FLASH_SWEEP_r04's own medians show
     # everything ≤ 1024 sits on the ~6.7 ms dispatch floor (parity, not
     # signal), and each L costs two remote compiles of a scarce window.
-    fvd_code = ("import json, bench; "
-                "print(json.dumps(bench.bench_flash_vs_dense("
-                "seq_lens=(2048, 16384))))")
-    out, err, timed_out = bench._run_child(fvd_code, timeout=420)
-    if timed_out:  # a fresh child gets a fresh tunnel connection — retry once
-        out, err, _ = bench._run_child(fvd_code, timeout=420)
-    if err is not None:
-        # Encoder number alone is still a successful capture; record the
-        # sweep failure explicitly rather than discarding the attempt.
-        rec["flash_vs_dense"] = [{"metric": "flash_vs_dense", "skipped": True,
-                                  "reason": err}]
-    else:
-        rec["flash_vs_dense"] = json.loads(out)
+    # One child PER LENGTH with that length's own budget (ISSUE 14): the
+    # r05 capture's single 420 s child died inside the 16k compile and
+    # threw away the 2048 point that had finished — a timed-out length now
+    # costs only its own record, and a fresh child per length doubles as
+    # the documented wedge remedy (fresh tunnel connection).
+    fvd_records = []
+    for L in (2048, 16384):
+        budget = bench.flash_len_budget(L)
+        fvd_code = ("import json, bench; "
+                    "print(json.dumps(bench.bench_flash_vs_dense("
+                    f"seq_lens=({L},), budget_s_per_len={budget})))")
+        out, err, timed_out = bench._run_child(fvd_code, timeout=budget + 45)
+        if timed_out:  # one retry: a fresh child gets a fresh connection
+            out, err, _ = bench._run_child(fvd_code, timeout=budget + 45)
+        if err is not None:
+            # Encoder number alone is still a successful capture; record
+            # the per-length failure explicitly rather than discarding it.
+            fvd_records.append({"metric": "flash_vs_dense", "seq_len": L,
+                                "skipped": True, "partial": True,
+                                "budget_s": budget, "reason": err})
+        else:
+            try:
+                fvd_records.extend(json.loads(out))
+            except (TypeError, ValueError):
+                # A zero-exit child whose last line isn't JSON must not
+                # crash the capture — the encoder record is already real
+                # data; degrade to this length's skip record like the
+                # bench.py twin loop does.
+                fvd_records.append({"metric": "flash_vs_dense",
+                                    "seq_len": L, "skipped": True,
+                                    "partial": True, "budget_s": budget,
+                                    "reason": f"unparseable child output: "
+                                              f"{(out or '')[:200]!r}"})
+    # Each child validated only its own length — re-run the sweep physics
+    # on the MERGED list so the cross-length monotonicity check (latency
+    # must grow with L off the dispatch floor) still fires.
+    rec["flash_vs_dense"] = bench.validate_flash_sweep(fvd_records, peak=None)
 
     # The compute-bound MFU config pays a multi-minute remote compile via the
     # tunnel — run it LAST so a slow compile can't eat the window the flash

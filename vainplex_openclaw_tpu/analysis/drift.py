@@ -60,6 +60,9 @@ CONFIG_SITES: tuple = (
     ("vainplex_openclaw_tpu/storage/lifecycle.py",
      ("LIFECYCLE_DEFAULTS",), ("s", "raw", "self.settings"),
      ("lifecycle_settings", "__init__")),
+    ("vainplex_openclaw_tpu/models/serve.py",
+     ("SERVE_DEFAULTS",), ("scfg", "serve_cfg"),
+     ("make_local_call_llm", "shared_batcher")),
 )
 
 

@@ -76,14 +76,38 @@ JIT_TABLE: tuple[JitEntry, ...] = (
         jit_fns=("flash_attention", "_pallas_flash", "_flash_kernel",
                  "_dense_stats_ref", "_flash_norm_bwd", "_flash_stats_bwd"),
         static=("causal", "block_q", "block_k", "interpret", "return_stats",
-                "scale", "n_kb", "L"),  # L: default_block's shape-int param
+                "scale", "n_kb",
+                # default_block/table_entry run at trace time on Python
+                # values only: L is the shape int, side/dtype/family/path
+                # select a searched-table entry (ISSUE 14) — none is ever
+                # a tracer.
+                "L", "side", "dtype", "family", "path"),
         wrapper="flash_attention",
         shape_policy=FIXED,
-        rationale="pads unaligned lengths internally to block multiples "
-                  "(padded keys masked, padded queries sliced), so the "
-                  "compile cache is bounded by the measured block table, "
+        rationale="pads ANY length internally to block multiples (padded "
+                  "keys masked, padded queries sliced; ISSUE 14 removed "
+                  "the dense bail on ragged lengths), so the compile cache "
+                  "is bounded by the searched block table "
+                  "(ops/flash_block_table.json) plus the pow2 fallback, "
                   "not by caller shape diversity",
         entry_names=("flash_attention",),
+    ),
+    JitEntry(
+        # Offline kernel-search probes (ISSUE 14): bench.py kernel_search
+        # builds one jitted chain per measured point. Not memoized ON
+        # PURPOSE — a fresh compile per point IS the experiment; the
+        # retrace gate lives inside measure_point (witness over the timed
+        # rounds), not in the builder.
+        module=f"{_PKG}/ops/kernel_search.py",
+        jit_fns=("_point_runner.run", "_point_runner.step"),
+        static=("L", "block_q", "block_k", "dtype", "steps", "seed",
+                "B", "H", "Dh"),
+        shape_policy=FIXED,
+        rationale="every probe shape is pinned by its (L, block) search "
+                  "point; the sweep enumerates a bounded candidate list "
+                  "and each point's single compile is excluded from its "
+                  "timed rounds",
+        builders=("_point_runner",),
     ),
     JitEntry(
         module=f"{_PKG}/models/encoder.py",
@@ -92,11 +116,15 @@ JIT_TABLE: tuple[JitEntry, ...] = (
         shape_policy=FIXED,
         rationale="seq_len is fixed by config; the batch dim is owned per "
                   "call site (every caller is bucketed, a traced body, or "
-                  "declared below)",
+                  "declared below — the ISSUE-14 continuous batcher step, "
+                  "models/batching.ContinuousBatcher._run_batch, buckets "
+                  "through pad_rows(·, pow2_bucket(n)) and so passes the "
+                  "retrace check structurally)",
         entry_names=("forward",),
         fixed_callers=(
             (f"{_PKG}/models/serve.py", "make_local_call_llm.call",
-             "single-prompt serve path: batch is always exactly 1"),
+             "one-shot oracle path (serve.continuousBatching:false): "
+             "batch is always exactly 1"),
         ),
     ),
     JitEntry(

@@ -350,7 +350,14 @@ class RetraceWitness:
 
     def wrap_trace(self, name: str, fn):
         """Wrap an unjitted impl; bumps once per Python-body execution
-        (= once per jit trace when a transform consumes the wrapper)."""
+        (= once per jit trace when a transform consumes the wrapper).
+        The name registers at wrap time, not first call: a wrapped impl
+        whose caller's jit cache already holds (zero executions) is still
+        an ARMED witness — assert_no_retrace must see it as 0 traces, not
+        reject it as a typo'd pin."""
+        with self._lock:
+            self._trace_counts.setdefault(name, {})
+
         def traced(*args, **kwargs):
             sig = self._signature(args, kwargs)
             with self._lock:

@@ -100,6 +100,12 @@ DEFAULTS = {
     "storage": {"journal": True},
     "twoFa": {"enabled": False},
     "validation": {"enabled": False, "facts": [], "factFiles": [],
+                   # serve: None inherits models/serve.SERVE_DEFAULTS
+                   # (continuous batching on; continuousBatching:false is
+                   # the one-shot escape hatch — ISSUE 14)
+                   "llmValidator": {"enabled": False, "local": False,
+                                    "failMode": "open",
+                                    "checkpointDir": None, "serve": None},
                    "responseGate": {"enabled": False, "rules": []}},
     "redaction": {"enabled": False},
     "erc8004": {"enabled": False},
@@ -207,15 +213,25 @@ class GovernancePlugin:
         call_llm = self.call_llm
         if lcfg.get("enabled") and call_llm is None and lcfg.get("local"):
             # Config-only local stage 3: the on-device triage encoder serves
-            # the verdict contract (models/serve.py). Constructor failures
+            # the verdict contract (models/serve.py) — continuous batching
+            # by default (ISSUE 14), one-shot behind
+            # serve.continuousBatching:false. Constructor failures
             # (unpinned jax platforms, missing checkpoint) degrade to
             # no-stage-3 with the reason logged — matching the DI'd seam's
             # absent behavior rather than killing plugin registration.
             try:
                 from ..models.serve import make_local_call_llm
 
-                call_llm = make_local_call_llm(lcfg.get("checkpointDir"))
-                api.logger.info("stage-3 validator: local encoder serve path")
+                call_llm = make_local_call_llm(lcfg.get("checkpointDir"),
+                                               serve_cfg=lcfg.get("serve"))
+                batcher = getattr(call_llm, "batcher", None)
+                if batcher is not None:
+                    # serve-path attribution (queue/batch/prefill/decode)
+                    # rides the same status surface as every subsystem.
+                    api.register_stage_timer("serve", batcher.timer)
+                api.logger.info(
+                    "stage-3 validator: local encoder serve path "
+                    f"({'continuous batching' if batcher else 'one-shot'})")
             except RuntimeError as exc:
                 api.logger.warn(f"local stage-3 unavailable: {exc}")
         if lcfg.get("enabled") and call_llm is not None:

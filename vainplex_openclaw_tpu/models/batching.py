@@ -1,0 +1,251 @@
+"""Continuous batching for the local serve seam (ISSUE 14).
+
+The governance stage-3 ``llmValidator`` used to reach the on-device triage
+encoder through one-shot ``call()``s: every concurrent validation paid its
+own ``forward`` dispatch at batch 1 — the serving half's last single-digit
+hot path (7.45% MFU in BENCH_r05). This module puts a continuous-batching
+scheduler between the seam and the model: concurrent requests queue, a
+collector drains up to ``max_batch`` of them inside a ``window_ms`` batching
+window, the batch dim is bucketed to a power of two (the PR-1 shape policy —
+O(log N) XLA programs over any traffic mix), and one ``forward`` serves them
+all. Verdict rendering is per-request and identical to the one-shot path,
+which stays available behind ``serve.continuousBatching: false`` as the
+equivalence oracle (tests/test_serve_batching.py pins the two paths
+verdict-identical over seeded concurrent mixes).
+
+Admission rides the PR-6 :class:`AdmissionController`: the collector reports
+queue depth, and a submit landing above the shed threshold raises
+:class:`ServeSheddedError` instead of queueing — the ``LlmValidator``'s
+``fail_mode`` then decides pass/block exactly like any other stage-3 outage
+(degraded mode stays visible, never silent). Per-request attribution lands
+in a shared :class:`StageTimer` under four stages — ``queue`` (enqueue →
+batch formation), ``batch`` (drain + tokenize + pad), ``prefill`` (the
+batched encoder forward), ``decode`` (severity argmax + verdict render) —
+so the serve-path bench can say WHICH stage ate a regression
+(docs/serving-perf.md).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..utils.stage_timer import StageTimer
+
+# severity head classes (encoder.py n_severity=4): info|low|medium|high-crit
+SEVERITY_TO_VERDICT = ("pass", "pass", "flag", "block")
+
+
+class ServeSheddedError(RuntimeError):
+    """Raised to a submitter the admission controller refused to queue."""
+
+
+def render_verdict(severity: int) -> str:
+    """The strict-JSON stage-3 verdict contract for one severity class —
+    shared by the one-shot oracle (models/serve.py) and the batched path,
+    so the two can only ever disagree through the model, never the
+    renderer."""
+    verdict = SEVERITY_TO_VERDICT[min(severity, len(SEVERITY_TO_VERDICT) - 1)]
+    issues = []
+    if verdict != "pass":
+        issues.append({"category": "unverifiable_claim",
+                       "detail": f"local triage severity class {severity}"})
+    return json.dumps({
+        "verdict": verdict,
+        "reason": f"local triage encoder: severity class {severity}",
+        "issues": issues,
+    })
+
+
+@dataclass
+class _Pending:
+    text: str
+    tenant: str
+    enqueued_at: float
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[str] = None
+    error: Optional[BaseException] = None
+
+
+class ContinuousBatcher:
+    """Queue → collect → one batched forward, continuously.
+
+    ``submit()`` is the blocking per-request surface the ``call_llm`` seam
+    wraps; the background collector thread (``autostart=True``) forms
+    batches. Tests and benches drive deterministically with
+    ``autostart=False`` + :meth:`step`.
+
+    The batch dim is padded to ``pow2_bucket(n)`` (zero-token rows — the
+    encoder's masked pooling makes them row-independent, and they are
+    sliced away before decode), so the compile cache is bounded by
+    log2(max_batch) programs regardless of traffic shape.
+    """
+
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 max_batch: int = 32, window_ms: float = 2.0,
+                 admission=None, timer: Optional[StageTimer] = None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 autostart: bool = True):
+        from .pretrained import available
+
+        if not available(checkpoint_dir):
+            # Same LOUD construction contract as the one-shot path: a
+            # silent per-call "pass" would override fail_mode='closed'.
+            raise RuntimeError(
+                "continuous batching serve path refused: no trained "
+                f"checkpoint at {checkpoint_dir or 'the shipped default'}")
+        self.checkpoint_dir = checkpoint_dir
+        self.max_batch = max(1, int(max_batch))
+        self.window_ms = float(window_ms)
+        self.admission = admission  # PR-6 AdmissionController or None
+        self.timer = timer or StageTimer()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queue: list[_Pending] = []
+        self._closed = False
+        self.served = 0
+        self.shed = 0
+        self.batches = 0
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self._thread = threading.Thread(
+                target=self._collector, name="serve-batcher", daemon=True)
+            self._thread.start()
+
+    # ── request surface ──────────────────────────────────────────────
+
+    def submit(self, text: str, tenant: str = "serve",
+               timeout_s: float = 60.0) -> str:
+        """Serve one extracted message text; blocks until its batch ran.
+        Raises :class:`ServeSheddedError` when admission sheds, whatever
+        the batch worker raised when serving failed."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            depth = len(self._queue) + 1
+        if self.admission is not None:
+            self.admission.note_queue_depth(depth)
+            if not self.admission.admit(tenant):
+                with self._lock:
+                    self.shed += 1
+                raise ServeSheddedError(
+                    f"serve admission shed (queue depth {depth})")
+        req = _Pending(text=text, tenant=tenant, enqueued_at=self._clock())
+        with self._nonempty:
+            self._queue.append(req)
+            self._nonempty.notify()
+        if not req.done.wait(timeout_s):
+            raise TimeoutError(f"serve request not batched in {timeout_s}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ── batch formation ──────────────────────────────────────────────
+
+    def _drain(self) -> list:
+        with self._lock:
+            batch, self._queue = (self._queue[:self.max_batch],
+                                  self._queue[self.max_batch:])
+        if self.admission is not None:
+            with self._lock:
+                depth = len(self._queue)
+            self.admission.note_queue_depth(depth)
+        return batch
+
+    def step(self, wait_s: float = 0.0) -> int:
+        """Serve ONE batch synchronously (manual drive for tests/benches —
+        the deterministic twin of the collector loop). Returns the number
+        of requests served (0 when the queue stayed empty for wait_s)."""
+        with self._nonempty:
+            if not self._queue and wait_s:
+                self._nonempty.wait(wait_s)
+            if not self._queue:
+                return 0
+        batch = self._drain()
+        self._run_batch(batch)
+        return len(batch)
+
+    def _collector(self) -> None:
+        while True:
+            with self._nonempty:
+                while not self._queue and not self._closed:
+                    self._nonempty.wait(0.1)
+                if self._closed and not self._queue:
+                    return
+            # batching window: let concurrent submitters land before the
+            # drain — bounded, so a lone request pays ≤ window_ms extra.
+            if self.window_ms > 0:
+                deadline = self._clock() + self.window_ms / 1e3
+                while self._clock() < deadline:
+                    with self._lock:
+                        if len(self._queue) >= self.max_batch:
+                            break
+                    time.sleep(0.0002)
+            batch = self._drain()
+            if batch:
+                try:
+                    self._run_batch(batch)
+                except BaseException as exc:  # noqa: BLE001 — per-request fan-out
+                    for req in batch:
+                        if not req.done.is_set():
+                            req.error = exc
+                            req.done.set()
+
+    # ── the batched serve step ───────────────────────────────────────
+
+    def _run_batch(self, batch: list) -> None:
+        import numpy as np
+
+        from ..ops.similarity import pad_rows, pow2_bucket
+        from . import encode_texts, forward
+        from .pretrained import load_pretrained
+
+        t0 = self._clock()
+        for req in batch:
+            self.timer.add("queue", (t0 - req.enqueued_at) * 1e3)
+        loaded = load_pretrained(self.checkpoint_dir)
+        if loaded is None:
+            raise RuntimeError("continuous serve: checkpoint no longer loadable")
+        cfg, params = loaded
+        tokens = encode_texts([r.text for r in batch], cfg.seq_len,
+                              cfg.vocab_size)
+        padded = pad_rows(tokens, pow2_bucket(len(batch)))
+        t1 = self._clock()
+        self.timer.add("batch", (t1 - t0) * 1e3)
+        out = forward(params, padded, cfg)
+        severity = np.asarray(out["severity"])  # blocks until ready
+        t2 = self._clock()
+        self.timer.add("prefill", (t2 - t1) * 1e3)
+        classes = severity[:len(batch)].argmax(axis=-1)
+        for req, cls in zip(batch, classes):
+            req.result = render_verdict(int(cls))
+            req.done.set()
+        with self._lock:
+            self.served += len(batch)
+            self.batches += 1
+        self.timer.add("decode", (self._clock() - t2) * 1e3)
+
+    # ── lifecycle / observability ────────────────────────────────────
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        with self._nonempty:
+            self._closed = True
+            self._nonempty.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+
+    def stats(self) -> dict:
+        with self._lock:
+            base = {"served": self.served, "batches": self.batches,
+                    "shed": self.shed, "queued": len(self._queue),
+                    "maxBatch": self.max_batch, "windowMs": self.window_ms}
+        base["meanBatch"] = round(base["served"] / base["batches"], 2) \
+            if base["batches"] else 0.0
+        if self.admission is not None:
+            base["admission"] = self.admission.stats()
+        base["stages"] = self.timer.snapshot()
+        return base

@@ -149,19 +149,20 @@ def _attention(x: jax.Array, p: dict, n_heads: int, mask: jax.Array,
         # under jit. The Pallas kernel is the TPU hot path (VERDICT r1 #3);
         # dense lets XLA fuse on CPU/GPU where interpret-mode Pallas is slow.
         # "axon" is the image's experimental TPU-tunnel platform — real TPU.
-        # Routing justified by measurement, not vibes (round-4 interleaved
-        # A/B + block sweep on v5e, FLASH_SWEEP_r04.json): with the tuned
-        # block defaults (ops/flash_attention.default_block) flash is at
-        # parity with dense-XLA below ~1k tokens (both sit on the ~6.7 ms
-        # dispatch floor), 2.1× faster at L=2048, and the ONLY feasible
-        # path at L≥8192 where dense's [B,H,L,L] scores tensor fails to
-        # compile at all — so auto stays flash on TPU at every length.
+        # auto → flash on TPU at EVERY length, short/ragged validator
+        # prompts included (ISSUE 14): block choice is no longer this
+        # comment's 512/1024 caps but the kernel-search table
+        # (ops/flash_block_table.json, regenerated by `bench.py
+        # kernel_search`, seeded from FLASH_SWEEP_r04.json), and
+        # default_block pads lengths with no aligned divisor instead of
+        # bailing to dense. Evidence + routing matrix: docs/serving-perf.md.
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "dense"
     if impl == "flash":
         from ..ops.flash_attention import flash_attention
 
         # The kernel pads unaligned lengths internally (padded keys masked,
-        # padded query rows sliced) and picks measured-optimal blocks.
+        # padded query rows sliced); blocks come from the searched table
+        # with the measured heuristic as fallback.
         out = flash_attention(q, k, v, mask)
     else:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)

@@ -19,13 +19,32 @@ keep this as the degraded-mode fallback.
 
 from __future__ import annotations
 
-import json
+import threading
 from typing import Callable, Optional
 
 from ..utils.jax_safety import backend_init_safe
+from .batching import SEVERITY_TO_VERDICT as _SEVERITY_TO_VERDICT
+from .batching import render_verdict
 
-# severity head classes (encoder.py n_severity=4): info|low|medium|high-crit
-_SEVERITY_TO_VERDICT = ("pass", "pass", "flag", "block")
+# Serve-path knobs (ISSUE 14), deep-merged under the governance
+# llmValidator config's ``serve`` section (GL-DRIFT-CONFIG site).
+# ``continuousBatching: false`` is the escape hatch back to the one-shot
+# path — kept as the equivalence oracle, never deleted.
+SERVE_DEFAULTS = {
+    "continuousBatching": True,
+    "maxBatch": 32,
+    "windowMs": 2.0,
+    # PR-6 AdmissionController over the serve queue. Shed semantics are
+    # the controller's, unchanged: EVERY submit sheds past 4x the
+    # watermark (shedAllFactor); between 1x and 4x only over-fair-share
+    # tenants shed, and only when >1 tenant is active — single-tenant
+    # callers (the default tenant="serve") queue up to the 4x depth, so
+    # size backpressure off shedAllDepth, not highWatermark. A shed
+    # raises ServeSheddedError and the validator's fail_mode owns the
+    # degraded verdict (docs/serving-perf.md). resilience/admission.py
+    # documents the remaining knobs.
+    "admission": {"enabled": True, "highWatermark": 128},
+}
 
 # Markers from llm_validator.build_prompt — the MESSAGE body is embedded
 # VERBATIM between them and may itself contain blank lines, so the section
@@ -44,9 +63,53 @@ def _extract_message(prompt: str) -> str:
     return body.strip()
 
 
+# One batcher per (checkpoint dir, knob tuple): every call_llm closure a
+# process builds for the same serving config shares one queue — that IS
+# the continuous-batching win (two validators batching together), and it
+# keeps the collector-thread count bounded.
+_batchers: dict = {}
+_batchers_lock = threading.Lock()
+
+
+def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict):
+    from ..resilience.admission import AdmissionController
+    from .batching import ContinuousBatcher
+
+    key = (checkpoint_dir, serve_cfg["maxBatch"], serve_cfg["windowMs"],
+           tuple(sorted((serve_cfg.get("admission") or {}).items())))
+    with _batchers_lock:
+        batcher = _batchers.get(key)
+        if batcher is None:
+            batcher = ContinuousBatcher(
+                checkpoint_dir,
+                max_batch=serve_cfg["maxBatch"],
+                window_ms=serve_cfg["windowMs"],
+                admission=AdmissionController.from_config(
+                    serve_cfg.get("admission")))
+            _batchers[key] = batcher
+        return batcher
+
+
+def close_batchers() -> None:
+    """Stop every shared collector thread (tests / process teardown)."""
+    with _batchers_lock:
+        batchers = list(_batchers.values())
+        _batchers.clear()
+    for b in batchers:
+        b.close()
+
+
 def make_local_call_llm(checkpoint_dir: Optional[str] = None,
-                        force: bool = False) -> Callable[[str], str]:
+                        force: bool = False,
+                        serve_cfg: Optional[dict] = None) -> Callable[[str], str]:
     """Build a ``call_llm`` seam served by the local triage encoder.
+
+    ``serve_cfg`` (deep-merged over :data:`SERVE_DEFAULTS`) selects the
+    path: continuous batching by default — concurrent validations share
+    one pow2-bucketed batched ``forward`` through a process-shared
+    :class:`~.batching.ContinuousBatcher` (exposed as ``call.batcher``) —
+    or the legacy one-shot path behind ``continuousBatching: false``,
+    kept verbatim as the equivalence oracle.
 
     Raises RuntimeError in a process that has not pinned its jax platforms
     (utils/jax_safety) unless ``force=True`` — a serve path must fail loud
@@ -69,6 +132,18 @@ def make_local_call_llm(checkpoint_dir: Optional[str] = None,
             f"{checkpoint_dir or 'the shipped default'} — point call_llm "
             "at a real LLM or ship a checkpoint")
 
+    from ..config.loader import deep_merge
+
+    scfg = deep_merge(SERVE_DEFAULTS, serve_cfg or {})
+    if scfg.get("continuousBatching"):
+        batcher = shared_batcher(checkpoint_dir, scfg)
+
+        def call(prompt: str) -> str:
+            return batcher.submit(_extract_message(prompt))
+
+        call.batcher = batcher
+        return call
+
     def call(prompt: str) -> str:
         import numpy as np
 
@@ -85,16 +160,6 @@ def make_local_call_llm(checkpoint_dir: Optional[str] = None,
         tokens = encode_texts([text], cfg.seq_len, cfg.vocab_size)
         out = forward(params, tokens, cfg)
         severity = int(np.asarray(out["severity"]).argmax(axis=-1)[0])
-        verdict = _SEVERITY_TO_VERDICT[min(severity,
-                                           len(_SEVERITY_TO_VERDICT) - 1)]
-        issues = []
-        if verdict != "pass":
-            issues.append({"category": "unverifiable_claim",
-                           "detail": f"local triage severity class {severity}"})
-        return json.dumps({
-            "verdict": verdict,
-            "reason": f"local triage encoder: severity class {severity}",
-            "issues": issues,
-        })
+        return render_verdict(severity)
 
     return call

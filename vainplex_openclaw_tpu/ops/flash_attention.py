@@ -22,29 +22,109 @@ by the multichip dryrun's ring+flash stage and tests/test_parallel.py).
 from __future__ import annotations
 
 import functools
+import json
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x ships the TPU compiler-params struct as TPUCompilerParams;
+# newer jax renamed it CompilerParams. Resolve once at import so the kernel
+# (and its interpret-mode tests) run on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 _STATS_LANES = 128  # keep scratch lane dimension hardware-aligned
 
+# Checked-in best-config table written by the kernel-search loop
+# (ops/kernel_search.py, `bench.py kernel_search`): per (backend family,
+# dtype, pow2 seq bucket) block shapes measured fastest with zero retraces.
+# Seeded from FLASH_SWEEP_r04.json; the search loop regenerates it whenever
+# a device window exists, and tests/test_kernel_search.py regression-gates
+# the committed file (docs/serving-perf.md).
+TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "flash_block_table.json")
+TABLE_ENV = "OPENCLAW_FLASH_BLOCK_TABLE"
 
-def default_block(L: int) -> "int | None":
-    """Largest MXU-aligned block that divides L, capped by what the round-4
-    v5e sweep measured as optimal (committed in FLASH_SWEEP_r04.json):
-    512 up to L=4096 (512² beat 128² by 2.9× at L=2048 and beat dense-XLA
-    2.1×), 1024 beyond (79→14.7 ms at L=8192, 301→39.6 ms at L=16384;
-    2048² blocks fail Mosaic compile on this chip). None = no aligned
-    divisor exists; the caller pads (models/encoder.py does)."""
+
+@functools.lru_cache(maxsize=8)
+def load_block_table(path: "str | None" = None) -> dict:
+    """Parsed block table ({} when missing/invalid — the heuristic then
+    owns every bucket). Cached per path: ``default_block`` runs at trace
+    time and must not pay file IO per compile."""
+    p = path or os.environ.get(TABLE_ENV) or TABLE_PATH
+    try:
+        with open(p, encoding="utf-8") as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    entries = table.get("entries")
+    return table if isinstance(entries, dict) else {}
+
+
+def clear_table_cache() -> None:
+    """Drop the memoized table (tests/search-loop reload after a rewrite)."""
+    load_block_table.cache_clear()
+
+
+# The repo-wide shape policy (PR-1): one rounding discipline for every
+# bucketed kernel, so table keys written by the search loop can never
+# drift from the lookups here.
+from .similarity import pow2_bucket as _pow2_bucket  # noqa: E402
+
+
+def backend_family(backend: "str | None" = None) -> str:
+    """'tpu' for real-TPU families ("axon" is the image's TPU tunnel),
+    else the backend name — the table key axis: blocks searched on one
+    family must not drive another."""
+    b = backend or jax.default_backend()
+    return "tpu" if b in ("tpu", "axon") else b
+
+
+def table_key(L: int, dtype: str = "bfloat16",
+              family: "str | None" = None) -> str:
+    """The one table-key format — writer (kernel_search) and reader
+    (table_entry) both call this, so the halves cannot drift apart."""
+    return f"{family or backend_family()}:{dtype}:{_pow2_bucket(max(L, 1))}"
+
+
+def table_entry(L: int, dtype: str = "bfloat16",
+                family: "str | None" = None,
+                path: "str | None" = None) -> "dict | None":
+    """Searched table entry for (family, dtype, pow2 bucket of L), or None."""
+    entries = load_block_table(path).get("entries", {})
+    ent = entries.get(table_key(L, dtype, family))
+    if not isinstance(ent, dict):
+        return None
+    bq, bk = ent.get("block_q"), ent.get("block_k")
+    if not (isinstance(bq, int) and isinstance(bk, int)
+            and bq >= 8 and bk >= 8 and bq % 8 == 0 and bk % 8 == 0):
+        return None  # malformed entry: fall back loudly-simple, not crash
+    return ent
+
+
+def default_block(L: int, dtype: str = "bfloat16", side: str = "q") -> int:
+    """Block size for one attention side at length L. Consults the
+    checked-in kernel-search table first (per backend family / dtype /
+    pow2 seq bucket); on a miss, falls back to the measured heuristic from
+    the round-4 v5e sweep (FLASH_SWEEP_r04.json): the largest MXU-aligned
+    divisor of L capped at 512 up to L=4096 and 1024 beyond (2048² blocks
+    fail Mosaic compile on that chip). Lengths with NO aligned divisor no
+    longer bail to the caller: the pow2 roundup of L (same caps) is
+    returned and ``flash_attention`` pads up to it — short/ragged
+    validator prompts hit the kernel instead of falling back to dense."""
+    ent = table_entry(L, dtype)
+    if ent is not None:
+        return ent["block_q"] if side == "q" else ent["block_k"]
     cap = 512 if L <= 4096 else 1024
     for b in range(min(cap, L), 7, -1):
         if L % b == 0 and b % 8 == 0:
             return b
-    return None
+    return min(cap, _pow2_bucket(max(L, 8)))
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, *refs,
@@ -152,7 +232,7 @@ def _pallas_flash(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running sum
             pltpu.VMEM((block_q, Dh), jnp.float32),            # accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, bias)
@@ -252,13 +332,16 @@ def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
     merges the raw partials across KV rotations instead
     (parallel/ring_attention.py).
 
-    block_q/block_k default to the measured-optimal ``default_block(L)``
-    (VERDICT r3 #3 — the round-3 fixed 128² default left 3-8× on the table
-    at long L). Lengths without an aligned block divisor are padded to a
-    block multiple internally (padded keys masked out, padded query rows
-    sliced away) — callers never pad. ``causal`` requires Lq == Lk (global
-    positions are block-local). interpret=None auto-selects the Pallas
-    interpreter off-TPU.
+    block_q/block_k default to ``default_block(L, dtype)`` — the searched
+    per-(family, dtype, seq-bucket) table when a kernel-search entry
+    exists, else the measured round-4 heuristic (VERDICT r3 #3 — the
+    round-3 fixed 128² default left 3-8× on the table at long L). ANY
+    length is padded to a block multiple internally (padded keys masked
+    out, padded query rows sliced away) — callers never pad, and short or
+    ragged lengths (validator prompts) hit the kernel instead of needing a
+    dense fallback. ``causal`` requires Lq == Lk (global positions are
+    block-local). interpret=None auto-selects the Pallas interpreter
+    off-TPU.
 
     Differentiable: the forward runs the Pallas kernel; the backward is a
     custom VJP that recomputes the block densely (O(Lq·Lk) memory during
@@ -269,8 +352,15 @@ def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
     Lk = k.shape[2]
     if causal and Lq != Lk:
         raise ValueError("causal flash attention requires Lq == Lk")
-    block_q = min(block_q or default_block(Lq) or 128, max(Lq, 8))
-    block_k = min(block_k or default_block(Lk) or 128, max(Lk, 8))
+    dtype_name = jnp.dtype(q.dtype).name
+    block_q = block_q or default_block(Lq, dtype_name, side="q")
+    block_k = block_k or default_block(Lk, dtype_name, side="k")
+    # Ragged/short handling: never run a block beyond the 8-aligned roundup
+    # of the actual length — a 64-token validator prompt pads to one 64-wide
+    # block, not to the table's 512 (the clamp keeps the block aligned, so a
+    # length like 100 pads to 104 instead of running a misaligned 100-block).
+    block_q = max(8, min(block_q, -(-Lq // 8) * 8))
+    block_k = max(8, min(block_k, -(-Lk // 8) * 8))
     pad_q = (-Lq) % block_q
     pad_k = (-Lk) % block_k
     if interpret is None:

@@ -1,0 +1,320 @@
+"""Offline kernel-config search for the flash-attention serving path
+(ISSUE 14).
+
+AutoKernel (PAPERS.md) is the shape of the loop — iterative,
+measurement-driven search over kernel configurations with the benchmark as
+the fitness signal; FastKernels is the artifact discipline — kernel
+performance as a checked-in, regression-gated table instead of a one-off
+tuning session (FLASH_SWEEP_r04 was measured once and its conclusions
+hand-copied into ``default_block``; nothing re-checked them).
+
+The loop sweeps (block_q, block_k) candidates per (backend family, dtype,
+pow2 seq bucket) on the live backend, timing ``steps`` serially
+data-dependent flash calls per round (the bench.py anti-elision harness:
+each step's output feeds the next query, so no cache can skip work). A
+candidate wins its bucket only when it is **measured faster than the
+incumbent ``default_block`` choice AND RetraceWitness-clean** — zero new
+XLA compiles during the timed rounds (the PR-10 witness; a candidate that
+retraces under steady-state traffic would bill compiles as serving
+latency). Winners land in the checked-in table
+(``ops/flash_block_table.json``) that ``default_block`` consults;
+``validate_table`` is the regression gate CI runs against the committed
+file.
+
+Seeded and resumable: inputs derive from ``fold_in``'s of one PRNGKey, and
+every measured point is appended to a state file the moment it completes —
+a sweep killed by a wedged TPU tunnel resumes from its last finished point
+instead of restarting from zero (the FLASH_SWEEP_r04 failure mode).
+
+CLI: ``python bench.py kernel_search`` (see bench.py for the record
+contract); workflow: docs/serving-perf.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .flash_attention import backend_family, default_block, table_key
+from .similarity import pow2_bucket as _pow2_bucket
+
+#: block candidates per side on real TPUs — 2048² fails Mosaic compile on
+#: v5e (FLASH_SWEEP_r04), so it is not a default candidate; pass it
+#: explicitly to re-probe on a newer chip.
+DEFAULT_CANDIDATES = (128, 256, 512, 1024)
+
+
+def _ceil8(n: int) -> int:
+    return max(8, -(-n // 8) * 8)
+
+
+def attention_flops(B: int, H: int, L: int, Dh: int) -> float:
+    """QKᵀ + PV matmul FLOPs for one attention call (2·m·n·k convention)."""
+    return 4.0 * B * H * L * L * Dh
+
+
+def bucket_key(L: int, dtype: str = "bfloat16",
+               family: "str | None" = None) -> str:
+    """Alias of :func:`~.flash_attention.table_key`: the search loop writes
+    keys with the SAME function ``default_block``'s lookup reads with."""
+    return table_key(L, dtype, family)
+
+
+def candidate_pairs(L: int, blocks: tuple = DEFAULT_CANDIDATES,
+                    dtype: str = "bfloat16") -> list:
+    """(block_q, block_k) sweep for one length: the incumbent default FIRST
+    (it is the baseline every candidate must beat), then the square and
+    rectangular combinations of ``blocks`` clamped to the padded roundup of
+    L (a block beyond one padded L would only waste VMEM)."""
+    lim = _ceil8(L)
+    incumbent = (min(default_block(L, dtype, side="q"), lim),
+                 min(default_block(L, dtype, side="k"), lim))
+    sizes = sorted({min(b, lim) for b in blocks if b >= 8})
+    pairs = [incumbent]
+    for bq in sizes:
+        for bk in sizes:
+            if (bq, bk) != incumbent:
+                pairs.append((bq, bk))
+    return pairs
+
+
+# ── one measured point ───────────────────────────────────────────────
+
+
+def _point_runner(L: int, block_q: int, block_k: int, dtype: str,
+                  steps: int, seed: int, B: int = 4, H: int = 8,
+                  Dh: int = 64):
+    """(runner, q0) — a jitted chain of ``steps`` serially data-dependent
+    flash calls at a pinned block shape (JIT_TABLE builder; each search
+    point compiles exactly once by design — the sweep IS the bounded shape
+    space)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .flash_attention import flash_attention
+
+    dt = jnp.dtype(dtype)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), L)
+    q0, k, v = (jax.random.normal(kk, (B, H, L, Dh), dt)
+                for kk in jax.random.split(key, 3))
+    mask = jnp.ones((B, L), bool)
+
+    def step(q, _):
+        o = flash_attention(q, k, v, mask, block_q=block_q, block_k=block_k)
+        # Output feeds the next query (cheap elementwise rescale) — step
+        # i+1 cannot start, or be skipped, before step i (bench.py method).
+        return (o / jnp.float32(1.125)).astype(q.dtype), ()
+
+    @jax.jit
+    def run(q0):
+        qf, _ = jax.lax.scan(step, q0, None, length=steps)
+        return qf
+
+    return run, q0
+
+
+def measure_point(L: int, block_q: int, block_k: int, *,
+                  dtype: str = "bfloat16", steps: int = 4, rounds: int = 3,
+                  seed: int = 0, clock=time.perf_counter) -> dict:
+    """Time one (L, block_q, block_k) candidate. Returns a record carrying
+    the median per-call ms, the relative spread, and ``retraces`` — XLA
+    compiles observed by the RetraceWitness DURING the timed rounds (the
+    warmup compile is expected and excluded). Compile/run failures (Mosaic
+    rejects a block, OOM) come back as ``{"error": ...}`` records instead
+    of killing the sweep — the r04 lesson: a failed candidate is DATA."""
+    import statistics
+
+    import jax
+
+    from ..analysis import RetraceWitness
+
+    rec = {"seq_len": L, "block_q": block_q, "block_k": block_k,
+           "dtype": dtype, "steps": steps, "rounds": rounds, "seed": seed}
+    try:
+        run, q0 = _point_runner(L, block_q, block_k, dtype, steps, seed)
+        jax.block_until_ready(run(q0))  # compile + warmup (excluded)
+    except Exception as exc:  # noqa: BLE001 — a rejected candidate is data
+        rec["error"] = str(exc)[:200]
+        return rec
+    witness = RetraceWitness()
+    witness.probe("kernel_search_point", run)
+    base = witness.baseline()  # snapshot once, BEFORE the timed rounds
+    samples = []
+    for _ in range(max(1, rounds)):
+        t0 = clock()
+        jax.block_until_ready(run(q0))
+        samples.append((clock() - t0) / steps * 1e3)
+    retraces = witness.traces("kernel_search_point") - \
+        base.get("kernel_search_point", 0)
+    med = statistics.median(samples)
+    rec.update({
+        "ms": round(med, 4),
+        "spread": round((max(samples) - min(samples)) / med, 3) if med else 0.0,
+        "retraces": int(retraces),
+    })
+    return rec
+
+
+# ── resumable state ──────────────────────────────────────────────────
+
+
+def _load_state(path: "str | None") -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            state = json.load(f)
+        return state if isinstance(state, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_state(path: "str | None", state: dict) -> None:
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+# ── the search loop ──────────────────────────────────────────────────
+
+
+def search(seq_lens: tuple, *, dtype: str = "bfloat16",
+           blocks: tuple = DEFAULT_CANDIDATES, steps: int = 4,
+           rounds: int = 3, seed: int = 0, state_path: "str | None" = None,
+           budget_s_per_len: "float | None" = None, log=None,
+           clock=time.perf_counter) -> dict:
+    """Sweep every candidate pair for every length; returns
+    ``{bucket_key: {"baseline", "best", "candidates", ...}}``.
+
+    ``state_path`` makes the sweep resumable: finished points are read
+    back instead of re-measured (same seed → same point identity), and
+    each new point is persisted the moment it lands. Persisted ERROR
+    records are not finished points — they re-measure on resume, so a
+    one-off tunnel failure never permanently bans a candidate. ``budget_s_per_len``
+    bounds one length's candidate loop — on expiry the remaining
+    candidates are recorded as skipped and the NEXT length still runs
+    (partial results beat a dead sweep; the ISSUE-14 satellite rule)."""
+    family = backend_family()
+    state = _load_state(state_path)
+    results: dict = {}
+    for L in seq_lens:
+        key = bucket_key(L, dtype, family)
+        pairs = candidate_pairs(L, blocks, dtype)
+        t_len = clock()
+        cands, skipped = [], 0
+        for i, (bq, bk) in enumerate(pairs):
+            pkey = f"{key}:{bq}x{bk}:s{steps}r{rounds}seed{seed}"
+            prior = state.get(pkey)
+            if prior is not None and prior.get("ms") is not None:
+                # resume hit: measured by a prior run. Error records do
+                # NOT count as finished — a transient tunnel failure must
+                # be re-measured, not permanently ban the candidate.
+                rec = {**prior, "resumed": True}
+            elif budget_s_per_len and i > 0 \
+                    and clock() - t_len > budget_s_per_len:
+                skipped += 1
+                continue
+            else:
+                rec = measure_point(L, bq, bk, dtype=dtype, steps=steps,
+                                    rounds=rounds, seed=seed, clock=clock)
+                state[pkey] = {k: v for k, v in rec.items() if k != "resumed"}
+                _save_state(state_path, state)
+            cands.append(rec)
+            if log is not None:
+                log(f"kernel_search {key} {bq}x{bk}: "
+                    f"{rec.get('ms', rec.get('error'))}")
+        baseline = cands[0] if cands else None
+        clean = [c for c in cands[1:]
+                 if c.get("ms") is not None and c.get("retraces") == 0]
+        best = baseline
+        if baseline is not None and baseline.get("ms") is not None:
+            # the gate: FASTER than the incumbent AND zero retraces —
+            # a tie (or a dirty winner) keeps the incumbent.
+            for c in clean:
+                if c["ms"] < (best.get("ms") or float("inf")):
+                    best = c
+        results[key] = {
+            "seq_len": L, "dtype": dtype, "family": family,
+            "baseline": baseline, "best": best, "candidates": cands,
+            "improved": bool(best is not baseline),
+            "skipped_candidates": skipped,
+            "partial": bool(skipped),
+        }
+    return results
+
+
+# ── table emission + the regression gate ─────────────────────────────
+
+
+def to_table(results: dict, base_table: "dict | None" = None) -> dict:
+    """Merge search winners into a block-table dict (schema v1). Only
+    buckets whose winner has a real measurement land; existing entries for
+    other buckets/families survive (a CPU mini-sweep must not strip the
+    committed TPU rows)."""
+    table = {"schema": "flash-block-table-v1",
+             "provenance": dict((base_table or {}).get("provenance") or {}),
+             "entries": dict((base_table or {}).get("entries") or {})}
+    table["provenance"]["generator"] = \
+        "python bench.py kernel_search --write-table <path>"
+    table["provenance"]["gate"] = ("faster than incumbent default AND "
+                                   "zero retraces in the timed phase")
+    for key, res in results.items():
+        best = res.get("best")
+        if not best or best.get("ms") is None:
+            continue
+        table["entries"][key] = {
+            "block_q": int(best["block_q"]), "block_k": int(best["block_k"]),
+            "ms": best["ms"],
+            "source": "kernel_search seed=%s steps=%s rounds=%s" % (
+                best.get("seed"), best.get("steps"), best.get("rounds")),
+        }
+    return table
+
+
+def write_table(table: dict, path: str) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def validate_table(table: dict) -> list:
+    """Regression-gate findings for a block table (empty list = clean).
+    CI runs this against the committed file AND against every freshly
+    searched table before it may be written — the FastKernels discipline:
+    the artifact is linted, not trusted."""
+    findings = []
+    if table.get("schema") != "flash-block-table-v1":
+        findings.append(f"unknown schema {table.get('schema')!r}")
+    entries = table.get("entries")
+    if not isinstance(entries, dict) or not entries:
+        findings.append("no entries")
+        return findings
+    for key, ent in entries.items():
+        parts = key.split(":")
+        if len(parts) != 3:
+            findings.append(f"{key}: key is not family:dtype:bucket")
+            continue
+        try:
+            bucket = int(parts[2])
+        except ValueError:
+            findings.append(f"{key}: bucket is not an int")
+            continue
+        if bucket < 8 or bucket != _pow2_bucket(bucket):
+            findings.append(f"{key}: bucket {bucket} is not a pow2 ≥ 8")
+        for side in ("block_q", "block_k"):
+            b = ent.get(side) if isinstance(ent, dict) else None
+            if not isinstance(b, int) or b < 8 or b % 8:
+                findings.append(f"{key}: {side}={b!r} not an aligned block")
+            elif b > bucket and b != _ceil8(bucket):
+                findings.append(f"{key}: {side}={b} exceeds its padded bucket")
+        ms = ent.get("ms") if isinstance(ent, dict) else None
+        if ms is not None and not (isinstance(ms, (int, float)) and ms > 0):
+            findings.append(f"{key}: ms={ms!r} not a positive number")
+    return findings
