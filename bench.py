@@ -2052,6 +2052,293 @@ def _serve_cli(argv: list) -> dict:
     return bench_serve_latency(**kwargs)
 
 
+def mesh_serve_stage_records(stage_quantiles: dict) -> list[dict]:
+    """Per-stage quantile lines for the mesh-served path — the PR-14
+    serve stages plus the mesh-only ``shard`` (params/token placement)
+    and ``gather`` (replicated output → host) attribution."""
+    return [{"metric": "mesh_serve_stage_ms", "stage": stage, **qs}
+            for stage, qs in (stage_quantiles or {}).items()]
+
+
+def bench_mesh_serve(shapes: tuple = ((1, 1), (2, 1), (2, 4)),
+                     n_requests: int = 64, concurrency: int = 8,
+                     seed: int = 0, max_batch: int = 16,
+                     window_ms: float = 1.0, n_facts: int = 96) -> dict:
+    """Multi-chip serving throughput + scaling efficiency (ISSUE 15).
+
+    Serves one seeded validator-prompt mix through the declarative-
+    sharded ContinuousBatcher on every mesh shape (params placed per the
+    encoder_validator rule table, compiled variant per (cfg, mesh, spec)),
+    pinned against the single-device one-shot oracle: verdict mismatches
+    must be 0 on every shape, and a RetraceWitness over each mesh's
+    compiled variant must read ZERO compiles in the measured phase (every
+    bucket is warmed first). A data-parallel embeddings pass (sync +
+    search over a dp mesh) rides in the same record. scaling_efficiency =
+    throughput(shape) / (throughput(1x1) × devices); on the CPU-device
+    dryrun (no TPU window) the virtual devices share the host's cores, so
+    the honest signal here is parity + zero retraces + shard/gather
+    attribution — device_kind documents which capture this was
+    (docs/serving-perf.md records the TPU projection)."""
+    import os
+    import threading
+
+    import jax
+    import numpy as np
+
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+    from vainplex_openclaw_tpu.governance.validation.llm_validator import build_prompt
+    from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+    from vainplex_openclaw_tpu.models.pretrained import load_pretrained
+    from vainplex_openclaw_tpu.models.serve import (
+        _extract_message as _extract, make_local_call_llm)
+    from vainplex_openclaw_tpu.ops.similarity import pad_rows
+    from vainplex_openclaw_tpu.parallel import plan as sharding_plan
+    from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+    shapes = tuple(tuple(int(x) for x in s) for s in shapes)
+    need = max(int(np.prod(s)) for s in shapes)
+    have = len(jax.devices())
+    if have < need:
+        raise RuntimeError(
+            f"mesh_serve: largest shape needs {need} devices, process has "
+            f"{have} — run `python bench.py mesh_serve` (the CLI re-execs "
+            f"onto virtual CPU host devices) or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need}")
+
+    rng = np.random.default_rng(seed)
+    subjects = ("deploy", "quarterly report", "incident", "migration",
+                "customer email", "release", "audit", "benchmark")
+    verbs = ("completed", "failed", "regressed", "crashed", "improved",
+             "shipped", "stalled", "recovered")
+    prompts = [build_prompt(
+        f"The {rng.choice(subjects)} {rng.choice(verbs)} with code "
+        f"{int(rng.integers(0, 500))}; throughput changed "
+        f"{int(rng.integers(-60, 90))}%.", []) for _ in range(n_requests)]
+
+    oneshot = make_local_call_llm(serve_cfg={"continuousBatching": False},
+                                  force=True)
+    t0 = time.perf_counter()
+    ref = [oneshot(p) for p in prompts]
+    oneshot_s = time.perf_counter() - t0
+    loaded = load_pretrained(None)
+    cfg = loaded[0]
+
+    def shape_name(s):
+        return "x".join(str(x) for x in s)
+
+    throughput: dict = {}
+    tokens_per_s: dict = {}
+    mismatches_by_shape: dict = {}
+    retraces_by_shape: dict = {}
+    mean_batch: dict = {}
+    stage_quantiles: dict = {}
+    for shape in shapes:
+        mesh = cached_mesh(shape)
+        batcher = ContinuousBatcher(max_batch=max_batch,
+                                    window_ms=window_ms, mesh=mesh)
+        try:
+            # Warm every bucket this run can form on THIS mesh (pow2,
+            # floored at dp) so the measured phase is compile-free by
+            # construction — same discipline as bench_serve_latency.
+            from vainplex_openclaw_tpu.models import encode_texts
+
+            placed_params = sharding_plan.sharded_params(
+                "bench-warm", loaded[1], mesh, "encoder_validator")
+            buckets = sorted({sharding_plan.serve_bucket(b, mesh)
+                              for b in range(1, max_batch + 1)})
+            for b in buckets:
+                toks = pad_rows(encode_texts(["warmup"], cfg.seq_len,
+                                             cfg.vocab_size), b)
+                np.asarray(sharding_plan.serve_forward(
+                    placed_params, sharding_plan.place_tokens(toks, mesh),
+                    cfg, mesh)["severity"])
+
+            witness = RetraceWitness()
+            compiled = sharding_plan._build_serve_forward(
+                cfg, mesh, "encoder_validator")
+            witness.probe("mesh_forward", compiled)
+            base = witness.baseline()
+
+            results: list = [None] * n_requests
+            errors: list = [None] * n_requests
+            next_idx = {"i": 0}
+            idx_lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with idx_lock:
+                        i = next_idx["i"]
+                        if i >= n_requests:
+                            return
+                        next_idx["i"] = i + 1
+                    try:
+                        results[i] = batcher.submit(_extract(prompts[i]))
+                    except Exception as exc:  # noqa: BLE001 — surfaced below
+                        errors[i] = exc
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker)
+                       for _ in range(max(1, concurrency))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            failed = [(i, e) for i, e in enumerate(errors) if e is not None]
+            if failed:
+                i, exc = failed[0]
+                raise RuntimeError(
+                    f"mesh_serve[{shape_name(shape)}]: {len(failed)}/"
+                    f"{n_requests} submits raised; first at {i}") from exc
+            name = shape_name(shape)
+            throughput[name] = round(n_requests / dt, 1)
+            tokens_per_s[name] = round(n_requests * cfg.seq_len / dt, 0)
+            mismatches_by_shape[name] = sum(
+                1 for a, b in zip(results, ref) if a != b)
+            retraces_by_shape[name] = int(
+                witness.traces("mesh_forward") - base.get("mesh_forward", 0))
+            stats = batcher.stats()
+            mean_batch[name] = stats["meanBatch"]
+            stage_quantiles[name] = batcher.timer.quantiles()
+        finally:
+            batcher.close()
+
+    base_name = shape_name(shapes[0])
+    scaling_efficiency = {}
+    for shape in shapes:
+        name = shape_name(shape)
+        ndev = int(np.prod(shape))
+        scaling_efficiency[name] = round(
+            throughput[name] / (throughput[base_name] * ndev), 3) \
+            if throughput.get(base_name) else 0.0
+
+    # ── data-parallel embeddings + arena search on a (need,) dp mesh ──
+    from types import SimpleNamespace
+
+    from vainplex_openclaw_tpu.knowledge.embeddings import create_embeddings
+
+    class _Log:
+        def info(self, *_a):
+            pass
+        warn = error = info
+
+    def synth_facts(n):
+        frng = np.random.default_rng(seed + 1)
+        subj = ("deploy", "db", "api", "release", "pipeline", "cache")
+        preds = ("failed-with", "depends-on", "improved", "blocked-by")
+        return [SimpleNamespace(
+            id=f"f{i}", subject=str(frng.choice(subj)),
+            predicate=str(frng.choice(preds)),
+            object=f"thing-{int(frng.integers(0, 60))}",
+            source="bench", created_at="2026-08-03") for i in range(n)]
+
+    facts = synth_facts(n_facts)
+    queries = ["deploy failed", "cache depends", "api improved thing-3",
+               "release blocked"]
+    emb_oracle = create_embeddings({"backend": "local"}, _Log())
+    emb_mesh = create_embeddings(
+        {"backend": "local", "meshServing": True, "meshShape": [need]},
+        _Log())
+    emb_oracle.sync(facts[:2])  # pay lazy init outside the timed sync
+    emb_mesh.sync(facts[:2])
+    t0 = time.perf_counter()
+    emb_oracle.sync(facts)
+    emb_sync_oracle_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    emb_mesh.sync(facts)
+    emb_sync_mesh_s = time.perf_counter() - t0
+    search_id_mismatches = 0
+    search_score_dev = 0.0
+    for q in queries:
+        a = emb_oracle.search(q, k=5)
+        b = emb_mesh.search(q, k=5)
+        if [r["id"] for r in a] != [r["id"] for r in b]:
+            search_id_mismatches += 1
+        if a and b:
+            search_score_dev = max(search_score_dev, max(
+                abs(x["score"] - y["score"]) for x, y in zip(a, b)))
+
+    platform, kind, _ = _device_peak()
+    best = max(throughput.values())
+    rec = {"metric": "mesh_serve", "value": best, "unit": "req/s",
+           "shapes": [shape_name(s) for s in shapes],
+           "devices": {shape_name(s): int(np.prod(s)) for s in shapes},
+           "n_requests": n_requests, "concurrency": concurrency,
+           "seed": seed, "max_batch": max_batch, "window_ms": window_ms,
+           "throughput_rps": throughput,
+           "tokens_per_s": tokens_per_s,
+           "scaling_efficiency": scaling_efficiency,
+           "oneshot_rps": round(n_requests / oneshot_s, 1),
+           "mean_batch": mean_batch,
+           "verdict_mismatches": sum(mismatches_by_shape.values()),
+           "verdict_mismatches_by_shape": mismatches_by_shape,
+           "retraces": sum(retraces_by_shape.values()),
+           "retraces_by_shape": retraces_by_shape,
+           "embed_sync_facts_s": round(n_facts / emb_sync_mesh_s, 1),
+           "embed_sync_facts_s_oracle": round(n_facts / emb_sync_oracle_s, 1),
+           "search_id_mismatches": search_id_mismatches,
+           "search_score_dev": round(float(search_score_dev), 6),
+           "mesh_serve_stage_quantiles": stage_quantiles,
+           "device": platform, "device_kind": kind,
+           "cpu_count": os.cpu_count()}
+    return rec
+
+
+def _mesh_serve_cli(argv: list) -> dict:
+    """``python bench.py mesh_serve [--shapes 1x1,2x1,2x4] [--requests N]
+    [--concurrency N] [--seed N] [--max-batch N] [--window-ms X]
+    [--facts N]``. Re-execs itself onto enough virtual CPU host devices
+    when the current process is short (the dryrun_multichip pattern —
+    XLA device count is fixed at first backend init)."""
+    import os
+    import subprocess
+
+    kwargs: dict = {}
+    flags = {"--requests": ("n_requests", int),
+             "--concurrency": ("concurrency", int), "--seed": ("seed", int),
+             "--max-batch": ("max_batch", int),
+             "--window-ms": ("window_ms", float),
+             "--facts": ("n_facts", int)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--shapes" and i + 1 < len(argv):
+            kwargs["shapes"] = tuple(
+                tuple(int(x) for x in s.split("x"))
+                for s in argv[i + 1].split(","))
+            i += 2
+            continue
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"mesh_serve: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    import numpy as np
+
+    shapes = kwargs.get("shapes", ((1, 1), (2, 1), (2, 4)))
+    need = max(int(np.prod(s)) for s in shapes)
+    import jax
+
+    if len(jax.devices()) < need \
+            and os.environ.get("OPENCLAW_MESH_SERVE_CHILD") != "1":
+        env = dict(os.environ)
+        env["OPENCLAW_MESH_SERVE_CHILD"] = "1"  # no re-exec loops
+        env["JAX_PLATFORMS"] = "cpu"
+        xf = [f for f in env.get("XLA_FLAGS", "").split()
+              if "host_platform_device_count" not in f]
+        xf.append(f"--xla_force_host_platform_device_count={need}")
+        env["XLA_FLAGS"] = " ".join(xf)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "mesh_serve", *argv],
+            env=env, capture_output=True, text=True, timeout=1200)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"mesh_serve child failed (rc={proc.returncode}): "
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    return bench_mesh_serve(**kwargs)
+
+
 def bench_kernel_search(seq_lens: tuple = (128,), blocks: "tuple | None" = None,
                         steps: int = 3, rounds: int = 3, seed: int = 0,
                         state_path: "str | None" = None,
@@ -2396,6 +2683,19 @@ if __name__ == "__main__":
         rec = _serve_cli(sys.argv[2:])
         for srec in serve_stage_records(rec.get("serve_stage_quantiles")):
             print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+        print(json.dumps(rec, ensure_ascii=False))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "mesh_serve":
+        # Subcommand mode (ISSUE 15): ONE stdout line = the mesh-serving
+        # record; per-shape stage quantile lines (incl. the mesh-only
+        # shard/gather stages) ride on stderr like every secondary. The
+        # CLI re-execs onto virtual CPU host devices when needed, so this
+        # works from a plain single-device shell.
+        rec = _mesh_serve_cli(sys.argv[2:])
+        for shp, qs in (rec.get("mesh_serve_stage_quantiles") or {}).items():
+            for srec in mesh_serve_stage_records(qs):
+                srec["shape"] = shp
+                print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         print(json.dumps(rec, ensure_ascii=False))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "kernel_search":

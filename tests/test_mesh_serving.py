@@ -1,0 +1,503 @@
+"""Declarative-sharded mesh serving ≡ the single-device oracle (ISSUE 15).
+
+The serving path (governance stage-3 validator + knowledge embeddings) now
+routes through the checked-in sharding plan (parallel/plan.py): params
+placed per the per-family rule table (``validate_rule_table`` armed at
+plan load), one compiled variant per (cfg, mesh, spec) via lru_cache
+builders, shard/gather attributed in the serve StageTimer. These tests pin:
+
+- rule-table validation armed at load (dead rule / missing axis / unknown
+  family all raise at placement, not silently replicate),
+- mesh-served validator verdicts EQUAL to the one-shot single-device
+  oracle across seeded concurrent mixes and ≥3 mesh shapes (the trained
+  checkpoint's class margins dwarf the documented reduction-order
+  tolerance — docs/tpu-numerics.md),
+- data-parallel embeddings search parity (ids exact, scores within the
+  documented tolerance) through sync/remove churn,
+- checkpoint resharding: save on mesh A → restore on mesh B via the plan
+  → gathered bytes identical to the single-device restore, including the
+  degenerate 1-device mesh,
+- the ``serve.meshServing:false`` escape hatch restoring the PR-14 path
+  end-to-end, and the batcher registry keying on mesh shape,
+- interpreter teardown with live collectors (the atexit satellite).
+
+conftest forces the 8-device virtual CPU mesh, so every shape here runs
+in any environment the suite runs in.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from test_serve_batching import seeded_texts, serve_all
+
+MESH_SHAPES = ((1, 1), (2, 1), (2, 4))
+
+
+class _Log:
+    def info(self, *_a):
+        pass
+
+    warn = error = info
+
+
+def _mesh(shape, axes=("dp", "tp")):
+    from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+    return cached_mesh(tuple(shape), tuple(axes))
+
+
+def _tiny_cfg_params(seed=0):
+    import jax
+
+    from vainplex_openclaw_tpu.models import (
+        EncoderConfig, cast_params, init_params)
+
+    cfg = EncoderConfig(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                        n_layers=2, d_ff=128)
+    params = cast_params(init_params(jax.random.PRNGKey(seed), cfg),
+                         cfg.dtype)
+    return cfg, params
+
+
+# ── plan load + armed validation ─────────────────────────────────────
+
+
+class TestShardingPlan:
+    def test_known_families(self):
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        for family in ("encoder_validator", "embeddings_forward"):
+            plan = splan.serving_plan(family)
+            assert plan.family == family
+            assert plan.rules[-1][0] == ""  # explicit catch-all closes it
+
+    def test_unknown_family_raises(self):
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        with pytest.raises(KeyError, match="no sharding plan"):
+            splan.serving_plan("nonexistent_family")
+
+    def test_rules_win_on_real_params(self):
+        """Every rule in every shipped table wins on at least one real
+        encoder param path — the armed validate_rule_table contract."""
+        from vainplex_openclaw_tpu.analysis.sharding import validate_rule_table
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        _cfg, params = _tiny_cfg_params()
+        paths = splan.param_path_keys(params)
+        for family in ("encoder_validator", "embeddings_forward"):
+            plan = splan.serving_plan(family)
+            assert validate_rule_table(plan.rules, paths, regex=True) == []
+
+    def test_dead_rule_raises_at_load(self):
+        from jax.sharding import PartitionSpec as P
+
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        _cfg, params = _tiny_cfg_params()
+        bad = splan.ShardingPlan(
+            family="bad", rules=(("no_such_leaf$", P("tp")), ("", P())),
+            data_spec=P("dp"), axes=("dp", "tp"))
+        with pytest.raises(ValueError, match="rule-table validation"):
+            splan.plan_shardings(bad, params, _mesh((2, 4)))
+
+    def test_missing_mesh_axis_raises(self):
+        from jax.sharding import PartitionSpec as P
+
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        _cfg, params = _tiny_cfg_params()
+        plan = splan.serving_plan("encoder_validator")
+        with pytest.raises(ValueError, match="needs mesh axes"):
+            splan.plan_shardings(plan, params, _mesh((8,), axes=("dp",)))
+        del P
+
+    def test_uncovered_leaf_raises(self):
+        from jax.sharding import PartitionSpec as P
+
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        _cfg, params = _tiny_cfg_params()
+        with pytest.raises(ValueError, match="no partition rule matches"):
+            splan.match_partition_rules((("attn/q$", P(None, "tp")),), params)
+
+    def test_scalars_never_partition(self):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        tree = {"scalar": jnp.float32(3.0), "mat": jnp.ones((4, 4))}
+        specs = splan.match_partition_rules((("", P("dp")),), tree)
+        assert specs["scalar"] == P()
+        assert specs["mat"] == P("dp")
+
+    def test_specs_follow_the_table(self):
+        """Placed params carry the table's specs: QKV column-split, o/w2
+        row-split, norms + heads replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        _cfg, params = _tiny_cfg_params()
+        mesh = _mesh((2, 4))
+        placed = splan.sharded_params("spec-pin", params, mesh,
+                                      "encoder_validator")
+        b0 = placed["blocks"][0]
+        assert b0["attn"]["q"].sharding.spec == P(None, "tp")
+        assert b0["attn"]["o"].sharding.spec == P("tp", None)
+        assert b0["mlp"]["w2"].sharding.spec == P("tp", None)
+        assert b0["norm1"]["scale"].sharding.spec == P()
+        assert placed["heads"]["severity"].sharding.spec == P()
+
+    def test_serve_bucket_non_pow2_dp(self):
+        """Regression (review): a 6-device host auto-factors to dp3×tp2;
+        the bucket must round UP to a dp multiple, not floor at dp —
+        flooring left bucket 4 indivisible by 3 and place_tokens raised
+        mid-request. Power-of-two dp keeps the old values exactly."""
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        m3 = _mesh((3, 2))
+        assert splan.serve_bucket(1, m3) == 3
+        assert splan.serve_bucket(4, m3) == 6
+        assert splan.serve_bucket(7, m3) == 9   # pow2 8 → next mult of 3
+        m2 = _mesh((2, 4))
+        assert splan.serve_bucket(3, m2) == 4   # pow2 dp: unchanged floor
+        assert splan.serve_bucket(1, m2) == 2
+
+    def test_non_pow2_dp_serves_end_to_end(self):
+        """The dp3×tp2 mesh actually serves: every bucket the batcher can
+        form places + computes + matches the oracle."""
+        from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+
+        texts = seeded_texts(7, seed=31)
+        oracle = TestMeshValidatorParity._oracle(self)
+        ref = [oracle(t) for t in texts]
+        batcher = ContinuousBatcher(max_batch=4, window_ms=0.0,
+                                    autostart=False, mesh=_mesh((3, 2)))
+        try:
+            assert serve_all(batcher, texts) == ref
+        finally:
+            batcher.close()
+
+    def test_sharded_params_cache_pins_host_tree(self):
+        """Same (key, mesh, family) + same host tree → one placement; a
+        NEW host tree under the same key re-places (re-shipped
+        checkpoint must not serve stale weights)."""
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        _cfg, params = _tiny_cfg_params()
+        mesh = _mesh((2, 1))
+        a = splan.sharded_params("cache-pin", params, mesh,
+                                 "encoder_validator")
+        b = splan.sharded_params("cache-pin", params, mesh,
+                                 "encoder_validator")
+        assert a is b
+        _cfg2, fresh = _tiny_cfg_params(seed=5)
+        c = splan.sharded_params("cache-pin", fresh, mesh,
+                                 "encoder_validator")
+        assert c is not a
+
+
+# ── mesh-served validator ≡ one-shot oracle ──────────────────────────
+
+
+class TestMeshValidatorParity:
+    def _oracle(self):
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        call = make_local_call_llm(
+            serve_cfg={"continuousBatching": False}, force=True)
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import \
+            build_prompt
+
+        return lambda text: call(build_prompt(text, []))
+
+    @pytest.mark.parametrize("shape", MESH_SHAPES)
+    def test_verdicts_equal_oracle(self, shape):
+        from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+
+        texts = seeded_texts(22, seed=sum(shape))
+        oracle = self._oracle()
+        ref = [oracle(t) for t in texts]
+        batcher = ContinuousBatcher(max_batch=8, window_ms=0.0,
+                                    autostart=False, mesh=_mesh(shape))
+        try:
+            got = serve_all(batcher, texts)
+        finally:
+            batcher.close()
+        assert got == ref
+        assert batcher.stats()["mesh"] == "x".join(str(s) for s in shape)
+
+    def test_shard_gather_stages_attributed(self):
+        from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(max_batch=4, window_ms=0.0,
+                                    autostart=False, mesh=_mesh((2, 4)))
+        try:
+            serve_all(batcher, seeded_texts(6, seed=3))
+        finally:
+            batcher.close()
+        snap = batcher.timer.snapshot()
+        assert set(snap["stages_ms"]) >= {"queue", "batch", "shard",
+                                          "prefill", "gather", "decode"}
+
+    def test_single_device_path_has_no_shard_stage(self):
+        from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+
+        batcher = ContinuousBatcher(max_batch=4, window_ms=0.0,
+                                    autostart=False)
+        try:
+            serve_all(batcher, seeded_texts(4, seed=4))
+        finally:
+            batcher.close()
+        snap = batcher.timer.snapshot()
+        assert "shard" not in snap["stages_ms"]
+        assert "gather" not in snap["stages_ms"]
+
+    def test_zero_retraces_after_warmup(self):
+        """Same-bucket streams on a mesh compile NOTHING after the bucket
+        is warm — the compiled variant is shared through the lru_cache
+        builder, not rebuilt per batch."""
+        from vainplex_openclaw_tpu.analysis import RetraceWitness
+        from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+        from vainplex_openclaw_tpu.models.pretrained import load_pretrained
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        mesh = _mesh((2, 4))
+        cfg = load_pretrained(None)[0]
+        batcher = ContinuousBatcher(max_batch=4, window_ms=0.0,
+                                    autostart=False, mesh=mesh)
+        try:
+            serve_all(batcher, seeded_texts(4, seed=6))  # warm bucket 4
+            witness = RetraceWitness()
+            witness.probe("mesh_step", splan._build_serve_forward(
+                cfg, mesh, "encoder_validator"))
+            base = witness.baseline()
+            for s in (7, 8):
+                serve_all(batcher, seeded_texts(4, seed=s))
+            assert witness.traces("mesh_step") == base["mesh_step"]
+        finally:
+            batcher.close()
+
+
+# ── serve config: escape hatch + registry keying + atexit ────────────
+
+
+class TestServeConfig:
+    def teardown_method(self):
+        from vainplex_openclaw_tpu.models.serve import close_batchers
+
+        close_batchers()
+
+    def test_mesh_serving_e2e_and_escape_hatch(self):
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import \
+            build_prompt
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        oneshot = make_local_call_llm(
+            serve_cfg={"continuousBatching": False}, force=True)
+        meshy = make_local_call_llm(
+            serve_cfg={"meshServing": True, "meshShape": [2, 4],
+                       "windowMs": 0.0}, force=True)
+        plain = make_local_call_llm(force=True)
+        # escape hatch: meshServing defaults false → the PR-14 batcher,
+        # no mesh attached, exactly the pre-ISSUE-15 path
+        assert plain.batcher.mesh is None
+        assert meshy.batcher.mesh is not None
+        for text in seeded_texts(6, seed=9):
+            prompt = build_prompt(text, [])
+            assert meshy(prompt) == oneshot(prompt) == plain(prompt)
+
+    def test_registry_keys_on_mesh_shape(self):
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        base = {"windowMs": 0.0}
+        plain = make_local_call_llm(force=True, serve_cfg=dict(base))
+        mesh_a = make_local_call_llm(force=True, serve_cfg=dict(
+            base, meshServing=True, meshShape=[2, 4]))
+        mesh_a2 = make_local_call_llm(force=True, serve_cfg=dict(
+            base, meshServing=True, meshShape=[2, 4]))
+        mesh_b = make_local_call_llm(force=True, serve_cfg=dict(
+            base, meshServing=True, meshShape=[2, 1]))
+        # two mesh configs must not share a compiled batcher; equal
+        # configs must (that IS the continuous-batching win)
+        assert mesh_a.batcher is mesh_a2.batcher
+        assert mesh_a.batcher is not mesh_b.batcher
+        assert mesh_a.batcher is not plain.batcher
+
+    def test_atexit_closes_unclosed_collectors(self):
+        """A script that builds a serving closure and never calls
+        close_batchers must still exit cleanly: close_batchers is
+        registered via atexit (the collector daemon would otherwise be
+        parked inside jax at interpreter teardown)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from vainplex_openclaw_tpu.models.serve import make_local_call_llm\n"
+            "from vainplex_openclaw_tpu.governance.validation.llm_validator import build_prompt\n"
+            "call = make_local_call_llm(force=True)\n"
+            "print(call(build_prompt('the deploy failed with code 3', []))[:20])\n"
+            # no close_batchers(): atexit owns the teardown
+        )
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=240,
+                              env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                                   "HOME": "/tmp"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "verdict" in proc.stdout
+
+
+# ── data-parallel embeddings ─────────────────────────────────────────
+
+
+def _facts(n, seed=0):
+    from types import SimpleNamespace
+
+    rng = np.random.default_rng(seed)
+    subj = ("deploy", "db", "api", "release", "pipeline", "cache")
+    preds = ("failed-with", "depends-on", "improved", "blocked-by")
+    return [SimpleNamespace(id=f"f{i}", subject=str(rng.choice(subj)),
+                            predicate=str(rng.choice(preds)),
+                            object=f"thing-{int(rng.integers(0, 60))}",
+                            source="t", created_at="2026-08-03")
+            for i in range(n)]
+
+
+class TestMeshEmbeddings:
+    def _pair(self):
+        from vainplex_openclaw_tpu.knowledge.embeddings import \
+            create_embeddings
+
+        oracle = create_embeddings({"backend": "local"}, _Log())
+        mesh = create_embeddings(
+            {"backend": "local", "meshServing": True, "meshShape": [8]},
+            _Log())
+        return oracle, mesh
+
+    def test_search_parity_through_churn(self):
+        oracle, mesh = self._pair()
+        facts = _facts(41, seed=1)
+        oracle.sync(facts)
+        mesh.sync(facts)
+        queries = ("deploy failed", "cache depends", "api improved thing-3",
+                   "release blocked", "pipeline")
+        for q in queries:
+            a, b = oracle.search(q, k=5), mesh.search(q, k=5)
+            assert [r["id"] for r in a] == [r["id"] for r in b], q
+            assert max(abs(x["score"] - y["score"])
+                       for x, y in zip(a, b)) < 5e-3, q
+        # churn: remove + re-sync must invalidate the device arena copy
+        dead = ["f0", "f7", "f19"]
+        oracle.remove(dead)
+        mesh.remove(dead)
+        fresh = _facts(9, seed=2)
+        for f in fresh:
+            f.id = "g" + f.id
+        oracle.sync(fresh)
+        mesh.sync(fresh)
+        for q in queries:
+            a, b = oracle.search(q, k=5), mesh.search(q, k=5)
+            assert [r["id"] for r in a] == [r["id"] for r in b], q
+
+    def test_shard_stage_attributed_and_cached(self):
+        _oracle, mesh = self._pair()
+        mesh.sync(_facts(17, seed=3))
+        mesh.search("deploy failed", k=3)
+        shard_count = mesh.timer.snapshot()["counts"].get("shard", 0)
+        assert shard_count >= 1
+        # a second query against an unchanged arena re-uses the committed
+        # device copy — no second shard
+        mesh.search("cache depends", k=3)
+        assert mesh.timer.snapshot()["counts"]["shard"] == shard_count
+        # mutation dirties it
+        mesh.remove(["f1"])
+        mesh.search("api improved", k=3)
+        assert mesh.timer.snapshot()["counts"]["shard"] == shard_count + 1
+
+    def test_multi_dim_mesh_shape_flattens_to_dp(self):
+        """Regression (review): the plugin schema accepts any-length
+        meshShape, and the sibling serve config documents [2, 4] — the
+        dp-only embeddings plan must flatten it to its device count, not
+        crash Mesh construction at plugin load."""
+        from vainplex_openclaw_tpu.knowledge.embeddings import \
+            create_embeddings
+
+        oracle = create_embeddings({"backend": "local"}, _Log())
+        emb = create_embeddings(
+            {"backend": "local", "meshServing": True, "meshShape": [2, 4]},
+            _Log())
+        assert emb._mesh is not None
+        assert dict(emb._mesh.shape) == {"dp": 8}
+        facts = _facts(13, seed=5)
+        oracle.sync(facts)
+        emb.sync(facts)
+        a = oracle.search("deploy failed", k=4)
+        b = emb.search("deploy failed", k=4)
+        assert [r["id"] for r in a] == [r["id"] for r in b]
+
+    def test_mesh_off_is_the_oracle_path(self):
+        from vainplex_openclaw_tpu.knowledge.embeddings import \
+            create_embeddings
+
+        emb = create_embeddings({"backend": "local"}, _Log())
+        assert emb._mesh is None
+
+
+# ── checkpoint resharding ────────────────────────────────────────────
+
+
+class TestCheckpointResharding:
+    @pytest.mark.parametrize("save_shape", ((2, 4), (1, 1)))
+    def test_save_any_mesh_restore_any_mesh(self, tmp_path, save_shape):
+        """Property (ISSUE 15 satellite): save-on-mesh-A → load-on-mesh-B
+        → gather equals the single-device checkpoint BYTES, across ≥3
+        restore shapes including the degenerate 1-device mesh."""
+        import jax
+
+        from vainplex_openclaw_tpu.models import init_params
+        from vainplex_openclaw_tpu.models.checkpoint import (
+            restore_checkpoint, save_checkpoint)
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        cfg, _ = _tiny_cfg_params()
+        params = init_params(jax.random.PRNGKey(11), cfg)
+        like = init_params(jax.random.PRNGKey(12), cfg)
+        sharded = splan.sharded_params(("ckpt", tuple(save_shape)), params,
+                                       _mesh(save_shape),
+                                       "encoder_validator")
+        save_checkpoint(str(tmp_path), sharded, step=1)
+        oracle = restore_checkpoint(str(tmp_path), like=like)
+        flat_oracle = [np.asarray(jax.device_get(x))
+                       for x in jax.tree_util.tree_leaves(oracle)]
+        for restore_shape in MESH_SHAPES:
+            restored = restore_checkpoint(
+                str(tmp_path), like=like, mesh=_mesh(restore_shape),
+                plan="encoder_validator")
+            flat = jax.tree_util.tree_leaves(restored)
+            assert all(
+                np.array_equal(np.asarray(jax.device_get(a)), b)
+                for a, b in zip(flat, flat_oracle)), restore_shape
+            # and the restored leaves actually carry the plan's placement
+            n_sharded = sum(
+                1 for leaf in flat if len(leaf.sharding.device_set) > 1)
+            if int(np.prod(restore_shape)) > 1:
+                assert n_sharded > 0, restore_shape
+
+    def test_mesh_without_plan_raises(self, tmp_path):
+        import jax
+
+        from vainplex_openclaw_tpu.models import init_params
+        from vainplex_openclaw_tpu.models.checkpoint import (
+            restore_checkpoint, save_checkpoint)
+
+        cfg, _ = _tiny_cfg_params()
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        save_checkpoint(str(tmp_path), params, step=1)
+        with pytest.raises(ValueError, match="without a plan"):
+            restore_checkpoint(str(tmp_path), like=params,
+                               mesh=_mesh((2, 1)), plan=None)

@@ -62,7 +62,8 @@ CONFIG_SITES: tuple = (
      ("lifecycle_settings", "__init__")),
     ("vainplex_openclaw_tpu/models/serve.py",
      ("SERVE_DEFAULTS",), ("scfg", "serve_cfg"),
-     ("make_local_call_llm", "shared_batcher")),
+     ("make_local_call_llm", "shared_batcher", "_mesh_key",
+      "_resolve_mesh")),
 )
 
 

@@ -194,6 +194,25 @@ JIT_TABLE: tuple[JitEntry, ...] = (
         shape_policy=BUCKETED,
         builders=("LocalEmbeddings._ensure_model",),
     ),
+    JitEntry(
+        # Mesh-serving compiled variants (ISSUE 15): the declarative
+        # sharding plan's jitted forward + arena-score matmul, one
+        # compile cache per (cfg, mesh, plan family) via lru_cache
+        # builders — the PR-10 contract the ring/pipeline/long-context
+        # builders established.
+        module=f"{_PKG}/parallel/plan.py",
+        jit_fns=("_build_serve_forward.run", "_build_arena_scores.run"),
+        static=("cfg", "mesh", "family", "dp_axis"),
+        shape_policy=FIXED,
+        rationale="compiled variants are memoized per (cfg, mesh, plan "
+                  "family); every caller buckets its batch/row dim "
+                  "through serve_bucket (pow2 floored at the mesh dp "
+                  "size) + pad_rows before placement, so each mesh holds "
+                  "O(log N) programs — batching._run_batch, "
+                  "embeddings._embed/_scores, bench warmup included",
+        builders=("_build_serve_forward", "_build_arena_scores"),
+        entry_names=("serve_forward", "arena_scores"),
+    ),
 )
 
 

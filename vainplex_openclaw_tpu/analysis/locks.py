@@ -154,12 +154,16 @@ GUARDED: tuple = (
         cls="LocalEmbeddings",
         locks={
             "_lock": ("_arena", "_size", "_ids", "_pos", "_docs",
-                      "_query_cache", "query_cache_hits", "query_cache_misses"),
+                      "_query_cache", "query_cache_hits", "query_cache_misses",
+                      # mesh serving (ISSUE 15): the committed device arena
+                      # copy + its dirty flag ride the same lock — a sync's
+                      # in-place mutation must not race a search's commit.
+                      "_device_arena", "_device_arena_rows", "_arena_dirty"),
             # write-once lazy init: unguarded reads after init are safe.
             "_init_lock": ("_model", "_forward_jit"),
         },
         write_only=("_model", "_forward_jit"),
-        holders={"_reserve": ("_lock",)},
+        holders={"_reserve": ("_lock",), "_scores": ("_lock",)},
     ),
     GuardSpec(
         module="vainplex_openclaw_tpu/resilience/admission.py",

@@ -40,7 +40,10 @@ from .tracing import _dotted, _function_index, expanded_jit_functions
 _PKG = "vainplex_openclaw_tpu"
 
 # Calls that satisfy the bucketing requirement when present in a body.
-_BUCKET_GUARDS = frozenset({"pow2_bucket", "pad_rows", "_pad_vec"})
+# serve_bucket is the mesh-serving form (ISSUE 15): pow2_bucket floored
+# at the mesh dp size, same O(log N) shape space per mesh.
+_BUCKET_GUARDS = frozenset({"pow2_bucket", "pad_rows", "_pad_vec",
+                            "serve_bucket"})
 # jit/shard_map constructors the in-function rule watches for. (pallas_call
 # is NOT here: invoked inside a traced body it builds an op, not a cache.)
 _JIT_MAKERS = frozenset({"jit", "shard_map", "pjit"})

@@ -1,0 +1,48 @@
+"""JAX version-compat shims (ISSUE 15 satellite).
+
+The repo is written against the modern JAX surface; images in the wild pin
+older releases. One incompatibility accounts for the entire pre-PR-15
+tier-1 failure baseline (18 tests): ``shard_map`` renamed its replication-
+check knob ``check_rep`` → ``check_vma`` (and moved from
+``jax.experimental.shard_map`` to ``jax.shard_map``), so every
+``shard_map(..., check_vma=False)`` call raised TypeError on jax 0.4.x
+before any sharded code ran. PR 14 fixed the sibling skew
+(``pltpu.CompilerParams`` | ``TPUCompilerParams``) inside
+ops/flash_attention.py; this module is the shared home for the pattern —
+resolve the installed surface ONCE at import, by inspection rather than
+version-string parsing (vendored/backported builds lie about versions).
+
+Import discipline: modules that shard use ``from ..compat import
+shard_map`` and always spell the knob ``check_vma``; the shim forwards it
+under whatever name the installed jax accepts. No behavior change on
+modern jax — the wrapper collapses to a passthrough.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # jax ≥ 0.8
+except ImportError:  # pragma: no cover — older jax (the image's 0.4.x)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# Resolve the knob name by signature, not version: "check_vma" on modern
+# jax, "check_rep" on 0.4.x-era shard_map. A surface with neither (very
+# old experimental builds) gets the knob dropped — the check is advisory.
+_PARAMS = inspect.signature(_shard_map).parameters
+_REP_KW = ("check_vma" if "check_vma" in _PARAMS
+           else "check_rep" if "check_rep" in _PARAMS else None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kw):
+    """``jax.shard_map`` with the modern keyword surface on any jax.
+
+    Positional ``f`` keeps the ``functools.partial(shard_map, mesh=...,
+    in_specs=..., out_specs=..., check_vma=False)`` decorator idiom every
+    sharded builder in the repo uses working unchanged.
+    """
+    if _REP_KW is not None:
+        kw[_REP_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -140,7 +140,7 @@ class LocalEmbeddings:
     def __init__(self, logger, seed: int = 11, learned_weight: float = 0.5,
                  checkpoint_dir: Optional[str] = None,
                  timer: Optional[StageTimer] = None,
-                 query_cache_size: int = 256):
+                 query_cache_size: int = 256, mesh=None):
         self.logger = logger
         self.seed = seed
         self.learned_weight = learned_weight
@@ -149,6 +149,18 @@ class LocalEmbeddings:
         self._model = None
         self._forward_jit = None
         self.trace_count = 0  # bumped at jit-trace time: once per bucket shape
+        # Mesh serving (ISSUE 15): a jax Mesh (axes ("dp",)) routes _embed
+        # through the data-parallel "embeddings_forward" sharding plan
+        # (parallel/plan.py — replicated weights, batch over dp) and arena
+        # search through a dp-sharded score matmul. None keeps the
+        # single-device path verbatim — the equivalence oracle.
+        self._mesh = mesh
+        # Device-committed arena copy for mesh search: re-committed (and
+        # "shard"-attributed in the timer) only after host mutations —
+        # sync/remove flip the dirty flag under the lock.
+        self._device_arena = None
+        self._device_arena_rows = 0
+        self._arena_dirty = True
         # Maintenance syncs/removes run on a daemon thread while the serve
         # thread searches; in-place arena mutation (row overwrite, swap
         # compaction) would tear a concurrent matmul's view, so arena and
@@ -215,9 +227,29 @@ class LocalEmbeddings:
         # batch-independent in the encoder (masked pooling clamps the
         # denominator) and are sliced back out, so the jit cache holds
         # O(log N) shapes instead of one compile per distinct batch size.
-        padded = pad_rows(tokens, pow2_bucket(n))
-        learned = np.asarray(self._forward_jit(params, padded),
-                             dtype=np.float32)[:n]  # already L2-normed
+        if self._mesh is not None:
+            # Data-parallel mesh forward (ISSUE 15): bucket floored at dp
+            # so every shard holds ≥1 row; weights replicated per the
+            # embeddings_forward plan, N/dp rows per chip on full-store
+            # syncs. Tolerance vs the single-device oracle is documented
+            # in docs/tpu-numerics.md.
+            from ..parallel import plan as sharding_plan
+
+            padded = pad_rows(tokens, sharding_plan.serve_bucket(
+                n, self._mesh))
+            placed = sharding_plan.sharded_params(
+                (self.checkpoint_dir or "shipped-default", self.seed),
+                params, self._mesh, "embeddings_forward")
+            tokens_dev = sharding_plan.place_tokens(
+                padded, self._mesh, "embeddings_forward")
+            out = sharding_plan.serve_forward(
+                placed, tokens_dev, cfg, self._mesh, "embeddings_forward")
+            learned = np.asarray(out["embedding"],
+                                 dtype=np.float32)[:n]  # already L2-normed
+        else:
+            padded = pad_rows(tokens, pow2_bucket(n))
+            learned = np.asarray(self._forward_jit(params, padded),
+                                 dtype=np.float32)[:n]  # already L2-normed
 
         # Vectorized bag-of-tokens: one flat scatter-add over (row, token)
         # pair indices instead of a per-row Python loop — and not bincount,
@@ -293,7 +325,36 @@ class LocalEmbeddings:
                     self._pos[fact.id] = self._size
                     self._ids.append(fact.id)
                     self._size += 1
+                self._arena_dirty = True
         return len(facts)
+
+    def _scores(self, q: np.ndarray, size: int) -> np.ndarray:
+        """Scores for the live arena rows — callers hold ``self._lock``.
+        Single-device: the numpy BLAS matmul (the oracle). Mesh: rows
+        sharded over dp through the compiled plan variant; the committed
+        device copy survives across queries and re-commits (attributed as
+        the ``shard`` stage) only after host mutations."""
+        if self._mesh is None:
+            return self._arena[:size] @ q
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import plan as sharding_plan
+
+        rows = sharding_plan.serve_bucket(size, self._mesh)
+        if self._arena_dirty or self._device_arena_rows != rows:
+            with self.timer.stage("shard"):
+                padded = np.zeros((rows, self._arena.shape[1]), np.float32)
+                padded[:size] = self._arena[:size]
+                self._device_arena = jax.device_put(
+                    padded, NamedSharding(self._mesh, P("dp", None)))
+                self._device_arena_rows = rows
+                self._arena_dirty = False
+        q_dev = jax.device_put(q.astype(np.float32, copy=False),
+                               NamedSharding(self._mesh, P()))
+        scores = np.asarray(sharding_plan.arena_scores(
+            self._device_arena, q_dev, self._mesh))
+        return scores[:size]
 
     def search(self, query: str, k: int = 5) -> list[dict]:
         if self._size == 0:
@@ -304,7 +365,7 @@ class LocalEmbeddings:
                 size = self._size
                 if size == 0:  # raced with a remove draining the arena
                     return []
-                scores = self._arena[:size] @ q
+                scores = self._scores(q, size)
                 if 0 < k < size:
                     # argpartition gives the kth-largest score in O(n); keep
                     # every index at or above it so boundary ties are broken
@@ -340,6 +401,7 @@ class LocalEmbeddings:
                     self._pos[moved] = row
                 self._ids.pop()
                 self._size -= 1
+            self._arena_dirty = True
         return len(dead)
 
     def count(self) -> int:
@@ -352,7 +414,25 @@ def create_embeddings(config: dict, logger, http_post: Callable = _default_http_
     if backend == "chroma":
         return ChromaEmbeddings(config, logger, http_post)
     if backend == "local":
+        mesh = None
+        if (config or {}).get("meshServing"):
+            # Opt-in (like serve.meshServing): builds the dp mesh NOW — a
+            # deliberate eager jax touch, because a serving config that
+            # cannot get its devices must fail at construction, not on
+            # the first sync. meshShape null = every local device. The
+            # embeddings plan is dp-only, so a multi-dim shape (the serve
+            # config's [2, 4] form, which the schema accepts) flattens to
+            # its device count instead of crashing Mesh construction.
+            import math
+
+            import jax
+
+            from ..parallel.mesh import cached_mesh
+
+            shape = (config or {}).get("meshShape") or (len(jax.devices()),)
+            n = math.prod(int(s) for s in shape)
+            mesh = cached_mesh((n,), ("dp",))
         return LocalEmbeddings(logger,
                                checkpoint_dir=(config or {}).get("checkpointDir"),
-                               timer=timer)
+                               timer=timer, mesh=mesh)
     return None

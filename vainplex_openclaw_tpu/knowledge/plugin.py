@@ -37,7 +37,12 @@ DEFAULTS = {
     "llm": {"enabled": False, "batchSize": 3},
     "embeddings": {"backend": "local", "enabled": True,
                    "endpoint": "http://localhost:8000/api/v2/collections/{name}/upsert",
-                   "collectionName": "openclaw-facts"},
+                   "collectionName": "openclaw-facts",
+                   # ISSUE 15: data-parallel mesh for local embeddings —
+                   # batched _embed + arena search shard over dp
+                   # (parallel/plan.py "embeddings_forward" plan).
+                   # Default off: the single-device path is the oracle.
+                   "meshServing": False, "meshShape": None},
     "maintenance": {"decayHours": 24, "syncMinutes": 30},
 }
 
@@ -61,7 +66,9 @@ MANIFEST = PluginManifest(
             "embeddings": enabled_section(
                 backend={"type": "string", "enum": ["local", "chroma", "none"]},
                 endpoint={"type": "string"},
-                collectionName={"type": "string"}),
+                collectionName={"type": "string"},
+                meshServing={"type": "boolean"},
+                meshShape={"type": ["array", "null"]}),
             "maintenance": {"type": "object", "properties": {
                 "decayHours": {"type": "number", "minimum": 0},
                 "syncMinutes": {"type": "number", "minimum": 0}}},

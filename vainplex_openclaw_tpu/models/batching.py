@@ -88,7 +88,8 @@ class ContinuousBatcher:
                  max_batch: int = 32, window_ms: float = 2.0,
                  admission=None, timer: Optional[StageTimer] = None,
                  clock: Callable[[], float] = time.perf_counter,
-                 autostart: bool = True):
+                 autostart: bool = True, mesh=None,
+                 plan_family: str = "encoder_validator"):
         from .pretrained import available
 
         if not available(checkpoint_dir):
@@ -97,6 +98,15 @@ class ContinuousBatcher:
             raise RuntimeError(
                 "continuous batching serve path refused: no trained "
                 f"checkpoint at {checkpoint_dir or 'the shipped default'}")
+        # Mesh serving (ISSUE 15): a jax Mesh routes _run_batch through the
+        # declarative sharding plan (parallel/plan.py) — params placed per
+        # the family rule table (validate_rule_table armed at placement),
+        # one compiled variant per (cfg, mesh, spec) via the lru_cache
+        # builders, shard/gather overhead attributed in the StageTimer.
+        # None keeps the PR-14 single-device forward verbatim (the
+        # equivalence oracle behind serve.meshServing:false).
+        self.mesh = mesh
+        self.plan_family = plan_family
         self.checkpoint_dir = checkpoint_dir
         self.max_batch = max(1, int(max_batch))
         self.window_ms = float(window_ms)
@@ -213,13 +223,47 @@ class ContinuousBatcher:
         cfg, params = loaded
         tokens = encode_texts([r.text for r in batch], cfg.seq_len,
                               cfg.vocab_size)
-        padded = pad_rows(tokens, pow2_bucket(len(batch)))
-        t1 = self._clock()
-        self.timer.add("batch", (t1 - t0) * 1e3)
-        out = forward(params, padded, cfg)
-        severity = np.asarray(out["severity"])  # blocks until ready
-        t2 = self._clock()
-        self.timer.add("prefill", (t2 - t1) * 1e3)
+        if self.mesh is not None:
+            # Mesh-served step: bucket floored at the dp size so every
+            # shard holds ≥1 row (still O(log N) compiled shapes), then
+            # shard → compiled mesh forward → gather, each attributed.
+            import os
+
+            import jax
+
+            from ..parallel import plan as sharding_plan
+
+            padded = pad_rows(tokens, sharding_plan.serve_bucket(
+                len(batch), self.mesh))
+            t1 = self._clock()
+            self.timer.add("batch", (t1 - t0) * 1e3)
+            from .pretrained import DEFAULT_DIR
+
+            ckpt_key = os.path.abspath(self.checkpoint_dir or DEFAULT_DIR)
+            placed_params = sharding_plan.sharded_params(
+                ckpt_key, params, self.mesh, self.plan_family)
+            placed_tokens = sharding_plan.place_tokens(
+                padded, self.mesh, self.plan_family)
+            t_sh = self._clock()
+            self.timer.add("shard", (t_sh - t1) * 1e3)
+            out = sharding_plan.serve_forward(
+                placed_params, placed_tokens, cfg, self.mesh,
+                self.plan_family)
+            jax.block_until_ready(out["severity"])
+            t2 = self._clock()
+            self.timer.add("prefill", (t2 - t_sh) * 1e3)
+            severity = np.asarray(out["severity"])  # replicated: one copy
+            t_g = self._clock()
+            self.timer.add("gather", (t_g - t2) * 1e3)
+            t2 = t_g
+        else:
+            padded = pad_rows(tokens, pow2_bucket(len(batch)))
+            t1 = self._clock()
+            self.timer.add("batch", (t1 - t0) * 1e3)
+            out = forward(params, padded, cfg)
+            severity = np.asarray(out["severity"])  # blocks until ready
+            t2 = self._clock()
+            self.timer.add("prefill", (t2 - t1) * 1e3)
         classes = severity[:len(batch)].argmax(axis=-1)
         for req, cls in zip(batch, classes):
             req.result = render_verdict(int(cls))
@@ -242,7 +286,9 @@ class ContinuousBatcher:
         with self._lock:
             base = {"served": self.served, "batches": self.batches,
                     "shed": self.shed, "queued": len(self._queue),
-                    "maxBatch": self.max_batch, "windowMs": self.window_ms}
+                    "maxBatch": self.max_batch, "windowMs": self.window_ms,
+                    "mesh": ("x".join(str(s) for s in self.mesh.shape.values())
+                             if self.mesh is not None else None)}
         base["meanBatch"] = round(base["served"] / base["batches"], 2) \
             if base["batches"] else 0.0
         if self.admission is not None:

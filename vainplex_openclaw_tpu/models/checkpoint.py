@@ -146,10 +146,20 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
 
 
 def restore_checkpoint(ckpt_dir: str, like: Any,
-                       step: Optional[int] = None) -> Any:
+                       step: Optional[int] = None,
+                       mesh=None, plan=None) -> Any:
     """Restore the checkpoint at ``step`` (default: latest) into the tree
     structure of ``like``, placing each leaf with the sharding of the
-    corresponding ``like`` leaf (host numpy leaves stay numpy)."""
+    corresponding ``like`` leaf (host numpy leaves stay numpy).
+
+    **Resharding on load** (ISSUE 15): pass ``mesh`` (a jax Mesh) plus
+    ``plan`` (a :class:`~..parallel.plan.ShardingPlan` or a family name
+    from its ``PLAN_TABLE``) and every restored leaf is placed per the
+    plan's rule table instead of ``like``'s shardings — a checkpoint
+    written on any mesh (the npz is always gathered host bytes) restores
+    straight onto any other mesh shape, single-device included.
+    ``validate_rule_table`` is armed through the plan-spec match, so a
+    rule that matches nothing fails the restore loudly."""
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
@@ -161,9 +171,23 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
     with open(mpath) as f:
         dtypes = json.load(f).get("dtypes", {})
 
+    flat_shardings = None
+    if mesh is not None:
+        from ..parallel.plan import plan_shardings as _plan_shardings
+        from ..parallel.plan import serving_plan
+
+        if plan is None:
+            raise ValueError("restore_checkpoint: mesh given without a plan")
+        if isinstance(plan, str):
+            plan = serving_plan(plan)
+        # Armed validation + rule match over the TEMPLATE tree (same paths
+        # and shapes as the checkpoint), then one NamedSharding per leaf
+        # in flatten order (NamedShardings are pytree leaves themselves).
+        flat_shardings = jax.tree_util.tree_leaves(
+            _plan_shardings(plan, like, mesh))
     leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
     restored = []
-    for leaf_path, leaf in leaves:
+    for i, (leaf_path, leaf) in enumerate(leaves):
         key = _path_key(leaf_path)
         if key not in arrays:
             raise KeyError(f"checkpoint {path} missing leaf {key!r}")
@@ -171,6 +195,11 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
         saved_dtype = _resolve_dtype(dtypes[key]) if key in dtypes else arr.dtype
         if arr.dtype != saved_dtype:  # stored as a same-itemsize uint view
             arr = arr.view(saved_dtype)
+        if flat_shardings is not None:
+            target_dtype = leaf.dtype if hasattr(leaf, "dtype") else arr.dtype
+            arr = arr.astype(target_dtype) if arr.dtype != target_dtype else arr
+            restored.append(jax.device_put(arr, flat_shardings[i]))
+            continue
         if isinstance(leaf, jax.Array):
             sharding = getattr(leaf, "sharding", None)
             arr = arr.astype(leaf.dtype) if arr.dtype != leaf.dtype else arr

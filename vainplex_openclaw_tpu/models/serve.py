@@ -19,6 +19,7 @@ keep this as the degraded-mode fallback.
 
 from __future__ import annotations
 
+import atexit
 import threading
 from typing import Callable, Optional
 
@@ -44,6 +45,18 @@ SERVE_DEFAULTS = {
     # degraded verdict (docs/serving-perf.md). resilience/admission.py
     # documents the remaining knobs.
     "admission": {"enabled": True, "highWatermark": 128},
+    # Mesh serving (ISSUE 15): route the batcher's step through the
+    # declarative sharding plan (parallel/plan.py) over a jax Mesh —
+    # tensor-parallel encoder forward for stage-3 validation. Default OFF
+    # like cluster.enabled: it is a deployment choice (needs a
+    # multi-device process), and `false` IS the PR-14 single-device path
+    # verbatim — the equivalence oracle, never deleted. meshShape null =
+    # auto-factor all local devices over meshAxes; an explicit shape
+    # ([2, 4]) is the inspectable artifact deployments should pin
+    # (docs/serving-perf.md, tolerance contract in docs/tpu-numerics.md).
+    "meshServing": False,
+    "meshShape": None,
+    "meshAxes": ["dp", "tp"],
 }
 
 # Markers from llm_validator.build_prompt — the MESSAGE body is embedded
@@ -71,12 +84,42 @@ _batchers: dict = {}
 _batchers_lock = threading.Lock()
 
 
+def _mesh_key(serve_cfg: dict):
+    """Hashable mesh identity for the batcher registry: two mesh configs
+    must NOT share a compiled batcher (distinct meshes = distinct compile
+    caches and param placements). None when mesh serving is off."""
+    if not serve_cfg.get("meshServing"):
+        return None
+    shape = serve_cfg.get("meshShape")
+    return (tuple(int(s) for s in shape) if shape is not None else "auto",
+            tuple(serve_cfg.get("meshAxes") or ("dp", "tp")))
+
+
+def _resolve_mesh(serve_cfg: dict):
+    """jax Mesh for the serving config, or None when mesh serving is off.
+    Shared through parallel/mesh.cached_mesh so equal configs get ONE
+    Mesh object — the lru_cache-keyed compiled variants depend on it."""
+    if not serve_cfg.get("meshServing"):
+        return None
+    import jax
+
+    from ..parallel.mesh import _factor, cached_mesh
+
+    axes = tuple(serve_cfg.get("meshAxes") or ("dp", "tp"))
+    shape = serve_cfg.get("meshShape")
+    if shape is None:
+        n = len(jax.devices())
+        shape = (n,) if len(axes) == 1 else _factor(n) + (1,) * (len(axes) - 2)
+    return cached_mesh(tuple(int(s) for s in shape), axes)
+
+
 def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict):
     from ..resilience.admission import AdmissionController
     from .batching import ContinuousBatcher
 
     key = (checkpoint_dir, serve_cfg["maxBatch"], serve_cfg["windowMs"],
-           tuple(sorted((serve_cfg.get("admission") or {}).items())))
+           tuple(sorted((serve_cfg.get("admission") or {}).items())),
+           _mesh_key(serve_cfg))
     with _batchers_lock:
         batcher = _batchers.get(key)
         if batcher is None:
@@ -85,7 +128,8 @@ def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict):
                 max_batch=serve_cfg["maxBatch"],
                 window_ms=serve_cfg["windowMs"],
                 admission=AdmissionController.from_config(
-                    serve_cfg.get("admission")))
+                    serve_cfg.get("admission")),
+                mesh=_resolve_mesh(serve_cfg))
             _batchers[key] = batcher
         return batcher
 
@@ -97,6 +141,14 @@ def close_batchers() -> None:
         _batchers.clear()
     for b in batchers:
         b.close()
+
+
+# Collector threads are daemons, but a daemon parked inside jax/XLA
+# during interpreter teardown can still segfault or hang CPython's exit
+# (scripts that build a validator and never call close_batchers). Closing
+# at atexit drains and joins them while the runtime is intact; a second
+# explicit close stays a no-op (the registry is cleared under its lock).
+atexit.register(close_batchers)
 
 
 def make_local_call_llm(checkpoint_dir: Optional[str] = None,

@@ -13,6 +13,7 @@ TPU slice, host memory on the virtual CPU mesh used in tests.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional, Sequence
 
 import jax
@@ -45,6 +46,17 @@ def make_mesh(n_devices: Optional[int] = None, axes: Sequence[str] = ("dp", "tp"
             shape = (dp, tp) + (1,) * (len(axes) - 2)
     arr = np.array(devices[:n]).reshape(tuple(shape))
     return Mesh(arr, tuple(axes))
+
+
+@lru_cache(maxsize=8)
+def cached_mesh(shape: tuple, axes: tuple = ("dp", "tp")) -> Mesh:
+    """Memoized mesh for serving: every caller asking for the same
+    (shape, axes) shares ONE Mesh object, so lru_cache-keyed compiled
+    variants (parallel/plan.py builders, the batcher's mesh step) hit one
+    compile cache per configuration instead of re-tracing against equal-
+    but-distinct meshes."""
+    n = int(np.prod(shape))
+    return make_mesh(n_devices=n, axes=tuple(axes), shape=tuple(shape))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -22,10 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map  # jax ≥ 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+# version-agnostic shard_map (check_vma on any jax — see compat.py)
+from ..compat import shard_map
 
 
 def stack_stage_params(block_params: list, n_stages: int):

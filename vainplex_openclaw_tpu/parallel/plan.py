@@ -1,0 +1,309 @@
+"""Declarative sharding plans for mesh serving (ISSUE 15, ROADMAP item 2).
+
+`MULTICHIP_r05` proves dp×tp training, pp pipelines, and ring+flash parity
+on 8-device dryruns — but until this module the *serving* path (the
+governance stage-3 validator and the knowledge embeddings, the half that
+fronts live traffic) ran single-device while the rest of the mesh idled.
+This module is the TACCL-shaped answer: the communication/placement
+schedule is an explicit, checked-in, lintable artifact — one rule table
+per servable model family — rather than emergent behavior scattered
+across call sites.
+
+Three layers:
+
+- **Rule tables** (`ENCODER_VALIDATOR_RULES`, `EMBEDDINGS_FORWARD_RULES`)
+  — regex → ``PartitionSpec`` over "/"-joined param-tree paths, first
+  match wins (the SNIPPETS ``match_partition_rules`` shape). They are
+  plain list literals so tracelint's GL-SHARD-RULE pass lints them
+  statically (dup/shadow/bad-regex), and ``validate_rule_table`` is ARMED
+  at every plan load against the real param paths — a dead rule (typo, or
+  params renamed) raises at placement time, not just in dryrun_multichip.
+- **Placement** (`plan_shardings` / `sharded_params`) — params are
+  ``device_put`` onto the mesh per the table once and cached per
+  (key, mesh, family); serving requests never re-place weights.
+- **Compiled variants** (`_build_serve_forward` / `_build_arena_scores`)
+  — ``lru_cache`` builders keyed on (cfg, mesh, family) per the PR-10
+  tracelint contract (a jit built per call is a guaranteed retrace;
+  memoized builders share one compile cache per mesh/spec). Outputs are
+  replicated (``P()``) so the host gather is one copy, and the batch dim
+  is bucketed by every caller (``pad_rows`` to
+  ``max(pow2_bucket(n), dp)``) so the compile cache stays O(log N) per
+  mesh.
+
+The single-device path stays the equivalence oracle behind
+``serve.meshServing:false`` (models/serve.py) and the embeddings config
+(docs/serving-perf.md, tolerance contract in docs/tpu-numerics.md).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# ── param-tree paths ─────────────────────────────────────────────────
+
+
+def _path_key(path) -> str:
+    """Stable "/"-joined key for one tree path — the same rendering
+    models/checkpoint.py uses for npz keys, so a rule table written
+    against checkpoint leaf names matches live param trees verbatim."""
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(getattr(p, "key", p)))
+    return "/".join(parts)
+
+
+def param_path_keys(params) -> list:
+    """"/"-joined path strings for every leaf, in flatten order."""
+    return [_path_key(path) for path, _ in
+            jax.tree_util.tree_flatten_with_path(params)[0]]
+
+
+# ── rule tables: one checked-in artifact per servable family ─────────
+
+# Stage-3 validator encoder, tensor-parallel (Megatron layout): QKV and
+# the MLP expand column-split over tp, output/contract row-split → one
+# psum per block rides the mesh fabric; embeddings split over d_model.
+# Norm scales, heads, and every future leaf fall through to the final
+# catch-all: replicated. Same layout the dp×tp train dryrun proves
+# (__graft_entry__._dryrun_impl section 1), promoted from an inline list
+# to the checked-in serving artifact.
+ENCODER_VALIDATOR_RULES = [
+    ("attn/q$", P(None, "tp")),
+    ("attn/k$", P(None, "tp")),
+    ("attn/v$", P(None, "tp")),
+    ("attn/o$", P("tp", None)),
+    ("mlp/w1$", P(None, "tp")),
+    ("mlp/w2$", P("tp", None)),
+    ("embed/tok$", P(None, "tp")),
+    ("embed/pos$", P(None, "tp")),
+    ("", P()),
+]
+
+# Knowledge embeddings forward, pure data-parallel: weights replicated
+# (the tiny encoder is KB-scale — replication is free, collectives are
+# not), batch sharded over dp. The win is N embedding rows per step per
+# chip on full-store syncs.
+EMBEDDINGS_FORWARD_RULES = [
+    ("", P()),
+]
+
+
+@dataclass(frozen=True)
+class ShardingPlan:
+    """One servable family's placement contract.
+
+    ``rules``: ((regex, PartitionSpec), …) over "/"-joined param paths,
+    first match wins. ``data_spec``: how the batch (tokens / arena rows)
+    shards. ``axes``: mesh axis names the plan's specs may reference —
+    ``for_mesh`` checks them against the actual mesh at load."""
+
+    family: str
+    rules: tuple
+    data_spec: P
+    axes: tuple
+    description: str = ""
+
+
+PLAN_TABLE: dict = {
+    "encoder_validator": ShardingPlan(
+        family="encoder_validator",
+        rules=tuple(ENCODER_VALIDATOR_RULES),
+        data_spec=P("dp"),
+        axes=("dp", "tp"),
+        description="stage-3 validator encoder: batch over dp, Megatron "
+                    "tensor-parallel weights over tp"),
+    "embeddings_forward": ShardingPlan(
+        family="embeddings_forward",
+        rules=tuple(EMBEDDINGS_FORWARD_RULES),
+        data_spec=P("dp"),
+        axes=("dp",),
+        description="knowledge embeddings: replicated weights, batch and "
+                    "arena rows over dp"),
+}
+
+
+def serving_plan(family: str) -> ShardingPlan:
+    plan = PLAN_TABLE.get(family)
+    if plan is None:
+        raise KeyError(
+            f"no sharding plan for family {family!r} — known: "
+            f"{sorted(PLAN_TABLE)}")
+    return plan
+
+
+# ── rule matching + armed validation ─────────────────────────────────
+
+
+def match_partition_rules(rules, params):
+    """Pytree of PartitionSpec from first-match-wins regex rules (the
+    SNIPPETS shape). Scalars and 1-element leaves never partition; a leaf
+    no rule matches raises — a silently-replicated param is exactly the
+    failure mode the rule table exists to prevent (close the table with
+    an explicit ("", P()) catch-all instead)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            specs.append(P())
+            continue
+        key = _path_key(path)
+        for pattern, spec in rules:
+            if re.search(pattern, key):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(f"no partition rule matches param {key!r}")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def plan_shardings(plan: ShardingPlan, params, mesh: Mesh):
+    """NamedSharding pytree for ``params`` on ``mesh`` per the plan.
+
+    ``validate_rule_table`` (analysis/sharding.py — the GL-SHARD-RULE
+    runtime contract) is ARMED here, at plan load: every rule must WIN on
+    at least one real param path, so a dead or shadowed rule fails the
+    placement loudly instead of silently replicating what it was supposed
+    to shard. The mesh must declare every axis the plan references."""
+    from ..analysis.sharding import validate_rule_table
+
+    missing = [a for a in plan.axes if a not in mesh.shape]
+    if missing:
+        raise ValueError(
+            f"plan {plan.family!r} needs mesh axes {missing} but the mesh "
+            f"declares {tuple(mesh.shape)}")
+    problems = validate_rule_table(plan.rules, param_path_keys(params),
+                                   regex=True)
+    if problems:
+        raise ValueError(
+            f"sharding plan {plan.family!r} failed rule-table validation: "
+            + "; ".join(problems))
+    specs = match_partition_rules(plan.rules, params)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+# ── cached placement ─────────────────────────────────────────────────
+
+_sharded_params: dict = {}
+_sharded_lock = threading.Lock()
+
+
+def sharded_params(key, params, mesh: Mesh, family: str):
+    """Place a host param tree onto ``mesh`` per the family plan, cached
+    per (key, mesh, family) — ``key`` is any hashable identity for the
+    tree (the serve path uses the resolved checkpoint dir). The cache
+    entry pins the host tree it was placed from and hits only while the
+    caller passes that same tree — a cleared/re-shipped checkpoint
+    (models/pretrained.clear_cache) re-places instead of serving stale
+    weights. Placement (slow) runs outside the lock; a racing
+    double-place resolves through one more get-or-store."""
+    ck = (key, mesh, family)
+    with _sharded_lock:
+        hit = _sharded_params.get(ck)
+    if hit is not None and hit[0] is params:
+        return hit[1]
+    placed = jax.device_put(params,
+                            plan_shardings(serving_plan(family), params, mesh))
+    with _sharded_lock:
+        hit = _sharded_params.get(ck)
+        if hit is not None and hit[0] is params:
+            return hit[1]
+        _sharded_params[ck] = (params, placed)
+    return placed
+
+
+def clear_plan_caches() -> None:
+    """Drop cached placements + compiled variants (tests / re-ship)."""
+    with _sharded_lock:
+        _sharded_params.clear()
+    _build_serve_forward.cache_clear()
+    _build_arena_scores.cache_clear()
+
+
+# ── compiled variants (PR-10 contract: memoized builders) ────────────
+
+
+@lru_cache(maxsize=16)
+def _build_serve_forward(cfg, mesh: Mesh, family: str):
+    """Jitted mesh-serving encoder forward, memoized per (cfg, mesh,
+    family). Inputs arrive committed (params via :func:`sharded_params`,
+    tokens via :func:`place_tokens`) so GSPMD reads the placement off the
+    arguments; outputs replicate (P()) so the verdict gather is one
+    device→host copy."""
+    from ..models import forward
+
+    out_sharding = NamedSharding(mesh, P())
+
+    @partial(jax.jit, out_shardings=out_sharding)
+    def run(params, tokens):
+        return forward(params, tokens, cfg)
+
+    return run
+
+
+def serve_forward(params, tokens, cfg, mesh: Mesh,
+                  family: str = "encoder_validator"):
+    """Mesh-compiled encoder forward for the serve path. Callers own the
+    batch-shape discipline: bucket through
+    ``pad_rows(tokens, serve_bucket(n, mesh))`` before placing."""
+    return _build_serve_forward(cfg, mesh, family)(params, tokens)
+
+
+@lru_cache(maxsize=8)
+def _build_arena_scores(mesh: Mesh, dp_axis: str):
+    """Jitted arena score matmul (rows sharded over dp, query replicated,
+    scores replicated out), memoized per (mesh, axis)."""
+    out_sharding = NamedSharding(mesh, P())
+
+    @partial(jax.jit, out_shardings=out_sharding)
+    def run(arena, q):
+        return arena @ q
+
+    return run
+
+
+def arena_scores(arena, q, mesh: Mesh, dp_axis: str = "dp"):
+    """Data-parallel cosine scores: ``arena [N, D] @ q [D]`` with rows
+    sharded over ``dp``. Callers pad N to a dp multiple (zero rows score
+    0.0 and are sliced away host-side)."""
+    return _build_arena_scores(mesh, dp_axis)(arena, q)
+
+
+def serve_bucket(n: int, mesh: Mesh, dp_axis: str = "dp") -> int:
+    """Batch bucket for a mesh: the pow2 bucket rounded UP to a dp
+    multiple, so every shard holds ≥1 row and the data spec always
+    divides evenly — including non-power-of-two dp (a 6-device host
+    auto-factors to dp3×tp2; flooring at dp left bucket 4 indivisible
+    by 3 and place_tokens raising mid-request). For power-of-two dp
+    this is exactly the old floor. Still one bucket per pow2 bucket,
+    so the compile cache stays O(log N) per mesh."""
+    from ..ops.similarity import pow2_bucket
+
+    b = pow2_bucket(max(n, 1))
+    dp = mesh.shape.get(dp_axis, 1)
+    return -(-b // dp) * dp
+
+
+def place_tokens(tokens, mesh: Mesh, family: str = "encoder_validator"):
+    """Commit a (bucketed) token batch onto the mesh with the plan's data
+    spec — the serve path's explicit "shard" step, timed separately so
+    shard overhead shows up attributed in the serve StageTimer."""
+    plan = serving_plan(family)
+    return jax.device_put(np.asarray(tokens),
+                          NamedSharding(mesh, plan.data_spec))

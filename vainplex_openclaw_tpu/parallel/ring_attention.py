@@ -23,10 +23,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map  # jax ≥ 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+# version-agnostic shard_map: accepts check_vma on any jax (compat.py
+# forwards it as check_rep on 0.4.x — the old import-try here left every
+# call raising TypeError on pre-rename releases)
+from ..compat import shard_map
 
 NEG_INF = -1e30  # finite: keeps fully-masked rows NaN-free through exp()
 
