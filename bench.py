@@ -2132,6 +2132,7 @@ def bench_mesh_serve(shapes: tuple = ((1, 1), (2, 1), (2, 4)),
     retraces_by_shape: dict = {}
     mean_batch: dict = {}
     stage_quantiles: dict = {}
+    plan_provenance: dict = {}
     for shape in shapes:
         mesh = cached_mesh(shape)
         batcher = ContinuousBatcher(max_batch=max_batch,
@@ -2142,20 +2143,24 @@ def bench_mesh_serve(shapes: tuple = ((1, 1), (2, 1), (2, 4)),
             # construction — same discipline as bench_serve_latency.
             from vainplex_openclaw_tpu.models import encode_texts
 
+            # Resolve the serving plan ONCE (searched table > hand-written
+            # — ISSUE 16) so warmup buckets, placement, and the probed
+            # compiled variant are exactly what the batcher will use.
+            plan = sharding_plan.resolve_plan("encoder_validator", mesh)
             placed_params = sharding_plan.sharded_params(
-                "bench-warm", loaded[1], mesh, "encoder_validator")
-            buckets = sorted({sharding_plan.serve_bucket(b, mesh)
+                "bench-warm", loaded[1], mesh, plan)
+            buckets = sorted({sharding_plan.serve_bucket(b, mesh, plan=plan)
                               for b in range(1, max_batch + 1)})
             for b in buckets:
                 toks = pad_rows(encode_texts(["warmup"], cfg.seq_len,
                                              cfg.vocab_size), b)
                 np.asarray(sharding_plan.serve_forward(
-                    placed_params, sharding_plan.place_tokens(toks, mesh),
-                    cfg, mesh)["severity"])
+                    placed_params,
+                    sharding_plan.place_tokens(toks, mesh, plan),
+                    cfg, mesh, plan)["severity"])
 
             witness = RetraceWitness()
-            compiled = sharding_plan._build_serve_forward(
-                cfg, mesh, "encoder_validator")
+            compiled = sharding_plan._build_serve_forward(cfg, mesh, plan)
             witness.probe("mesh_forward", compiled)
             base = witness.baseline()
 
@@ -2191,6 +2196,16 @@ def bench_mesh_serve(shapes: tuple = ((1, 1), (2, 1), (2, 4)),
                     f"mesh_serve[{shape_name(shape)}]: {len(failed)}/"
                     f"{n_requests} submits raised; first at {i}") from exc
             name = shape_name(shape)
+            # Plan provenance (ISSUE 16): which searched-table key governs
+            # this (mesh, family), the loaded table's content hash, and
+            # whether the plan that actually served is searched or
+            # hand-written — the record must say WHOSE placement it
+            # measured (GL-DRIFT-BENCH pins these fields in CI).
+            prov = sharding_plan.plan_provenance("encoder_validator", mesh)
+            plan_provenance[name] = {
+                "plan_table_key": prov["plan_table_key"],
+                "plan_table_hash": prov["plan_table_hash"],
+                "plan_source": prov["plan_source"]}
             throughput[name] = round(n_requests / dt, 1)
             tokens_per_s[name] = round(n_requests * cfg.seq_len / dt, 0)
             mismatches_by_shape[name] = sum(
@@ -2279,6 +2294,11 @@ def bench_mesh_serve(shapes: tuple = ((1, 1), (2, 1), (2, 4)),
            "search_id_mismatches": search_id_mismatches,
            "search_score_dev": round(float(search_score_dev), 6),
            "mesh_serve_stage_quantiles": stage_quantiles,
+           "plan_provenance": plan_provenance,
+           "plan_table_hash": sharding_plan.plan_table_hash(),
+           "searched_plan_shapes": sum(
+               1 for p in plan_provenance.values()
+               if p.get("plan_source") == "searched"),
            "device": platform, "device_kind": kind,
            "cpu_count": os.cpu_count()}
     return rec
@@ -2425,6 +2445,165 @@ def _kernel_search_cli(argv: list) -> dict:
         kwargs[name] = cast(argv[i + 1])
         i += 2
     return bench_kernel_search(**kwargs)
+
+
+def bench_plan_search(families: "tuple | None" = None,
+                      shapes: "tuple | None" = None,
+                      n_requests: "int | None" = None,
+                      concurrency: "int | None" = None,
+                      max_batch: "int | None" = None,
+                      window_ms: "float | None" = None,
+                      n_facts: "int | None" = None,
+                      n_queries: "int | None" = None,
+                      bucket_mins: "tuple | None" = None,
+                      min_gain: "float | None" = None,
+                      seed: "int | None" = None,
+                      state_path: "str | None" = None,
+                      write_table_path: "str | None" = None,
+                      budget_s: "float | None" = None) -> dict:
+    """Sketch-constrained placement search (ISSUE 16): sweeps sketch-legal
+    variants of the serving rule tables per (device family, mesh shape,
+    servable family) with the mesh_serve machinery as the fitness signal,
+    gated on "faster than the hand-written incumbent AND oracle parity
+    AND zero retraces" (parallel/plan_search.py). Seeded, resumable via
+    ``state_path``, and only a table that passes ``validate_plan_table``
+    may be written — the regression-gate discipline kernel_search set."""
+    from vainplex_openclaw_tpu.parallel import plan as sharding_plan
+    from vainplex_openclaw_tpu.parallel import plan_search as ps
+
+    t0 = time.perf_counter()
+    settings: dict = {}
+    for name, value in (("families", families), ("shapes", shapes),
+                        ("requests", n_requests),
+                        ("concurrency", concurrency),
+                        ("maxBatch", max_batch), ("windowMs", window_ms),
+                        ("facts", n_facts), ("queries", n_queries),
+                        ("bucketMins", bucket_mins), ("minGain", min_gain),
+                        ("seed", seed), ("budgetS", budget_s)):
+        if value is not None:
+            settings[name] = value
+    results = ps.search(settings, state_path=state_path,
+                        log=lambda msg: print(msg, file=sys.stderr))
+
+    sweeps = {}
+    measured = retraces = sketch_rejected = 0
+    for key, res in results["sweeps"].items():
+        for c in res["candidates"]:
+            if c.get("rps") is not None:
+                measured += 1
+                retraces += int(c.get("retraces") or 0)
+        sketch_rejected += res["sketch_rejected"]
+        base, best = res.get("baseline"), res.get("best")
+        sweeps[key] = {
+            "improved": res["improved"],
+            "best_candidate": (best or {}).get("candidate"),
+            "best_rps": (best or {}).get("rps"),
+            "baseline_rps": (base or {}).get("rps"),
+            "speedup_vs_handwritten": round(best["rps"] / base["rps"], 3)
+            if base and base.get("rps") and best and best.get("rps")
+            else None,
+            "mismatches": (best or {}).get("mismatches"),
+            "sketch_rejected": res["sketch_rejected"],
+            "skipped_candidates": res["skipped_candidates"],
+        }
+    table = ps.to_table(results,
+                        base_table=sharding_plan.load_plan_table() or None)
+    findings = ps.validate_plan_table(table) if table.get("entries") else []
+    written = None
+    if write_table_path and not findings and table.get("entries"):
+        written = ps.write_table(table, write_table_path)
+        sharding_plan.clear_plan_table_cache()
+    platform, kind, _ = _device_peak()
+    rec = {"metric": "plan_search", "value": measured, "unit": "points",
+           "seed": results["seed"], "device_family": results["device_family"],
+           "sweeps": sweeps,
+           "improved_keys": sum(1 for s in sweeps.values()
+                                if s.get("improved")),
+           "sketch_rejected": sketch_rejected,
+           "retraces": retraces,
+           "factorizations": {k: v["mesh_shape"] for k, v in
+                              results["factorizations"].items()},
+           "partial": any(r.get("partial")
+                          for r in results["sweeps"].values()),
+           "table_findings": findings, "table_written": written,
+           "plan_table_hash": sharding_plan.plan_table_hash(),
+           "resumable_state": state_path,
+           "elapsed_s": round(time.perf_counter() - t0, 1),
+           "device": platform, "device_kind": kind}
+    return rec
+
+
+def _plan_search_cli(argv: list) -> dict:
+    """``python bench.py plan_search [--families a,b] [--shapes 1x1,2x4]
+    [--requests N] [--concurrency N] [--max-batch N] [--window-ms X]
+    [--facts N] [--queries N] [--bucket-mins 1,2,4] [--min-gain X]
+    [--seed N] [--state PATH] [--write-table PATH] [--budget-s X]``.
+    Re-execs itself onto enough virtual CPU host devices when the process
+    is short (the mesh_serve pattern — XLA device count is fixed at first
+    backend init)."""
+    import os
+    import subprocess
+
+    kwargs: dict = {}
+
+    def csv_ints(s):
+        return tuple(int(x) for x in s.split(",") if x)
+    flags = {"--requests": ("n_requests", int),
+             "--concurrency": ("concurrency", int),
+             "--max-batch": ("max_batch", int),
+             "--window-ms": ("window_ms", float),
+             "--facts": ("n_facts", int), "--queries": ("n_queries", int),
+             "--bucket-mins": ("bucket_mins", csv_ints),
+             "--min-gain": ("min_gain", float), "--seed": ("seed", int),
+             "--state": ("state_path", str),
+             "--write-table": ("write_table_path", str),
+             "--budget-s": ("budget_s", float)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--shapes" and i + 1 < len(argv):
+            kwargs["shapes"] = tuple(
+                tuple(int(x) for x in s.split("x"))
+                for s in argv[i + 1].split(","))
+            i += 2
+            continue
+        if arg == "--families" and i + 1 < len(argv):
+            kwargs["families"] = tuple(
+                f for f in argv[i + 1].split(",") if f)
+            i += 2
+            continue
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"plan_search: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    import numpy as np
+
+    from vainplex_openclaw_tpu.parallel.plan_search import \
+        PLAN_SEARCH_DEFAULTS
+
+    shapes = kwargs.get("shapes", PLAN_SEARCH_DEFAULTS["shapes"])
+    need = max(int(np.prod(s)) for s in shapes)
+    import jax
+
+    if len(jax.devices()) < need \
+            and os.environ.get("OPENCLAW_PLAN_SEARCH_CHILD") != "1":
+        env = dict(os.environ)
+        env["OPENCLAW_PLAN_SEARCH_CHILD"] = "1"  # no re-exec loops
+        env["JAX_PLATFORMS"] = "cpu"
+        xf = [f for f in env.get("XLA_FLAGS", "").split()
+              if "host_platform_device_count" not in f]
+        xf.append(f"--xla_force_host_platform_device_count={need}")
+        env["XLA_FLAGS"] = " ".join(xf)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "plan_search",
+             *argv], env=env, capture_output=True, text=True, timeout=3000)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"plan_search child failed (rc={proc.returncode}): "
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    return bench_plan_search(**kwargs)
 
 
 def _run_child(code: str, timeout: float):
@@ -2704,6 +2883,15 @@ if __name__ == "__main__":
         # findings); --state makes it resumable, --write-table commits a
         # validated table for default_block to consult.
         print(json.dumps(_kernel_search_cli(sys.argv[2:]), ensure_ascii=False))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "plan_search":
+        # Subcommand mode (ISSUE 16): the placement search loop. ONE
+        # stdout line = the search record (per-key winners, sketch
+        # rejections, retraces, table findings); --state makes it
+        # resumable, --write-table commits a validated plan table for
+        # serving_plan to consult. Re-execs onto virtual CPU host
+        # devices when the process is short.
+        print(json.dumps(_plan_search_cli(sys.argv[2:]), ensure_ascii=False))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "slo_report":
         # Subcommand mode (ISSUE 6): ONE stdout line = the SLO report;

@@ -558,6 +558,63 @@ class TestShardRules:
         assert sharding.validate_rule_table(
             [("w1", P)], ["['w1']"]) == []
 
+    def test_plan_table_schema_twin_matches_loader(self):
+        """sharding.PLAN_TABLE_SCHEMA is spelled locally so graftlint
+        stays jax-free — it must track the loader's constant."""
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        assert sharding.PLAN_TABLE_SCHEMA == splan.PLAN_TABLE_SCHEMA
+
+    def test_plan_table_file_pass_flags_bad_tables(self, tmp_path):
+        import json as _json
+
+        cases = (
+            ("{not json", "table:unreadable"),
+            (_json.dumps({"schema": "v0", "entries": {}}), "table:schema"),
+            (_json.dumps({"schema": "plan-table-v1"}), "table:entries"),
+            (_json.dumps({"schema": "plan-table-v1", "entries": {
+                "badkey": {}}}), "table:key:badkey"),
+            (_json.dumps({"schema": "plan-table-v1", "entries": {
+                "cpu:n8:encoder_validator": {"mesh_shape": [3, 1]}}}),
+             "table:factor"),
+            (_json.dumps({"schema": "plan-table-v1", "entries": {
+                "cpu:2x1:encoder_validator": {
+                    "rules": [["w1", []], ["w1", []]],
+                    "axes": ["dp", "tp"]}}}), "dup:"),
+            (_json.dumps({"schema": "plan-table-v1", "entries": {
+                "cpu:2x1:encoder_validator": {
+                    "rules": [["", []]], "axes": ["dp"]}}}),
+             "table:rank"),
+        )
+        for body, needle in cases:
+            p = tmp_path / "t.json"
+            p.write_text(body)
+            found = sharding.check_plan_table_file(p, "t.json")
+            assert any(needle in f.detail for f in found), (body, needle)
+            assert all(f.rule == "GL-SHARD-RULE" for f in found)
+
+    def test_plan_table_file_pass_accepts_clean_table(self, tmp_path):
+        import json as _json
+
+        p = tmp_path / "t.json"
+        p.write_text(_json.dumps({"schema": "plan-table-v1", "entries": {
+            "cpu:2x4:encoder_validator": {
+                "rules": [["attn/q$", [None, "tp"]], ["", []]],
+                "axes": ["dp", "tp"], "data_spec": ["dp"]},
+            "cpu:n8:encoder_validator": {"mesh_shape": [2, 4]}}}))
+        assert sharding.check_plan_table_file(p, "t.json") == []
+
+    def test_shipped_plan_table_lints_clean(self):
+        from pathlib import Path
+
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        path = Path(splan.PLAN_TABLE_PATH)
+        if not path.exists():
+            pytest.skip("no shipped plan_table.json")
+        rel = "vainplex_openclaw_tpu/parallel/plan_table.json"
+        assert sharding.check_plan_table_file(path, rel) == []
+
     def test_repo_moe_rules_live_on_real_params(self):
         """The item-4 precondition on today's tables: moe_sharding_rules
         must win on every real MoE param path."""

@@ -1,0 +1,762 @@
+"""Sketch-constrained placement search (ISSUE 16).
+
+parallel/plan_search.py turns partition plans into regression-gated
+artifacts: seeded resumable sweep → communication-sketch rejection →
+measurement through the real serving machinery → checked-in
+parallel/plan_table.json consulted by serving_plan() at load. These
+tests pin:
+
+- sketch legality (Megatron pairs, the replicated closing rule, loose-
+  site caps) and that sketch rejection is COMPILE-FREE — an illegal
+  assignment never constructs a candidate plan, never measures,
+- enumeration determinism, incumbent-first ordering, and the
+  incumbent-duplicate dedupe,
+- the search loop on a stubbed measurement: resume skips finished
+  points, persisted ERROR records re-measure, and the gate (faster by
+  minGain AND oracle parity AND zero retraces) — a tie, a mismatch, or
+  a dirty winner keeps the hand-written plan,
+- entry_from_plan ↔ _plan_from_entry round-trip and the
+  validate_plan_table regression gate (schema, key format, stale
+  factorizations),
+- table loading: OPENCLAW_PLAN_TABLE override, the lru_cached load +
+  clear_plan_table_cache(), malformed tables/entries falling back
+  LOUDLY (RuntimeWarning) to hand-written rules, the searched=False /
+  OPENCLAW_SEARCHED_PLANS=0 escape hatches, plan_override precedence,
+- the SHIPPED plan_table.json: gate-clean, every entry places on real
+  param trees with validate_rule_table armed, every searched encoder
+  entry resolves AND serves verdict-parity with the single-device
+  oracle on its mesh shape,
+- verdict parity with searched tables active across 1×1 / 2×1 / 2×4
+  and the non-pow2 dp3×tp2 mesh.
+
+conftest forces the 8-device virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from test_mesh_serving import _tiny_cfg_params
+from test_serve_batching import seeded_texts, serve_all
+
+
+def _splan():
+    from vainplex_openclaw_tpu.parallel import plan as splan
+
+    return splan
+
+
+def _ps():
+    from vainplex_openclaw_tpu.parallel import plan_search as ps
+
+    return ps
+
+
+def _mesh(shape, axes=("dp", "tp")):
+    from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+    return cached_mesh(tuple(shape), tuple(axes))
+
+
+def _fam_dev():
+    from vainplex_openclaw_tpu.ops.flash_attention import backend_family
+
+    return backend_family()
+
+
+def _all_rep_assignment():
+    ps = _ps()
+    return tuple((site, "rep") for site, _, _ in ps._ENCODER_SITES)
+
+
+def _megatron_assignment():
+    return (("qkv", "col"), ("o", "row"), ("w1", "col"), ("w2", "row"),
+            ("embed", "col"))
+
+
+def _entry(bucket_min=1, gather="replicated"):
+    """A valid all-replicated encoder table entry."""
+    ps = _ps()
+    plan = ps._candidate_plan("encoder_validator", _all_rep_assignment(),
+                              bucket_min, gather)
+    return ps.entry_from_plan(
+        plan, {"rps": 200.0, "candidate": "allrep"}, {"rps": 100.0}, 0)
+
+
+@pytest.fixture
+def isolated_table(monkeypatch, tmp_path):
+    """Point OPENCLAW_PLAN_TABLE at a scratch file; the memoized loader
+    is cleared on both sides so no test sees another's table."""
+    splan = _splan()
+    path = tmp_path / "plan_table.json"
+    monkeypatch.setenv(splan.PLAN_TABLE_ENV, str(path))
+    splan.clear_plan_table_cache()
+    yield path
+    splan.clear_plan_table_cache()
+
+
+def _write_table(path, entries):
+    splan = _splan()
+    path.write_text(json.dumps(
+        {"schema": splan.PLAN_TABLE_SCHEMA, "entries": entries}))
+    _splan().clear_plan_table_cache()
+
+
+# ── the communication sketch ─────────────────────────────────────────
+
+
+class TestSketch:
+    def test_megatron_assignment_is_legal_with_signature(self):
+        ps = _ps()
+        legal, reason, colls = ps.sketch_check(
+            "encoder_validator", _megatron_assignment(), (2, 4))
+        assert legal, reason
+        assert colls == [("psum", "qkv->o"), ("psum", "w1->w2"),
+                         ("all_gather", "embed")]
+
+    def test_all_replicated_is_legal_with_zero_collectives(self):
+        ps = _ps()
+        legal, reason, colls = ps.sketch_check(
+            "encoder_validator", _all_rep_assignment(), (2, 4))
+        assert legal, reason
+        assert colls == []
+
+    def test_col_producer_with_replicated_consumer_rejected(self):
+        """w1=col, w2=rep re-materializes the wide intermediate — not an
+        allowed producer→consumer pattern."""
+        ps = _ps()
+        a = dict(_all_rep_assignment())
+        a["w1"] = "col"
+        legal, reason, _ = ps.sketch_check(
+            "encoder_validator", tuple(a.items()), (2, 4))
+        assert not legal
+        assert "producer→consumer" in reason
+
+    def test_row_consumer_without_col_producer_rejected(self):
+        ps = _ps()
+        a = dict(_all_rep_assignment())
+        a["o"] = "row"
+        legal, reason, _ = ps.sketch_check(
+            "encoder_validator", tuple(a.items()), (2, 4))
+        assert not legal
+
+    def test_site_outside_sketch_must_stay_replicated(self):
+        """The closing rule: embeddings_forward declares NO collective
+        pattern, so a split-weights assignment is rejected."""
+        ps = _ps()
+        legal, reason, _ = ps.sketch_check(
+            "embeddings_forward", (("weights", "split"),), (8,))
+        assert not legal
+        assert "must stay" in reason and "replicated" in reason
+
+    def test_loose_collective_cap(self, monkeypatch):
+        ps = _ps()
+        tight = ps.CommSketch(
+            family="encoder_validator",
+            pairs=(("qkv", "o"), ("w1", "w2")),
+            allowed_pairs=(("col", "row"), ("rep", "rep")),
+            loose_sites=("embed",), loose_allowed=("col", "rep"),
+            max_loose_collectives=0)
+        monkeypatch.setitem(ps.SKETCHES, "encoder_validator", tight)
+        legal, reason, _ = ps.sketch_check(
+            "encoder_validator", _megatron_assignment(), (2, 4))
+        assert not legal
+        assert "loose collectives exceed" in reason
+
+    def test_loose_choice_outside_allowed_rejected(self, monkeypatch):
+        ps = _ps()
+        rep_only = ps.CommSketch(
+            family="encoder_validator",
+            pairs=(("qkv", "o"), ("w1", "w2")),
+            allowed_pairs=(("col", "row"), ("rep", "rep")),
+            loose_sites=("embed",), loose_allowed=("rep",),
+            max_loose_collectives=0)
+        monkeypatch.setitem(ps.SKETCHES, "encoder_validator", rep_only)
+        legal, reason, _ = ps.sketch_check(
+            "encoder_validator", _megatron_assignment(), (2, 4))
+        assert not legal
+        assert "allowed loose choices" in reason
+
+
+# ── candidate enumeration ────────────────────────────────────────────
+
+
+class TestEnumeration:
+    def test_incumbent_first_and_space_size(self):
+        ps = _ps()
+        splan = _splan()
+        cands, rejected = ps.enumerate_candidates(
+            "encoder_validator", (2, 4), bucket_mins=(1, 2, 4))
+        assert cands[0].cand_id == "incumbent"
+        assert cands[0].plan is splan.PLAN_TABLE["encoder_validator"]
+        # 2^5 assignments, 8 sketch-legal, × 3 bucket floors × 2 gather
+        # modes, minus the one generated twin of the incumbent
+        assert len(rejected) == 24
+        assert len(cands) == 1 + 8 * 3 * 2 - 1
+
+    def test_tp1_collapses_to_one_assignment(self):
+        ps = _ps()
+        cands, rejected = ps.enumerate_candidates(
+            "encoder_validator", (2, 1), bucket_mins=(1, 2, 4))
+        assert rejected == []
+        # all-rep only: splits are aliases of replication on tp=1
+        assert len(cands) == 1 + 1 * 3 * 2
+
+    def test_enumeration_is_deterministic(self):
+        ps = _ps()
+        a, _ = ps.enumerate_candidates("encoder_validator", (2, 4))
+        b, _ = ps.enumerate_candidates("encoder_validator", (2, 4))
+        assert [c.cand_id for c in a] == [c.cand_id for c in b]
+
+    def test_incumbent_twin_deduped(self):
+        """The generated candidate identical to the hand-written table
+        (canonical Megatron assignment, bucket floor 1, replicated
+        gather) must not be measured twice."""
+        ps = _ps()
+        cands, _ = ps.enumerate_candidates(
+            "encoder_validator", (2, 4), bucket_mins=(1, 2))
+        ids = [c.cand_id for c in cands]
+        twin = ps._cand_id(_megatron_assignment(), 1, "replicated")
+        assert twin not in ids
+        assert ps._cand_id(_megatron_assignment(), 2, "replicated") in ids
+
+    def test_sketch_rejection_constructs_no_plan(self, monkeypatch):
+        """The cheap-rejection contract: an illegal assignment is
+        rejected ONCE, before bucket/gather expansion — it never reaches
+        plan construction (and therefore never compiles/measures)."""
+        ps = _ps()
+        built = []
+        real = ps._candidate_plan
+
+        def spy(family, assignment, bm, gather):
+            built.append(dict(assignment))
+            return real(family, assignment, bm, gather)
+
+        monkeypatch.setattr(ps, "_candidate_plan", spy)
+        _cands, rejected = ps.enumerate_candidates(
+            "encoder_validator", (2, 4), bucket_mins=(1,))
+        assert len(rejected) == 24
+        assert len(built) == 8 * 1 * 2  # legal assignments only
+        illegal = [dict(r["assignment"]) for r in rejected]
+        assert all(b not in illegal for b in built)
+
+    def test_candidate_plan_specs_follow_assignment(self):
+        ps = _ps()
+        plan = ps._candidate_plan(
+            "encoder_validator", _megatron_assignment(), 2, "sharded")
+        rules = dict(plan.rules)
+        assert rules["attn/q$"] == P(None, "tp")
+        assert rules["attn/o$"] == P("tp", None)
+        assert rules["mlp/w1$"] == P(None, "tp")
+        assert plan.rules[-1] == ("", P())
+        assert plan.bucket_min == 2
+        assert plan.gather == "sharded"
+        assert plan.source == "candidate"
+
+
+# ── the search loop on a stubbed measurement ─────────────────────────
+
+
+class _FakeMeasure:
+    """measure_candidate stand-in: record per-plan, never touch jax."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = []
+
+    def __call__(self, family, plan, mesh_shape, scfg, fixtures,
+                 clock=None):
+        self.calls.append((family, tuple(mesh_shape), plan.source,
+                           plan.gather))
+        rec = {"family": family, "mesh_shape": list(mesh_shape)}
+        rec.update(self.fn(plan))
+        rec["elapsed_s"] = 0.0
+        return rec
+
+
+_SETTINGS = {"families": ("encoder_validator",), "shapes": ((2, 1),),
+             "bucketMins": (1,), "requests": 3}
+
+
+@pytest.fixture
+def stub_oracle(monkeypatch):
+    """search() computes single-device oracle refs before sweeping;
+    stub the serve closure so loop tests stay jax-free."""
+    monkeypatch.setattr(
+        "vainplex_openclaw_tpu.models.serve.make_local_call_llm",
+        lambda **_kw: (lambda _text: "ok"))
+
+
+class TestSearchLoop:
+    def _run(self, fake, monkeypatch, state_path=None, settings=None):
+        ps = _ps()
+        monkeypatch.setattr(ps, "measure_candidate", fake)
+        return ps.search(dict(_SETTINGS, **(settings or {})),
+                         state_path=state_path)
+
+    def test_gate_rejects_sub_margin_mismatch_and_retrace(
+            self, monkeypatch, stub_oracle):
+        cases = (
+            ({"rps": 104.0, "mismatches": 0, "retraces": 0}, False),
+            ({"rps": 200.0, "mismatches": 1, "retraces": 0}, False),
+            ({"rps": 200.0, "mismatches": 0, "retraces": 1}, False),
+            ({"rps": 200.0, "mismatches": 0, "retraces": 0}, True),
+        )
+        for cand_rec, want_improved in cases:
+            fake = _FakeMeasure(
+                lambda plan, rec=cand_rec:
+                {"rps": 100.0, "mismatches": 0, "retraces": 0}
+                if plan.source == "handwritten" else dict(rec))
+            results = self._run(fake, monkeypatch)
+            key = f"{_fam_dev()}:2x1:encoder_validator"
+            res = results["sweeps"][key]
+            assert res["improved"] is want_improved, cand_rec
+            if want_improved:
+                ent = res["entry"]
+                assert ent["baseline_rps"] == 100.0
+                assert ent["rps"] == 200.0
+                assert "gate=faster+parity+zero-retraces" in ent["source"]
+            else:
+                assert "entry" not in res
+                assert res["best"] is res["baseline"]
+
+    def test_gate_picks_fastest_clean_winner(self, monkeypatch,
+                                             stub_oracle):
+        fake = _FakeMeasure(lambda plan: {
+            "rps": {"handwritten": 100.0}.get(
+                plan.source, 150.0 if plan.gather == "replicated"
+                else 200.0),
+            "mismatches": 0, "retraces": 0})
+        results = self._run(fake, monkeypatch)
+        res = results["sweeps"][f"{_fam_dev()}:2x1:encoder_validator"]
+        assert res["improved"]
+        assert res["best"]["rps"] == 200.0
+        assert res["entry"]["gather"] == "sharded"
+
+    def test_error_candidate_is_data_not_fatal(self, monkeypatch,
+                                               stub_oracle):
+        fake = _FakeMeasure(
+            lambda plan: {"rps": 100.0, "mismatches": 0, "retraces": 0}
+            if plan.source == "handwritten" else {"error": "boom"})
+        results = self._run(fake, monkeypatch)
+        res = results["sweeps"][f"{_fam_dev()}:2x1:encoder_validator"]
+        assert res["improved"] is False
+        assert sum(1 for c in res["candidates"]
+                   if c.get("error") == "boom") == 2
+
+    def test_resume_skips_finished_points(self, monkeypatch, stub_oracle,
+                                          tmp_path):
+        state = str(tmp_path / "state.json")
+        clean = lambda plan: {"rps": 100.0, "mismatches": 0,  # noqa: E731
+                              "retraces": 0}
+        fake1 = _FakeMeasure(clean)
+        r1 = self._run(fake1, monkeypatch, state_path=state)
+        # one discarded warmup + 3 candidates (incumbent + rep×2 gathers)
+        assert len(fake1.calls) == 4
+        fake2 = _FakeMeasure(clean)
+        r2 = self._run(fake2, monkeypatch, state_path=state)
+        assert fake2.calls == []  # every point resumed, nothing re-ran
+        key = f"{_fam_dev()}:2x1:encoder_validator"
+        assert [c["rps"] for c in r2["sweeps"][key]["candidates"]] == \
+            [c["rps"] for c in r1["sweeps"][key]["candidates"]]
+        assert all(c.get("resumed") for c in
+                   r2["sweeps"][key]["candidates"])
+
+    def test_error_records_remeasure_on_resume(self, monkeypatch,
+                                               stub_oracle, tmp_path):
+        state_path = tmp_path / "state.json"
+        clean = lambda plan: {"rps": 100.0, "mismatches": 0,  # noqa: E731
+                              "retraces": 0}
+        self._run(_FakeMeasure(clean), monkeypatch,
+                  state_path=str(state_path))
+        state = json.loads(state_path.read_text())
+        victim = next(k for k in state if "|bm1|sharded" in k)
+        state[victim] = {"family": "encoder_validator",
+                         "mesh_shape": [2, 1], "error": "transient"}
+        state_path.write_text(json.dumps(state))
+        fake = _FakeMeasure(clean)
+        r = self._run(fake, monkeypatch, state_path=str(state_path))
+        # warmup + exactly the poisoned point re-measured
+        assert len(fake.calls) == 2
+        key = f"{_fam_dev()}:2x1:encoder_validator"
+        assert all(c.get("rps") == 100.0
+                   for c in r["sweeps"][key]["candidates"])
+
+    def test_budget_skips_are_partial_not_fatal(self, monkeypatch,
+                                                stub_oracle):
+        ps = _ps()
+        ticks = {"t": 0.0}
+
+        def slow_clock():
+            ticks["t"] += 10.0
+            return ticks["t"]
+
+        fake = _FakeMeasure(lambda plan: {"rps": 100.0, "mismatches": 0,
+                                          "retraces": 0})
+        monkeypatch.setattr(ps, "measure_candidate", fake)
+        results = ps.search(dict(_SETTINGS, budgetS=1.0),
+                            clock=slow_clock)
+        res = results["sweeps"][f"{_fam_dev()}:2x1:encoder_validator"]
+        assert res["partial"]
+        assert res["skipped_candidates"] >= 1
+        assert res["baseline"] is not None  # incumbent always measured
+
+
+# ── table round-trip + the regression gate ───────────────────────────
+
+
+class TestTableRoundTrip:
+    def test_entry_round_trips_through_the_loader(self):
+        ps, splan = _ps(), _splan()
+        plan = ps._candidate_plan(
+            "encoder_validator", _megatron_assignment(), 2, "sharded")
+        ent = ps.entry_from_plan(
+            plan, {"rps": 321.0, "candidate": "mega|bm2|sharded"},
+            {"rps": 300.0}, 7)
+        assert splan.plan_entry_problems(ent) == []
+        key = "cpu:2x4:encoder_validator"
+        back = splan._plan_from_entry("encoder_validator", key, ent)
+        assert back.rules == plan.rules
+        assert back.data_spec == plan.data_spec
+        assert back.axes == plan.axes
+        assert back.bucket_min == 2 and back.gather == "sharded"
+        assert back.source == "searched" and back.table_key == key
+        assert ent["baseline_rps"] == 300.0
+        assert "seed=7" in ent["source"]
+
+    def test_to_table_merges_over_base(self):
+        ps, splan = _ps(), _splan()
+        fam = _fam_dev()
+        key = f"{fam}:2x1:encoder_validator"
+        results = {
+            "sweeps": {key: {"improved": True, "entry": _entry()},
+                       f"{fam}:1x1:encoder_validator":
+                           {"improved": False}},
+            "factorizations": {f"{fam}:n8:encoder_validator": {
+                "mesh_shape": [2, 4], "rps": 50.0, "source": "s"}}}
+        base = {"entries": {"tpu:4x4:encoder_validator": _entry()},
+                "provenance": {"note": "kept"}}
+        table = ps.to_table(results, base_table=base)
+        assert table["schema"] == splan.PLAN_TABLE_SCHEMA
+        # improved key lands; unimproved does not; base rows survive
+        assert key in table["entries"]
+        assert f"{fam}:1x1:encoder_validator" not in table["entries"]
+        assert "tpu:4x4:encoder_validator" in table["entries"]
+        assert table["entries"][f"{fam}:n8:encoder_validator"][
+            "mesh_shape"] == [2, 4]
+        assert table["provenance"]["note"] == "kept"
+        assert "generator" in table["provenance"]
+        assert ps.validate_plan_table(table) == []
+
+    def test_write_table_round_trips(self, tmp_path):
+        ps = _ps()
+        table = ps.to_table({"sweeps": {
+            f"{_fam_dev()}:2x1:encoder_validator":
+                {"improved": True, "entry": _entry()}}})
+        path = str(tmp_path / "t.json")
+        ps.write_table(table, path)
+        assert json.loads(open(path).read()) == table
+        assert not (tmp_path / "t.json.tmp").exists()
+
+    @pytest.mark.parametrize("table,needle", (
+        ({"schema": "nope", "entries": {"cpu:2x1:encoder_validator":
+                                        None}}, "unknown schema"),
+        ({"schema": "plan-table-v1", "entries": {}}, "no entries"),
+        ({"schema": "plan-table-v1",
+          "entries": {"justonekey": {}}}, "device_family:shape:family"),
+        ({"schema": "plan-table-v1",
+          "entries": {"cpu:2x1:nonexistent": {}}}, "unknown servable"),
+        ({"schema": "plan-table-v1",
+          "entries": {"cpu:n8:encoder_validator": {"rules": [["", []]],
+                      "axes": ["dp"], "data_spec": []}}},
+         "without a mesh_shape"),
+        ({"schema": "plan-table-v1",
+          "entries": {"cpu:n8:encoder_validator":
+                      {"mesh_shape": [3, 1]}}}, "does not factor"),
+        ({"schema": "plan-table-v1",
+          "entries": {"cpu:2x1:encoder_validator":
+                      {"mesh_shape": [2, 1]}}}, "belongs under nN"),
+        ({"schema": "plan-table-v1",
+          "entries": {"cpu:what:encoder_validator": {}}},
+         "not x-joined"),
+    ))
+    def test_validate_plan_table_findings(self, table, needle):
+        findings = _ps().validate_plan_table(table)
+        assert any(needle in f for f in findings), findings
+
+    def test_validate_flags_axes_shape_rank_mismatch(self):
+        ent = _entry()  # axes ("dp", "tp") — 2-d
+        table = {"schema": "plan-table-v1",
+                 "entries": {"cpu:8:encoder_validator": ent}}
+        findings = _ps().validate_plan_table(table)
+        assert any("axes vs" in f for f in findings), findings
+
+    def test_validate_uses_entry_problems(self):
+        ent = _entry()
+        ent["bucket_min"] = 3  # not a pow2
+        table = {"schema": "plan-table-v1",
+                 "entries": {"cpu:2x1:encoder_validator": ent}}
+        findings = _ps().validate_plan_table(table)
+        assert any("pow2" in f for f in findings), findings
+
+
+# ── table loading: env override, cache, loud fallbacks ───────────────
+
+
+class TestTableLoading:
+    def test_env_override_and_memoized_load(self, isolated_table):
+        splan = _splan()
+        key = splan.plan_table_key(_mesh((2, 1)), "encoder_validator")
+        _write_table(isolated_table, {key: _entry()})
+        table = splan.load_plan_table()
+        assert table["_path"] == str(isolated_table)
+        first_hash = splan.plan_table_hash()
+        assert first_hash
+        plan = splan.serving_plan("encoder_validator", _mesh((2, 1)))
+        assert plan.source == "searched" and plan.table_key == key
+        # rewrite on disk: the memoized load must NOT see it until the
+        # cache is cleared (serve hot path pays no file IO per batch)
+        _entry2 = _entry(bucket_min=2)
+        isolated_table.write_text(json.dumps(
+            {"schema": splan.PLAN_TABLE_SCHEMA,
+             "entries": {key: _entry2}}))
+        assert splan.plan_table_hash() == first_hash
+        splan.clear_plan_table_cache()
+        assert splan.plan_table_hash() != first_hash
+        assert splan.serving_plan(
+            "encoder_validator", _mesh((2, 1))).bucket_min == 2
+
+    def test_missing_table_serves_handwritten(self, isolated_table):
+        splan = _splan()
+        assert splan.load_plan_table() == {}
+        assert splan.plan_table_hash() is None
+        plan = splan.serving_plan("encoder_validator", _mesh((2, 1)))
+        assert plan.source == "handwritten"
+
+    def test_unreadable_table_warns_and_falls_back(self, isolated_table):
+        splan = _splan()
+        isolated_table.write_text("{not json at all")
+        with pytest.warns(RuntimeWarning, match="unreadable"):
+            assert splan.load_plan_table() == {}
+        with warnings.catch_warnings():
+            # load_plan_table(None) is a distinct memo key from
+            # load_plan_table() — the fallback warns once per key
+            warnings.simplefilter("ignore", RuntimeWarning)
+            plan = splan.serving_plan("encoder_validator", _mesh((2, 1)))
+        assert plan.source == "handwritten"
+
+    def test_wrong_schema_warns_and_falls_back(self, isolated_table):
+        splan = _splan()
+        isolated_table.write_text(json.dumps(
+            {"schema": "plan-table-v0", "entries": {}}))
+        with pytest.warns(RuntimeWarning, match="hand-written rules"):
+            assert splan.load_plan_table() == {}
+
+    def test_malformed_entry_warns_and_falls_back(self, isolated_table):
+        splan = _splan()
+        key = splan.plan_table_key(_mesh((2, 1)), "encoder_validator")
+        _write_table(isolated_table, {key: {"rules": []}})
+        with pytest.warns(RuntimeWarning, match="unusable"):
+            plan = splan.serving_plan("encoder_validator", _mesh((2, 1)))
+        assert plan.source == "handwritten"
+
+    def test_stale_axes_entry_warns_and_falls_back(self, isolated_table):
+        splan = _splan()
+        key = splan.plan_table_key(_mesh((2, 1)), "encoder_validator")
+        ent = _entry()
+        ent["axes"] = ["dp", "tp", "pp"]  # mesh declares no pp
+        _write_table(isolated_table, {key: ent})
+        with pytest.warns(RuntimeWarning, match="unusable"):
+            plan = splan.serving_plan("encoder_validator", _mesh((2, 1)))
+        assert plan.source == "handwritten"
+
+    def test_escape_hatches_and_override_precedence(
+            self, isolated_table, monkeypatch):
+        splan = _splan()
+        mesh = _mesh((2, 1))
+        key = splan.plan_table_key(mesh, "encoder_validator")
+        _write_table(isolated_table, {key: _entry()})
+        assert splan.serving_plan(
+            "encoder_validator", mesh).source == "searched"
+        # per-call escape hatch
+        assert splan.serving_plan(
+            "encoder_validator", mesh, searched=False).source == \
+            "handwritten"
+        # process-wide escape hatch — it must beat even an EXPLICIT
+        # searched=True (the batcher plumbs its config value through;
+        # the kill switch silently losing to it served a different
+        # program than the warmup path resolved)
+        monkeypatch.setenv(splan.SEARCHED_PLANS_ENV, "0")
+        assert not splan.searched_plans_enabled()
+        assert splan.serving_plan(
+            "encoder_validator", mesh).source == "handwritten"
+        assert splan.serving_plan(
+            "encoder_validator", mesh, searched=True).source == \
+            "handwritten"
+        monkeypatch.delenv(splan.SEARCHED_PLANS_ENV)
+        # an active plan_override beats the searched table
+        probe = splan.ShardingPlan(
+            family="encoder_validator", rules=(("", P()),),
+            data_spec=P("dp"), axes=("dp",), source="override-probe")
+        with splan.plan_override("encoder_validator", probe):
+            assert splan.serving_plan(
+                "encoder_validator", mesh) is probe
+        assert splan.serving_plan(
+            "encoder_validator", mesh).source == "searched"
+
+    def test_preferred_mesh_shape_and_stale_factorization(
+            self, isolated_table):
+        splan = _splan()
+        fam = _fam_dev()
+        _write_table(isolated_table, {
+            f"{fam}:n8:encoder_validator":
+                {"mesh_shape": [8, 1], "rps": 1.0, "source": "s"}})
+        assert splan.preferred_mesh_shape(8) == (8, 1)
+        assert splan.preferred_mesh_shape(4) is None  # no entry
+        _write_table(isolated_table, {
+            f"{fam}:n8:encoder_validator":
+                {"mesh_shape": [2, 2], "rps": 1.0, "source": "s"}})
+        with pytest.warns(RuntimeWarning, match="default factorization"):
+            assert splan.preferred_mesh_shape(8) is None
+
+
+# ── the shipped artifact ─────────────────────────────────────────────
+
+
+def _shipped_table():
+    splan = _splan()
+    try:
+        with open(splan.PLAN_TABLE_PATH, encoding="utf-8") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        pytest.skip("no shipped plan_table.json")
+
+
+class TestShippedTable:
+    def test_shipped_table_is_gate_clean(self):
+        table = _shipped_table()
+        assert _ps().validate_plan_table(table) == []
+        assert table["entries"], "shipped table must carry entries"
+
+    def test_every_shipped_entry_places_on_real_params(self):
+        """Property test: every shape-keyed entry builds a plan that
+        passes the ARMED validate_rule_table against a real encoder
+        param tree and places cleanly on its mesh."""
+        splan = _splan()
+        table = _shipped_table()
+        _cfg, params = _tiny_cfg_params()
+        checked = 0
+        for key, ent in table["entries"].items():
+            _dev, shape_s, family = key.split(":")
+            if shape_s.startswith("n"):
+                assert int(np.prod(ent["mesh_shape"])) == int(shape_s[1:])
+                continue
+            shape = tuple(int(x) for x in shape_s.split("x"))
+            if int(np.prod(shape)) > 8:
+                continue  # conftest mesh is 8 virtual devices
+            assert splan.plan_entry_problems(ent) == [], key
+            plan = splan._plan_from_entry(family, key, ent)
+            axes = ("dp", "tp")[:len(shape)] if len(shape) <= 2 else None
+            mesh = _mesh(shape, axes)
+            shardings = splan.plan_shardings(plan, params, mesh)
+            assert shardings is not None
+            assert splan.serve_bucket(1, mesh, plan=plan) >= \
+                plan.bucket_min
+            checked += 1
+        assert checked >= 1
+
+    def test_shipped_searched_plans_resolve_and_hold_parity(self):
+        """Every shipped encoder entry actually WINS resolution on its
+        mesh shape, and the batcher serving on it matches the one-shot
+        single-device oracle verdict-for-verdict — the sweep gate,
+        re-verified against the committed artifact."""
+        from vainplex_openclaw_tpu.models.batching import \
+            ContinuousBatcher
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+        from vainplex_openclaw_tpu.governance.validation.llm_validator \
+            import build_prompt
+
+        splan = _splan()
+        splan.clear_plan_table_cache()
+        table = _shipped_table()
+        fam = _fam_dev()
+        call = make_local_call_llm(
+            serve_cfg={"continuousBatching": False}, force=True)
+        oracle = lambda text: call(build_prompt(text, []))  # noqa: E731
+        texts = seeded_texts(8, seed=16)
+        ref = [oracle(t) for t in texts]
+        exercised = 0
+        for key, ent in table["entries"].items():
+            dev, shape_s, family = key.split(":")
+            if dev != fam or shape_s.startswith("n") \
+                    or family != "encoder_validator":
+                continue
+            shape = tuple(int(x) for x in shape_s.split("x"))
+            if int(np.prod(shape)) > 8:
+                continue
+            mesh = _mesh(shape)
+            plan = splan.serving_plan("encoder_validator", mesh)
+            assert plan.source == "searched", key
+            assert plan.table_key == key
+            batcher = ContinuousBatcher(max_batch=8, window_ms=0.0,
+                                        autostart=False, mesh=mesh)
+            try:
+                assert serve_all(batcher, texts) == ref, key
+            finally:
+                batcher.close()
+            exercised += 1
+        if not exercised:
+            pytest.skip(f"no searched {fam} encoder entries ≤ 8 devices")
+
+    def test_shipped_embeddings_entries_resolve(self):
+        splan = _splan()
+        splan.clear_plan_table_cache()
+        table = _shipped_table()
+        fam = _fam_dev()
+        for key in table["entries"]:
+            dev, shape_s, family = key.split(":")
+            if dev != fam or shape_s.startswith("n") \
+                    or family != "embeddings_forward":
+                continue
+            n = int(np.prod([int(x) for x in shape_s.split("x")]))
+            if n > 8:
+                continue
+            plan = splan.serving_plan(
+                "embeddings_forward", _mesh((n,), ("dp",)))
+            assert plan.source == "searched", key
+            assert plan.table_key == key
+
+
+# ── parity with searched tables active (ISSUE 16 acceptance pin) ─────
+
+
+class TestSearchedPlanParity:
+    """Verdict parity vs the single-device oracle with the shipped table
+    ACTIVE (searched plans resolve by default) across the ISSUE shapes,
+    including non-pow2 dp3×tp2 — whatever plan wins resolution must
+    still be verdict-identical to the oracle."""
+
+    @pytest.mark.parametrize("shape", ((1, 1), (2, 1), (2, 4), (3, 2)))
+    def test_verdict_parity(self, shape):
+        from vainplex_openclaw_tpu.models.batching import \
+            ContinuousBatcher
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+        from vainplex_openclaw_tpu.governance.validation.llm_validator \
+            import build_prompt
+
+        _splan().clear_plan_table_cache()
+        call = make_local_call_llm(
+            serve_cfg={"continuousBatching": False}, force=True)
+        texts = seeded_texts(9, seed=sum(shape) + 40)
+        ref = [call(build_prompt(t, [])) for t in texts]
+        batcher = ContinuousBatcher(max_batch=4, window_ms=0.0,
+                                    autostart=False, mesh=_mesh(shape))
+        try:
+            assert serve_all(batcher, texts) == ref
+        finally:
+            batcher.close()

@@ -64,6 +64,9 @@ CONFIG_SITES: tuple = (
      ("SERVE_DEFAULTS",), ("scfg", "serve_cfg"),
      ("make_local_call_llm", "shared_batcher", "_mesh_key",
       "_resolve_mesh")),
+    ("vainplex_openclaw_tpu/parallel/plan_search.py",
+     ("PLAN_SEARCH_DEFAULTS",), ("scfg",),
+     ("search", "_measure_validator", "_measure_embeddings")),
 )
 
 

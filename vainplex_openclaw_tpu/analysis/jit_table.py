@@ -202,11 +202,14 @@ JIT_TABLE: tuple[JitEntry, ...] = (
         # builders established.
         module=f"{_PKG}/parallel/plan.py",
         jit_fns=("_build_serve_forward.run", "_build_arena_scores.run"),
-        static=("cfg", "mesh", "family", "dp_axis"),
+        static=("cfg", "mesh", "plan", "family", "dp_axis"),
         shape_policy=FIXED,
-        rationale="compiled variants are memoized per (cfg, mesh, plan "
-                  "family); every caller buckets its batch/row dim "
-                  "through serve_bucket (pow2 floored at the mesh dp "
+        rationale="compiled variants are memoized per (cfg, mesh, plan) "
+                  "— plan being the RESOLVED ShardingPlan (searched "
+                  "table or hand-written, ISSUE 16), so a family string "
+                  "and its resolution share one cache row; every caller "
+                  "buckets its batch/row dim through serve_bucket (pow2 "
+                  "floored at the plan's bucket_min and the mesh dp "
                   "size) + pad_rows before placement, so each mesh holds "
                   "O(log N) programs — batching._run_batch, "
                   "embeddings._embed/_scores, bench warmup included",

@@ -236,43 +236,148 @@ def _rule_tables(tree: ast.Module):
             yield node.lineno, patterns
 
 
+def _pattern_findings(patterns, path: str, lineno, where: str = "") -> list:
+    """Dup/dead/bad-regex findings for one ordered pattern list — shared
+    by the static-table AST pass and the checked-in plan_table.json pass
+    (ISSUE 16) so hand-written and searched tables are linted by ONE
+    implementation. ``where`` disambiguates detail keys when several
+    tables live at the same location (JSON entries have no lineno)."""
+    findings = []
+    at = f"{where}:" if where else ""
+    ctx = f" [{where}]" if where else ""
+    seen: dict = {}
+    for i, pat in enumerate(patterns):
+        if pat in seen:
+            findings.append(Finding(
+                "GL-SHARD-RULE", path, lineno,
+                f"rule table{ctx} repeats pattern {pat!r} — the second "
+                f"entry can never win (first match wins)",
+                detail=f"dup:{at}{pat}:{lineno}"))
+            continue
+        seen[pat] = i
+        if pat == "" and i != len(patterns) - 1:
+            findings.append(Finding(
+                "GL-SHARD-RULE", path, lineno,
+                f"empty pattern{ctx} matches every path — all later "
+                f"rules are dead",
+                detail=f"empty:{at}{lineno}"))
+        if _REGEXY.search(pat):
+            try:
+                re.compile(pat)
+            except re.error as exc:
+                findings.append(Finding(
+                    "GL-SHARD-RULE", path, lineno,
+                    f"rule pattern {pat!r}{ctx} is not a valid regex: "
+                    f"{exc}",
+                    detail=f"badre:{at}{pat}:{lineno}"))
+        for prev in patterns[:i]:
+            if prev and prev in pat:
+                findings.append(Finding(
+                    "GL-SHARD-RULE", path, lineno,
+                    f"rule {pat!r}{ctx} is dead: earlier rule {prev!r} "
+                    f"is a substring, so it wins on every path the "
+                    f"later rule matches",
+                    detail=f"shadow:{at}{prev}->{pat}:{lineno}"))
+    return findings
+
+
 def check_rule_tables_source(src: str, path: str, tree=None) -> list:
     """GL-SHARD-RULE findings for the static rule tables in one module."""
     tree = ast.parse(src) if tree is None else tree
     findings = []
     for lineno, patterns in _rule_tables(tree):
-        seen: dict = {}
-        for i, pat in enumerate(patterns):
-            if pat in seen:
+        findings.extend(_pattern_findings(patterns, path, lineno))
+    return findings
+
+
+# The plan.PLAN_TABLE_SCHEMA twin — spelled here so graftlint stays free
+# of jax imports; tests/test_plan_search.py pins the two equal.
+PLAN_TABLE_SCHEMA = "plan-table-v1"
+
+
+def check_plan_table_file(path, rel: str) -> list:
+    """GL-SHARD-RULE over the CHECKED-IN searched plan table
+    (parallel/plan_table.json, ISSUE 16). The searched artifact gets the
+    same pattern lint as the hand-written Python tables — dup, shadow,
+    bad regex — plus the structural contract a JSON table can violate
+    that a Python literal cannot: key format, a device-count key whose
+    ``mesh_shape`` does not factor its N, axes whose rank disagrees with
+    the key's mesh shape. The deep schema gate (``validate_plan_table``
+    against real param trees) runs in tests; this pass is the cheap
+    always-on half."""
+    import json
+
+    findings: list = []
+    try:
+        table = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, ValueError) as exc:
+        return [Finding(
+            "GL-SHARD-RULE", rel, 1,
+            f"searched plan table is unreadable ({exc}) — serving falls "
+            f"back to hand-written rules everywhere",
+            detail="table:unreadable")]
+    if not isinstance(table, dict) \
+            or table.get("schema") != PLAN_TABLE_SCHEMA:
+        return [Finding(
+            "GL-SHARD-RULE", rel, 1,
+            f"searched plan table has schema "
+            f"{table.get('schema') if isinstance(table, dict) else None!r}"
+            f" (want {PLAN_TABLE_SCHEMA!r}) — the loader will ignore it",
+            detail="table:schema")]
+    entries = table.get("entries")
+    if not isinstance(entries, dict):
+        return [Finding(
+            "GL-SHARD-RULE", rel, 1,
+            "searched plan table has no entries object",
+            detail="table:entries")]
+    for key, ent in sorted(entries.items()):
+        parts = key.split(":")
+        if len(parts) != 3:
+            findings.append(Finding(
+                "GL-SHARD-RULE", rel, 1,
+                f"plan-table key {key!r} is not "
+                f"device_family:shape:family",
+                detail=f"table:key:{key}"))
+            continue
+        if not isinstance(ent, dict):
+            findings.append(Finding(
+                "GL-SHARD-RULE", rel, 1,
+                f"plan-table entry {key!r} is not an object",
+                detail=f"table:ent:{key}"))
+            continue
+        shape_s = parts[1]
+        if shape_s[:1] == "n" and shape_s[1:].isdigit():
+            ms = ent.get("mesh_shape")
+            prod = 1
+            for x in (ms if isinstance(ms, list) else [0]):
+                prod *= x if isinstance(x, int) else 0
+            if prod != int(shape_s[1:]):
                 findings.append(Finding(
-                    "GL-SHARD-RULE", path, lineno,
-                    f"rule table repeats pattern {pat!r} — the second "
-                    f"entry can never win (first match wins)",
-                    detail=f"dup:{pat}:{lineno}"))
-                continue
-            seen[pat] = i
-            if pat == "" and i != len(patterns) - 1:
+                    "GL-SHARD-RULE", rel, 1,
+                    f"plan-table entry {key!r}: mesh_shape {ms!r} does "
+                    f"not factor {shape_s[1:]} devices — stale "
+                    f"factorization",
+                    detail=f"table:factor:{key}"))
+            continue
+        rules = ent.get("rules")
+        patterns = [r[0] for r in (rules if isinstance(rules, list)
+                                   else [])
+                    if isinstance(r, list) and len(r) == 2
+                    and isinstance(r[0], str)]
+        if patterns:
+            findings.extend(_pattern_findings(patterns, rel, 1,
+                                              where=key))
+        try:
+            rank = len(shape_s.split("x"))
+            axes = ent.get("axes")
+            if isinstance(axes, list) and axes and len(axes) != rank:
                 findings.append(Finding(
-                    "GL-SHARD-RULE", path, lineno,
-                    "empty pattern matches every path — all later rules "
-                    "are dead",
-                    detail=f"empty:{lineno}"))
-            if _REGEXY.search(pat):
-                try:
-                    re.compile(pat)
-                except re.error as exc:
-                    findings.append(Finding(
-                        "GL-SHARD-RULE", path, lineno,
-                        f"rule pattern {pat!r} is not a valid regex: {exc}",
-                        detail=f"badre:{pat}:{lineno}"))
-            for prev in patterns[:i]:
-                if prev and prev in pat:
-                    findings.append(Finding(
-                        "GL-SHARD-RULE", path, lineno,
-                        f"rule {pat!r} is dead: earlier rule {prev!r} is "
-                        f"a substring, so it wins on every path the "
-                        f"later rule matches",
-                        detail=f"shadow:{prev}->{pat}:{lineno}"))
+                    "GL-SHARD-RULE", rel, 1,
+                    f"plan-table entry {key!r}: {len(axes)} axes vs "
+                    f"{rank}-d mesh shape {shape_s}",
+                    detail=f"table:rank:{key}"))
+        except ValueError:
+            pass
     return findings
 
 
@@ -333,4 +438,9 @@ def run(root) -> tuple[list, int]:
         findings.extend(check_axis_source("", rel, axes, tree=tree))
         findings.extend(check_donation_source("", rel, donors, tree=tree))
         findings.extend(check_rule_tables_source("", rel, tree=tree))
+    # the searched-placement artifact (ISSUE 16) rides the same gate
+    table_path = root / _PKG / "parallel" / "plan_table.json"
+    if table_path.exists():
+        findings.extend(check_plan_table_file(
+            table_path, table_path.relative_to(root).as_posix()))
     return findings, len(trees)

@@ -229,14 +229,15 @@ class LocalEmbeddings:
         # O(log N) shapes instead of one compile per distinct batch size.
         if self._mesh is not None:
             # Data-parallel mesh forward (ISSUE 15): bucket floored at dp
-            # so every shard holds ≥1 row; weights replicated per the
+            # (and the searched plan's bucket_min, ISSUE 16) so every
+            # shard holds ≥1 row; weights replicated per the
             # embeddings_forward plan, N/dp rows per chip on full-store
             # syncs. Tolerance vs the single-device oracle is documented
             # in docs/tpu-numerics.md.
             from ..parallel import plan as sharding_plan
 
             padded = pad_rows(tokens, sharding_plan.serve_bucket(
-                n, self._mesh))
+                n, self._mesh, plan="embeddings_forward"))
             placed = sharding_plan.sharded_params(
                 (self.checkpoint_dir or "shipped-default", self.seed),
                 params, self._mesh, "embeddings_forward")
@@ -341,7 +342,8 @@ class LocalEmbeddings:
 
         from ..parallel import plan as sharding_plan
 
-        rows = sharding_plan.serve_bucket(size, self._mesh)
+        rows = sharding_plan.serve_bucket(size, self._mesh,
+                                          plan="embeddings_forward")
         if self._arena_dirty or self._device_arena_rows != rows:
             with self.timer.stage("shard"):
                 padded = np.zeros((rows, self._arena.shape[1]), np.float32)

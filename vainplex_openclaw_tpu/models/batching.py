@@ -89,7 +89,8 @@ class ContinuousBatcher:
                  admission=None, timer: Optional[StageTimer] = None,
                  clock: Callable[[], float] = time.perf_counter,
                  autostart: bool = True, mesh=None,
-                 plan_family: str = "encoder_validator"):
+                 plan_family: str = "encoder_validator",
+                 searched_plans: bool = True):
         from .pretrained import available
 
         if not available(checkpoint_dir):
@@ -105,8 +106,12 @@ class ContinuousBatcher:
         # builders, shard/gather overhead attributed in the StageTimer.
         # None keeps the PR-14 single-device forward verbatim (the
         # equivalence oracle behind serve.meshServing:false).
+        # ``searched_plans=False`` (serve.searchedPlans) pins the
+        # hand-written rule tables — the ISSUE-16 escape hatch/oracle;
+        # True resolves through the checked-in searched plan table.
         self.mesh = mesh
         self.plan_family = plan_family
+        self.searched_plans = bool(searched_plans)
         self.checkpoint_dir = checkpoint_dir
         self.max_batch = max(1, int(max_batch))
         self.window_ms = float(window_ms)
@@ -224,35 +229,40 @@ class ContinuousBatcher:
         tokens = encode_texts([r.text for r in batch], cfg.seq_len,
                               cfg.vocab_size)
         if self.mesh is not None:
-            # Mesh-served step: bucket floored at the dp size so every
-            # shard holds ≥1 row (still O(log N) compiled shapes), then
-            # shard → compiled mesh forward → gather, each attributed.
+            # Mesh-served step: bucket floored at the dp size (and the
+            # plan's searched bucket_min) so every shard holds ≥1 row
+            # (still O(log N) compiled shapes), then shard → compiled
+            # mesh forward → gather, each attributed. The plan resolves
+            # ONCE per batch (override > searched table > hand-written)
+            # so bucket, placement, and compiled variant always agree.
             import os
 
             import jax
 
             from ..parallel import plan as sharding_plan
 
+            plan = sharding_plan.resolve_plan(
+                self.plan_family, self.mesh, searched=self.searched_plans)
             padded = pad_rows(tokens, sharding_plan.serve_bucket(
-                len(batch), self.mesh))
+                len(batch), self.mesh, plan=plan))
             t1 = self._clock()
             self.timer.add("batch", (t1 - t0) * 1e3)
             from .pretrained import DEFAULT_DIR
 
             ckpt_key = os.path.abspath(self.checkpoint_dir or DEFAULT_DIR)
             placed_params = sharding_plan.sharded_params(
-                ckpt_key, params, self.mesh, self.plan_family)
+                ckpt_key, params, self.mesh, plan)
             placed_tokens = sharding_plan.place_tokens(
-                padded, self.mesh, self.plan_family)
+                padded, self.mesh, plan)
             t_sh = self._clock()
             self.timer.add("shard", (t_sh - t1) * 1e3)
             out = sharding_plan.serve_forward(
-                placed_params, placed_tokens, cfg, self.mesh,
-                self.plan_family)
+                placed_params, placed_tokens, cfg, self.mesh, plan)
             jax.block_until_ready(out["severity"])
             t2 = self._clock()
             self.timer.add("prefill", (t2 - t_sh) * 1e3)
-            severity = np.asarray(out["severity"])  # replicated: one copy
+            severity = np.asarray(out["severity"])  # one copy (or per-shard
+            # assembly when the plan gathers "sharded")
             t_g = self._clock()
             self.timer.add("gather", (t_g - t2) * 1e3)
             t2 = t_g

@@ -57,6 +57,14 @@ SERVE_DEFAULTS = {
     "meshServing": False,
     "meshShape": None,
     "meshAxes": ["dp", "tp"],
+    # Searched placement (ISSUE 16): resolve the serving plan through the
+    # checked-in parallel/plan_table.json (regression-gated winners from
+    # `bench.py plan_search`), hand-written rules as the fallback. `false`
+    # IS the hand-written rule table verbatim — the equivalence oracle /
+    # escape hatch, never deleted (OPENCLAW_SEARCHED_PLANS=0 is the
+    # process-wide twin). Also lets meshShape:null consult the searched
+    # dp×tp factorization for the local device count.
+    "searchedPlans": True,
 }
 
 # Markers from llm_validator.build_prompt — the MESSAGE body is embedded
@@ -92,7 +100,8 @@ def _mesh_key(serve_cfg: dict):
         return None
     shape = serve_cfg.get("meshShape")
     return (tuple(int(s) for s in shape) if shape is not None else "auto",
-            tuple(serve_cfg.get("meshAxes") or ("dp", "tp")))
+            tuple(serve_cfg.get("meshAxes") or ("dp", "tp")),
+            bool(serve_cfg.get("searchedPlans", True)))
 
 
 def _resolve_mesh(serve_cfg: dict):
@@ -109,7 +118,21 @@ def _resolve_mesh(serve_cfg: dict):
     shape = serve_cfg.get("meshShape")
     if shape is None:
         n = len(jax.devices())
-        shape = (n,) if len(axes) == 1 else _factor(n) + (1,) * (len(axes) - 2)
+        if len(axes) == 1:
+            shape = (n,)
+        else:
+            # meshShape null = auto: the searched dp×tp factorization for
+            # this device count (plan_table.json nN entries, ISSUE 16)
+            # when enabled and shaped for these axes, else _factor — the
+            # pre-search default, kept as the fallback/oracle.
+            from ..parallel.plan import (
+                preferred_mesh_shape, searched_plans_enabled)
+
+            pref = preferred_mesh_shape(n) \
+                if serve_cfg.get("searchedPlans", True) \
+                and searched_plans_enabled() else None
+            shape = pref if pref is not None and len(pref) == len(axes) \
+                else _factor(n) + (1,) * (len(axes) - 2)
     return cached_mesh(tuple(int(s) for s in shape), axes)
 
 
@@ -129,7 +152,8 @@ def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict):
                 window_ms=serve_cfg["windowMs"],
                 admission=AdmissionController.from_config(
                     serve_cfg.get("admission")),
-                mesh=_resolve_mesh(serve_cfg))
+                mesh=_resolve_mesh(serve_cfg),
+                searched_plans=serve_cfg.get("searchedPlans", True))
             _batchers[key] = batcher
         return batcher
 

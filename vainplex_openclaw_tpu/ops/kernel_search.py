@@ -37,6 +37,9 @@ import os
 import time
 
 from .flash_attention import backend_family, default_block, table_key
+from .search_common import SweepState, config_key
+from .search_common import load_state as _load_state  # noqa: F401 — re-export
+from .search_common import save_state as _save_state  # noqa: F401 — re-export
 from .similarity import pow2_bucket as _pow2_bucket
 
 #: block candidates per side on real TPUs — 2048² fails Mosaic compile on
@@ -156,28 +159,11 @@ def measure_point(L: int, block_q: int, block_k: int, *,
     return rec
 
 
-# ── resumable state ──────────────────────────────────────────────────
-
-
-def _load_state(path: "str | None") -> dict:
-    if not path or not os.path.exists(path):
-        return {}
-    try:
-        with open(path, encoding="utf-8") as f:
-            state = json.load(f)
-        return state if isinstance(state, dict) else {}
-    except (OSError, ValueError):
-        return {}
-
-
-def _save_state(path: "str | None", state: dict) -> None:
-    if not path:
-        return
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(state, f)
-    os.replace(tmp, path)
-
+# ── resumable state: shared harness (ops/search_common.py, ISSUE 16) —
+# ``_load_state``/``_save_state`` re-exported above for callers that
+# predate the extraction; the resume semantics (error records re-measure,
+# atomic writes, config-hash keys) live in SweepState so this loop and
+# parallel/plan_search cannot drift apart.
 
 # ── the search loop ──────────────────────────────────────────────────
 
@@ -199,7 +185,7 @@ def search(seq_lens: tuple, *, dtype: str = "bfloat16",
     candidates are recorded as skipped and the NEXT length still runs
     (partial results beat a dead sweep; the ISSUE-14 satellite rule)."""
     family = backend_family()
-    state = _load_state(state_path)
+    state = SweepState(state_path, done_field="ms")
     results: dict = {}
     for L in seq_lens:
         key = bucket_key(L, dtype, family)
@@ -207,13 +193,15 @@ def search(seq_lens: tuple, *, dtype: str = "bfloat16",
         t_len = clock()
         cands, skipped = [], 0
         for i, (bq, bk) in enumerate(pairs):
-            pkey = f"{key}:{bq}x{bk}:s{steps}r{rounds}seed{seed}"
-            prior = state.get(pkey)
-            if prior is not None and prior.get("ms") is not None:
+            pkey = config_key(f"{key}:{bq}x{bk}", ("s", steps),
+                              ("r", rounds), ("seed", seed))
+            prior = state.finished(pkey)
+            if prior is not None:
                 # resume hit: measured by a prior run. Error records do
                 # NOT count as finished — a transient tunnel failure must
-                # be re-measured, not permanently ban the candidate.
-                rec = {**prior, "resumed": True}
+                # be re-measured, not permanently ban the candidate
+                # (SweepState.finished owns that contract).
+                rec = prior
             elif budget_s_per_len and i > 0 \
                     and clock() - t_len > budget_s_per_len:
                 skipped += 1
@@ -221,8 +209,7 @@ def search(seq_lens: tuple, *, dtype: str = "bfloat16",
             else:
                 rec = measure_point(L, bq, bk, dtype=dtype, steps=steps,
                                     rounds=rounds, seed=seed, clock=clock)
-                state[pkey] = {k: v for k, v in rec.items() if k != "resumed"}
-                _save_state(state_path, state)
+                state.record(pkey, rec)
             cands.append(rec)
             if log is not None:
                 log(f"kernel_search {key} {bq}x{bk}: "

@@ -1,0 +1,83 @@
+"""Shared seeded-resumable sweep harness (ISSUE 16 satellite).
+
+Both offline search loops — :mod:`.kernel_search` (flash block shapes,
+ISSUE 14) and :mod:`..parallel.plan_search` (placement plans, ISSUE 16) —
+follow the same artifact discipline: every measured point is persisted to
+a state file the moment it lands, keyed by a config-hash string that
+encodes the point's FULL measurement identity (candidate + every knob
+that changes the number), so a killed sweep resumes from its last
+finished point and a re-run with different knobs re-measures instead of
+resuming a stale record.
+
+The resume semantics live here so the two loops cannot drift:
+
+- a record counts as *finished* only when its ``done_field`` (``"ms"``
+  for kernels, ``"rps"`` for plans) carries a real value;
+- persisted ERROR records are NOT finished points — they re-measure on
+  resume, so a one-off tunnel failure never permanently bans a candidate
+  (the FLASH_SWEEP_r04 lesson);
+- writes are atomic (tmp + ``os.replace``) — a sweep killed mid-write
+  leaves the previous state intact, never a truncated JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def load_state(path: "str | None") -> dict:
+    """Parsed sweep state ({} when missing/invalid — an unreadable state
+    file restarts the sweep, it must never kill it)."""
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            state = json.load(f)
+        return state if isinstance(state, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(path: "str | None", state: dict) -> None:
+    """Atomic persist (tmp + replace); a None path disables persistence."""
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+def config_key(prefix: str, *knobs) -> str:
+    """Config-hash point key: ``prefix:k1v1k2v2…`` from ordered
+    (name, value) pairs. The knob tuple IS the point identity — both
+    sweeps build their state keys through this one function so the
+    written and resumed identities can never use different formats."""
+    return prefix + ":" + "".join(f"{k}{v}" for k, v in knobs)
+
+
+class SweepState:
+    """One sweep's resumable state file.
+
+    ``finished(pkey)`` returns the prior record (marked ``resumed``) only
+    when it actually finished — its ``done_field`` holds a value; error
+    records return None and therefore re-measure. ``record(pkey, rec)``
+    persists immediately (crash-durable per point), stripping any
+    ``resumed`` marker so a record never ships a stale resume flag.
+    """
+
+    def __init__(self, path: "str | None", done_field: str = "ms"):
+        self.path = path
+        self.done_field = done_field
+        self.state = load_state(path)
+
+    def finished(self, pkey: str) -> "dict | None":
+        prior = self.state.get(pkey)
+        if prior is not None and prior.get(self.done_field) is not None:
+            return {**prior, "resumed": True}
+        return None
+
+    def record(self, pkey: str, rec: dict) -> None:
+        self.state[pkey] = {k: v for k, v in rec.items() if k != "resumed"}
+        save_state(self.path, self.state)

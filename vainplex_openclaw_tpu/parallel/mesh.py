@@ -30,6 +30,14 @@ def _factor(n: int) -> tuple[int, int]:
     return n // tp, tp
 
 
+def factorizations(n: int) -> list:
+    """Every integer (dp, tp) factorization of ``n``, tp ascending —
+    the dp×tp candidate axis the placement sweep enumerates for a device
+    count (parallel/plan_search.py, ISSUE 16). ``_factor(n)`` is always a
+    member: the hand-written heuristic stays in the searched space."""
+    return [(n // tp, tp) for tp in range(1, n + 1) if n % tp == 0]
+
+
 def make_mesh(n_devices: Optional[int] = None, axes: Sequence[str] = ("dp", "tp"),
               shape: Optional[Sequence[int]] = None) -> Mesh:
     devices = jax.devices()

@@ -1,0 +1,757 @@
+"""Sketch-constrained placement search for serving plans (ISSUE 16).
+
+The hand-written rule tables in parallel/plan.py encode ONE point in the
+placement space (Megatron column→row splits, bucket floor 1, replicated
+gather) — chosen by reasoning, never by measurement. This module turns
+partition plans into the same regression-gated artifact discipline the
+flash kernel search established (ops/kernel_search.py): a seeded,
+resumable sweep per (device family, mesh shape, servable family) whose
+winners land in the checked-in ``parallel/plan_table.json`` that
+:func:`~.plan.serving_plan` consults at load.
+
+Three stages, TACCL-shaped:
+
+- **Candidate enumeration** — sketch-legal variants of the hand-written
+  tables: per-site column/row/replicated split assignments, the
+  :func:`serve_bucket` floor (``bucket_min``), and the output gather
+  ordering (``replicated`` vs ``sharded``). The dp×tp factorization axis
+  is swept by passing multiple shapes of one device count (see
+  ``parallel/mesh.factorizations``); the best shape per count lands as a
+  ``device_family:nN:family`` entry that ``meshShape: null`` consults.
+- **The communication sketch** — a declared, symbolic bound on the
+  collective pattern a plan may induce (:class:`CommSketch`). Producer→
+  consumer matmul pairs may be Megatron column→row (one psum rides the
+  fabric) or fully replicated (zero collectives); loose sites (the
+  embedding gather) are capped; everything else — a column split whose
+  consumer is replicated gathers a wide intermediate, a row split with a
+  replicated producer pays a psum without sharded compute — is rejected
+  BEFORE any compile is spent. Sketch checking is pure Python over the
+  assignment; an illegal candidate costs microseconds, not a trace.
+- **Measurement + the gate** — fitness comes from the real
+  ``bench.py mesh_serve`` machinery: candidates flow through the
+  UNCHANGED serving path (:func:`~.plan.plan_override` routes the
+  ContinuousBatcher / embeddings backend through the candidate), fitness
+  is served requests/s (validator) or search queries/s (embeddings) with
+  shard/gather stage quantiles attributed. A candidate wins only when it
+  is **measured faster than the hand-written incumbent (by ``minGain``)
+  AND verdict/search-parity with the single-device oracle AND
+  RetraceWitness-clean** — zero XLA compiles in the timed phase.
+
+Seeded and resumable on the shared harness (ops/search_common.py): every
+measured point persists the moment it lands, error records re-measure on
+resume, and the same seed reproduces the same fixture mix. Only a table
+that passes :func:`validate_plan_table` may be written.
+
+CLI: ``python bench.py plan_search`` (record contract in bench.py);
+workflow: docs/serving-perf.md, artifact lint: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.search_common import SweepState, config_key
+from .plan import (GATHER_MODES, PLAN_TABLE, PLAN_TABLE_SCHEMA, ShardingPlan,
+                   plan_entry_problems, spec_to_json)
+
+# Sweep knobs (GL-DRIFT-CONFIG site): merged under whatever settings the
+# caller passes. ``minGain`` keeps measurement noise out of the committed
+# artifact — a candidate must beat the incumbent by the margin, not tie
+# within jitter. ``budgetS`` bounds one (family, shape) candidate loop;
+# on expiry the rest are recorded skipped and the NEXT point still runs.
+PLAN_SEARCH_DEFAULTS = {
+    "families": ("encoder_validator", "embeddings_forward"),
+    "shapes": ((1, 1), (2, 1), (2, 4)),
+    "requests": 32,
+    "concurrency": 8,
+    "maxBatch": 16,
+    "windowMs": 0.5,
+    "facts": 48,
+    "queries": 12,
+    "bucketMins": (1, 2, 4),
+    "minGain": 0.05,
+    "budgetS": None,
+    "seed": 0,
+}
+
+
+# ── communication sketches ───────────────────────────────────────────
+
+#: split choices per site → the PartitionSpec fragment they compile to.
+_CHOICE_SPECS = {"col": P(None, "tp"), "row": P("tp", None), "rep": P()}
+
+#: encoder sites: (site, choices, rule patterns the choice governs). The
+#: rule ORDER reproduces ENCODER_VALIDATOR_RULES exactly, so the
+#: canonical assignment's rules compare equal to the hand-written table.
+_ENCODER_SITES = (
+    ("qkv", ("col", "rep"), ("attn/q$", "attn/k$", "attn/v$")),
+    ("o", ("row", "rep"), ("attn/o$",)),
+    ("w1", ("col", "rep"), ("mlp/w1$",)),
+    ("w2", ("row", "rep"), ("mlp/w2$",)),
+    ("embed", ("col", "rep"), ("embed/tok$", "embed/pos$")),
+)
+
+
+@dataclass(frozen=True)
+class CommSketch:
+    """Declared bound on the collective pattern a plan may induce.
+
+    ``pairs``: (producer, consumer) matmul sites whose split pattern must
+    appear in ``allowed_pairs`` — ``("col", "row")`` is Megatron (sharded
+    compute, one psum), ``("rep", "rep")`` is zero-collective. Any other
+    combination re-materializes a wide intermediate or pays a reduce
+    without sharded compute, and is rejected before compilation.
+    ``loose_sites`` may each contribute at most one gather-class
+    collective, capped by ``max_loose_collectives``; every site the
+    sketch does not name must stay replicated."""
+
+    family: str
+    pairs: tuple = ()
+    allowed_pairs: tuple = ()
+    loose_sites: tuple = ()
+    loose_allowed: tuple = ("rep",)
+    max_loose_collectives: int = 0
+
+
+SKETCHES = {
+    "encoder_validator": CommSketch(
+        family="encoder_validator",
+        pairs=(("qkv", "o"), ("w1", "w2")),
+        allowed_pairs=(("col", "row"), ("rep", "rep")),
+        loose_sites=("embed",),
+        loose_allowed=("col", "rep"),
+        max_loose_collectives=1),
+    # Embeddings forward is data-parallel by contract: weights replicated,
+    # zero weight collectives — a sharded-weights candidate is enumerated
+    # (the sketch must DO something) and always rejected here.
+    "embeddings_forward": CommSketch(family="embeddings_forward"),
+}
+
+
+def sketch_check(family: str, assignment: tuple,
+                 mesh_shape: tuple) -> tuple:
+    """(legal, reason, collectives) for one split assignment — pure
+    Python, no jax, no compile: this is the cheap rejection layer.
+    ``collectives`` is the symbolic signature (kind, site) the plan would
+    induce on the model axis."""
+    sketch = SKETCHES[family]
+    a = dict(assignment)
+    covered = {s for pair in sketch.pairs for s in pair}
+    covered |= set(sketch.loose_sites)
+    for site, choice in assignment:
+        if site not in covered and choice != "rep":
+            return (False, f"{site}={choice}: site outside the sketch's "
+                           f"declared collective pattern must stay "
+                           f"replicated", [])
+    colls: list = []
+    for prod_site, cons_site in sketch.pairs:
+        pat = (a.get(prod_site, "rep"), a.get(cons_site, "rep"))
+        if pat not in sketch.allowed_pairs:
+            return (False, f"{prod_site}={pat[0]} → {cons_site}={pat[1]} "
+                           f"is not an allowed producer→consumer pattern "
+                           f"(sketch allows {sketch.allowed_pairs})", [])
+        if pat != ("rep", "rep"):
+            colls.append(("psum", f"{prod_site}->{cons_site}"))
+    n_loose = 0
+    for site in sketch.loose_sites:
+        choice = a.get(site, "rep")
+        if choice not in sketch.loose_allowed:
+            return (False, f"{site}={choice} not in the sketch's allowed "
+                           f"loose choices {sketch.loose_allowed}", [])
+        if choice != "rep":
+            n_loose += 1
+            colls.append(("all_gather", site))
+    if n_loose > sketch.max_loose_collectives:
+        return (False, f"{n_loose} loose collectives exceed the sketch "
+                       f"bound {sketch.max_loose_collectives}", [])
+    return True, "", colls
+
+
+# ── candidate enumeration ────────────────────────────────────────────
+
+
+@dataclass(frozen=True)
+class PlanCandidate:
+    cand_id: str
+    family: str
+    plan: ShardingPlan
+    assignment: tuple = ()
+
+
+def _cand_id(assignment: tuple, bucket_min: int, gather: str) -> str:
+    sites = ",".join(f"{s}={c}" for s, c in assignment)
+    return f"{sites}|bm{bucket_min}|{gather}"
+
+
+def _assignments(family: str, mesh_shape: tuple) -> list:
+    """Every split assignment for one family on one mesh shape — sketch
+    legality is NOT applied here (enumerate, then reject, so the sweep
+    can report how much of the space the sketch pruned)."""
+    if family == "embeddings_forward":
+        return [(("weights", "rep"),), (("weights", "split"),)]
+    tp = int(mesh_shape[1]) if len(mesh_shape) > 1 else 1
+    if tp <= 1:
+        # degenerate model axis: every split collapses to replication —
+        # one canonical assignment instead of 2^sites aliases.
+        return [tuple((site, "rep") for site, _, _ in _ENCODER_SITES)]
+    names = [site for site, _, _ in _ENCODER_SITES]
+    choice_lists = [choices for _, choices, _ in _ENCODER_SITES]
+    return [tuple(zip(names, combo))
+            for combo in itertools.product(*choice_lists)]
+
+
+def _candidate_plan(family: str, assignment: tuple, bucket_min: int,
+                    gather: str) -> ShardingPlan:
+    a = dict(assignment)
+    if family == "embeddings_forward":
+        spec = P() if a.get("weights", "rep") == "rep" else P("dp", None)
+        rules: tuple = (("", spec),)
+        axes: tuple = ("dp",)
+    else:
+        out = []
+        for site, _choices, patterns in _ENCODER_SITES:
+            spec = _CHOICE_SPECS[a.get(site, "rep")]
+            out.extend((pat, spec) for pat in patterns)
+        out.append(("", P()))
+        rules, axes = tuple(out), ("dp", "tp")
+    return ShardingPlan(
+        family=family, rules=rules, data_spec=P("dp"), axes=axes,
+        description="plan-search candidate "
+                    + _cand_id(assignment, bucket_min, gather),
+        bucket_min=int(bucket_min), gather=gather, source="candidate")
+
+
+def enumerate_candidates(family: str, mesh_shape: tuple,
+                         bucket_mins: tuple = (1, 2, 4)) -> tuple:
+    """(candidates, rejected) for one (family, mesh shape). The
+    hand-written incumbent is ALWAYS candidate 0 (it is the baseline the
+    gate compares against); sketch-illegal assignments never expand into
+    bucket/gather variants — they are rejected once, compile-free, and
+    returned as ``{"assignment", "reason"}`` records."""
+    base = PLAN_TABLE[family]
+    cands = [PlanCandidate("incumbent", family, base)]
+    rejected: list = []
+    for assignment in _assignments(family, mesh_shape):
+        legal, reason, _colls = sketch_check(family, assignment, mesh_shape)
+        if not legal:
+            rejected.append({"assignment": dict(assignment),
+                             "reason": reason})
+            continue
+        for bm in bucket_mins:
+            for gather in GATHER_MODES:
+                plan = _candidate_plan(family, assignment, bm, gather)
+                if plan.rules == base.rules and bm == base.bucket_min \
+                        and gather == base.gather:
+                    continue  # identical to the incumbent baseline
+                cands.append(PlanCandidate(
+                    _cand_id(assignment, bm, gather), family, plan,
+                    tuple(assignment)))
+    return cands, rejected
+
+
+# ── seeded fixtures ──────────────────────────────────────────────────
+
+
+class _NullLog:
+    def info(self, *_a):
+        pass
+    warn = error = info
+
+
+def _seeded_texts(n: int, seed: int) -> list:
+    """The bench.py mesh_serve validator mix (seeded): plain message
+    texts — ``_extract_message`` passes them through verbatim on both the
+    one-shot oracle and the batched path."""
+    rng = np.random.default_rng(seed)
+    subjects = ("deploy", "quarterly report", "incident", "migration",
+                "customer email", "release", "audit", "benchmark")
+    verbs = ("completed", "failed", "regressed", "crashed", "improved",
+             "shipped", "stalled", "recovered")
+    return [f"The {rng.choice(subjects)} {rng.choice(verbs)} with code "
+            f"{int(rng.integers(0, 500))}; throughput changed "
+            f"{int(rng.integers(-60, 90))}%." for _ in range(n)]
+
+
+def _synth_facts(n: int, seed: int) -> list:
+    from types import SimpleNamespace
+
+    rng = np.random.default_rng(seed + 1)
+    subj = ("deploy", "db", "api", "release", "pipeline", "cache")
+    preds = ("failed-with", "depends-on", "improved", "blocked-by")
+    return [SimpleNamespace(
+        id=f"f{i}", subject=str(rng.choice(subj)),
+        predicate=str(rng.choice(preds)),
+        object=f"thing-{int(rng.integers(0, 60))}",
+        source="plan-search", created_at="2026-08-03") for i in range(n)]
+
+
+def _seeded_queries(n: int, seed: int) -> list:
+    """Distinct query texts (seeded): each timed search must MISS the
+    embeddings query cache, or the sweep would measure an OrderedDict."""
+    rng = np.random.default_rng(seed + 2)
+    subj = ("deploy", "db", "api", "release", "pipeline", "cache")
+    preds = ("failed", "depends", "improved", "blocked")
+    return [f"{rng.choice(subj)} {rng.choice(preds)} thing-{i}"
+            for i in range(n)]
+
+
+# ── one measured candidate ───────────────────────────────────────────
+
+
+def _measure_validator(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
+                       fx: dict, clock) -> dict:
+    import threading
+
+    from ..analysis import RetraceWitness
+    from ..models import encode_texts
+    from ..models.batching import ContinuousBatcher
+    from ..models.pretrained import load_pretrained
+    from ..ops.similarity import pad_rows
+    from . import plan as sharding_plan
+    from .mesh import cached_mesh
+
+    texts, ref = fx["texts"], fx["ref"]
+    mesh = cached_mesh(tuple(mesh_shape))
+    loaded = load_pretrained(None)
+    if loaded is None:
+        raise RuntimeError("plan_search: no shipped checkpoint")
+    cfg = loaded[0]
+    n = len(texts)
+    with sharding_plan.plan_override("encoder_validator", plan):
+        batcher = ContinuousBatcher(max_batch=int(scfg.get("maxBatch")),
+                                    window_ms=float(scfg.get("windowMs")),
+                                    mesh=mesh)
+        try:
+            # Warm every bucket this run can form under THIS plan (its
+            # bucket_min moves the floor) so the timed phase is
+            # compile-free by construction — the mesh_serve discipline.
+            placed = sharding_plan.sharded_params(
+                "plan-search", loaded[1], mesh, plan)
+            buckets = sorted({sharding_plan.serve_bucket(b, mesh, plan=plan)
+                              for b in range(1, batcher.max_batch + 1)})
+            for b in buckets:
+                toks = pad_rows(encode_texts(["warmup"], cfg.seq_len,
+                                             cfg.vocab_size), b)
+                np.asarray(sharding_plan.serve_forward(
+                    placed, sharding_plan.place_tokens(toks, mesh, plan),
+                    cfg, mesh, plan)["severity"])
+            witness = RetraceWitness()
+            witness.probe("plan_search_forward",
+                          sharding_plan._build_serve_forward(cfg, mesh, plan))
+            base = witness.baseline()
+
+            results: list = [None] * n
+            errors: list = [None] * n
+            nxt = {"i": 0}
+            ilock = threading.Lock()
+
+            def worker():
+                while True:
+                    with ilock:
+                        i = nxt["i"]
+                        if i >= n:
+                            return
+                        nxt["i"] = i + 1
+                    try:
+                        results[i] = batcher.submit(texts[i])
+                    except Exception as exc:  # noqa: BLE001 — surfaced below
+                        errors[i] = exc
+
+            t0 = clock()
+            threads = [threading.Thread(target=worker)
+                       for _ in range(max(1, int(scfg.get("concurrency"))))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = clock() - t0
+            failed = [e for e in errors if e is not None]
+            if failed:
+                raise RuntimeError(
+                    f"{len(failed)}/{n} submits raised") from failed[0]
+            q = batcher.timer.quantiles()
+            return {
+                "rps": round(n / dt, 2),
+                "mismatches": sum(1 for a, b in zip(results, ref) if a != b),
+                "retraces": int(witness.traces("plan_search_forward")
+                                - base.get("plan_search_forward", 0)),
+                "mean_batch": batcher.stats()["meanBatch"],
+                "shard_ms_p95": (q.get("shard") or {}).get("p95"),
+                "gather_ms_p95": (q.get("gather") or {}).get("p95"),
+            }
+        finally:
+            batcher.close()
+
+
+def _measure_embeddings(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
+                        fx: dict, clock) -> dict:
+    from ..analysis import RetraceWitness
+    from ..knowledge.embeddings import create_embeddings
+    from . import plan as sharding_plan
+    from .mesh import cached_mesh
+
+    facts, queries, ref = fx["facts"], fx["queries"], fx["ref_search"]
+    n = int(np.prod(mesh_shape))
+    mesh = cached_mesh((n,), ("dp",))
+    with sharding_plan.plan_override("embeddings_forward", plan):
+        emb = create_embeddings(
+            {"backend": "local", "meshServing": True, "meshShape": [n]},
+            _NullLog())
+        t0 = clock()
+        emb.sync(facts)  # untimed: model init + embed compiles + placement
+        sync_s = clock() - t0
+        # Warm the query-embed bucket and the arena matmul with queries
+        # OUTSIDE the timed set (the timed queries must miss the cache).
+        emb.search("plan search warmup one", k=5)
+        emb.search("plan search warmup two", k=5)
+        cfg = emb._ensure_model()[0]
+        witness = RetraceWitness()
+        witness.probe("plan_search_embed",
+                      sharding_plan._build_serve_forward(cfg, mesh, plan))
+        witness.probe("plan_search_arena",
+                      sharding_plan._build_arena_scores(mesh, "dp"))
+        base = witness.baseline()
+        t0 = clock()
+        got = [emb.search(q_text, k=5) for q_text in queries]
+        dt = clock() - t0
+        mism = sum(1 for g, r in zip(got, ref)
+                   if [x["id"] for x in g] != [x["id"] for x in r])
+        score_dev = 0.0
+        for g, r in zip(got, ref):
+            if g and r:
+                score_dev = max(score_dev, max(
+                    abs(x["score"] - y["score"]) for x, y in zip(g, r)))
+        retraces = sum(
+            int(witness.traces(name) - base.get(name, 0))
+            for name in ("plan_search_embed", "plan_search_arena"))
+        q = emb.timer.quantiles()
+        return {
+            "rps": round(len(queries) / dt, 2),
+            "mismatches": mism,
+            "search_score_dev": round(float(score_dev), 6),
+            "retraces": retraces,
+            "sync_facts_s": round(len(facts) / sync_s, 1) if sync_s else None,
+            "shard_ms_p95": (q.get("shard") or {}).get("p95"),
+            "gather_ms_p95": None,
+        }
+
+
+def measure_candidate(family: str, plan: ShardingPlan, mesh_shape: tuple,
+                      scfg: dict, fixtures: dict,
+                      clock=time.perf_counter) -> dict:
+    """Fitness for one candidate through the REAL serving machinery
+    (plan_override → ContinuousBatcher / embeddings backend). Returns a
+    record whose ``rps`` is the done-field; failures come back as
+    ``{"error": ...}`` records — a failed candidate is DATA, not a dead
+    sweep (the FLASH_SWEEP_r04 lesson)."""
+    from . import plan as sharding_plan
+
+    # Fresh caches per candidate: placements and compiled variants are
+    # keyed by plan, so nothing leaks between candidates — but the
+    # unbounded placement dict would otherwise grow with the sweep.
+    sharding_plan.clear_plan_caches()
+    rec: dict = {"family": family, "mesh_shape": list(mesh_shape)}
+    t0 = clock()
+    try:
+        if family == "embeddings_forward":
+            rec.update(_measure_embeddings(plan, mesh_shape, scfg,
+                                           fixtures, clock))
+        else:
+            rec.update(_measure_validator(plan, mesh_shape, scfg,
+                                          fixtures, clock))
+    except Exception as exc:  # noqa: BLE001 — a rejected candidate is data
+        rec["error"] = str(exc)[:200]
+    rec["elapsed_s"] = round(clock() - t0, 2)
+    return rec
+
+
+# ── the search loop ──────────────────────────────────────────────────
+
+
+def search(settings: "dict | None" = None, *,
+           state_path: "str | None" = None, log=None,
+           clock=time.perf_counter) -> dict:
+    """Sweep every sketch-legal candidate per (family, mesh shape);
+    returns ``{"device_family", "seed", "sweeps", "factorizations"}``.
+
+    ``state_path`` makes the sweep resumable on the shared harness:
+    finished points read back instead of re-measuring (same seed → same
+    point identity); persisted ERROR records re-measure on resume. The
+    gate per point: a candidate must beat the incumbent's rps by
+    ``minGain`` AND hold oracle parity AND read zero retraces — anything
+    else keeps the hand-written plan."""
+    import jax
+
+    scfg = {**PLAN_SEARCH_DEFAULTS, **(settings or {})}
+    from ..ops.flash_attention import backend_family
+
+    seed = int(scfg.get("seed"))
+    families = tuple(scfg.get("families"))
+    shapes = tuple(tuple(int(x) for x in s) for s in scfg.get("shapes"))
+    bucket_mins = tuple(int(b) for b in scfg.get("bucketMins"))
+    budget_s = scfg.get("budgetS")
+    min_gain = float(scfg.get("minGain"))
+    fam_dev = backend_family()
+    state = SweepState(state_path, done_field="rps")
+
+    need = max(int(np.prod(s)) for s in shapes)
+    have = len(jax.devices())
+    if have < need:
+        raise RuntimeError(
+            f"plan_search: largest shape needs {need} devices, process "
+            f"has {have} — run `python bench.py plan_search` (the CLI "
+            f"re-execs onto virtual CPU host devices) or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need}")
+
+    # Seeded fixtures + single-device oracle references, computed ONCE —
+    # every candidate on every shape is pinned against the same oracle.
+    fixtures: dict = {}
+    if "encoder_validator" in families:
+        from ..models.serve import make_local_call_llm
+
+        texts = _seeded_texts(int(scfg.get("requests")), seed)
+        oneshot = make_local_call_llm(
+            serve_cfg={"continuousBatching": False}, force=True)
+        fixtures["texts"] = texts
+        fixtures["ref"] = [oneshot(t) for t in texts]
+    if "embeddings_forward" in families:
+        from ..knowledge.embeddings import create_embeddings
+
+        facts = _synth_facts(int(scfg.get("facts")), seed)
+        queries = _seeded_queries(int(scfg.get("queries")), seed)
+        oracle = create_embeddings({"backend": "local"}, _NullLog())
+        oracle.sync(facts)
+        fixtures["facts"] = facts
+        fixtures["queries"] = queries
+        fixtures["ref_search"] = [oracle.search(q, k=5) for q in queries]
+
+    sweeps: dict = {}
+    for family in families:
+        seen: set = set()
+        for shape in shapes:
+            # embeddings meshes are 1-D over dp: a (2, 4) serve shape
+            # collapses to (8,), and duplicate counts sweep once.
+            mesh_shape = (int(np.prod(shape)),) \
+                if family == "embeddings_forward" else shape
+            if mesh_shape in seen:
+                continue
+            seen.add(mesh_shape)
+            key = (f"{fam_dev}:"
+                   f"{'x'.join(str(s) for s in mesh_shape)}:{family}")
+            cands, rejected = enumerate_candidates(family, mesh_shape,
+                                                   bucket_mins)
+            t_point = clock()
+            skipped = 0
+            measured: list = []
+            warmed = False
+            for i, cand in enumerate(cands):
+                pkey = config_key(f"{key}:{cand.cand_id}",
+                                  ("req", len(fixtures.get("texts") or [])
+                                   if family == "encoder_validator"
+                                   else len(fixtures.get("queries") or [])),
+                                  ("seed", seed))
+                prior = state.finished(pkey)
+                if prior is not None:
+                    rec = prior
+                elif budget_s and i > 0 \
+                        and clock() - t_point > float(budget_s):
+                    skipped += 1
+                    continue
+                else:
+                    if not warmed:
+                        # One DISCARDED measurement per point: the first
+                        # run on a shape pays one-time costs (imports,
+                        # thread spin-up, mesh buffers) that would skew
+                        # whichever candidate went first — usually the
+                        # incumbent, inflating every speedup.
+                        measure_candidate(family, cand.plan, mesh_shape,
+                                          scfg, fixtures, clock=clock)
+                        warmed = True
+                    rec = measure_candidate(family, cand.plan, mesh_shape,
+                                            scfg, fixtures, clock=clock)
+                    rec["candidate"] = cand.cand_id
+                    state.record(pkey, rec)
+                measured.append((cand, rec))
+                if log is not None:
+                    log(f"plan_search {key} {cand.cand_id}: "
+                        f"{rec.get('rps', rec.get('error'))}")
+            baseline = measured[0][1] if measured else None
+            best_cand, best = measured[0] if measured else (None, None)
+            if baseline is not None and baseline.get("rps") is not None:
+                floor = baseline["rps"] * (1.0 + min_gain)
+                for cand, rec in measured[1:]:
+                    # the gate: faster than the hand-written incumbent (by
+                    # minGain) AND oracle parity AND zero retraces — a tie,
+                    # a mismatch, or a dirty winner keeps the incumbent.
+                    if rec.get("rps") is None or rec.get("retraces") != 0 \
+                            or rec.get("mismatches", 1) != 0:
+                        continue
+                    if rec["rps"] >= floor and rec["rps"] > best["rps"]:
+                        best_cand, best = cand, rec
+            improved = best is not None and best is not baseline
+            res = {"family": family, "mesh_shape": list(mesh_shape),
+                   "baseline": baseline, "best": best,
+                   "candidates": [r for _, r in measured],
+                   "improved": improved,
+                   "sketch_rejected": len(rejected),
+                   "rejected": rejected,
+                   "skipped_candidates": skipped,
+                   "partial": bool(skipped)}
+            if improved:
+                res["entry"] = entry_from_plan(best_cand.plan, best,
+                                               baseline, seed)
+            sweeps[key] = res
+
+    # Best dp×tp factorization per device count (encoder only — the
+    # embeddings mesh is dp-only, one shape per count): the nN entries
+    # serve.meshShape:null consults.
+    factorizations: dict = {}
+    for family in families:
+        if family == "embeddings_forward":
+            continue
+        by_n: dict = {}
+        for res in sweeps.values():
+            if res["family"] != family:
+                continue
+            rps = (res.get("best") or {}).get("rps")
+            if rps is None:
+                continue
+            n = int(np.prod(res["mesh_shape"]))
+            by_n.setdefault(n, []).append((rps, tuple(res["mesh_shape"])))
+        for n, points in by_n.items():
+            if n < 2 or len(points) < 2:
+                continue  # a lone shape proves nothing about factorization
+            rps, shape = max(points)
+            ranked = ",".join("x".join(str(x) for x in s)
+                              for _, s in sorted(points, reverse=True))
+            factorizations[f"{fam_dev}:n{n}:{family}"] = {
+                "mesh_shape": [int(x) for x in shape],
+                "rps": rps,
+                "source": f"plan_search seed={seed}: best of {ranked}",
+            }
+    return {"device_family": fam_dev, "seed": seed, "sweeps": sweeps,
+            "factorizations": factorizations}
+
+
+# ── table emission + the regression gate ─────────────────────────────
+
+
+def entry_from_plan(plan: ShardingPlan, rec: dict, baseline: dict,
+                    seed: int) -> dict:
+    """The plan-table-v1 JSON entry for one winning candidate — the
+    serialization twin of ``plan._plan_from_entry`` (round-trip pinned in
+    tests/test_plan_search.py)."""
+    return {
+        "rules": [[pat, spec_to_json(spec)] for pat, spec in plan.rules],
+        "data_spec": spec_to_json(plan.data_spec),
+        "axes": list(plan.axes),
+        "bucket_min": int(plan.bucket_min),
+        "gather": plan.gather,
+        "rps": rec.get("rps"),
+        "baseline_rps": (baseline or {}).get("rps"),
+        "candidate": rec.get("candidate"),
+        "source": f"plan_search seed={seed} "
+                  f"gate=faster+parity+zero-retraces",
+    }
+
+
+def to_table(results: dict, base_table: "dict | None" = None) -> dict:
+    """Merge sweep winners into a plan-table dict (schema v1). Only
+    IMPROVED points land (the hand-written rules need no entry — they are
+    the fallback); existing entries for other shapes/device families
+    survive, so a CPU mini-sweep cannot strip committed TPU rows."""
+    base = base_table or {}
+    table = {"schema": PLAN_TABLE_SCHEMA,
+             "provenance": dict(base.get("provenance") or {}),
+             "entries": dict(base.get("entries") or {})}
+    table["provenance"]["generator"] = \
+        "python bench.py plan_search --write-table <path>"
+    table["provenance"]["gate"] = (
+        "faster than the hand-written incumbent AND single-device oracle "
+        "parity AND zero retraces in the timed phase")
+    for key, res in (results.get("sweeps") or {}).items():
+        ent = res.get("entry")
+        if res.get("improved") and ent is not None:
+            table["entries"][key] = ent
+    for key, ent in (results.get("factorizations") or {}).items():
+        table["entries"][key] = {"mesh_shape": ent["mesh_shape"],
+                                 "rps": ent.get("rps"),
+                                 "source": ent.get("source")}
+    return table
+
+
+def write_table(table: dict, path: str) -> str:
+    import json
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def validate_plan_table(table) -> list:
+    """Regression-gate findings for a plan table (empty list = clean).
+    CI runs this against the committed file AND against every freshly
+    searched table before it may be written — the artifact is linted,
+    not trusted. Per-entry schema problems come from the SAME
+    ``plan_entry_problems`` the loader's loud-fallback path uses, so the
+    gate and the consumer cannot drift on what "malformed" means."""
+    findings: list = []
+    if not isinstance(table, dict):
+        return ["table is not an object"]
+    if table.get("schema") != PLAN_TABLE_SCHEMA:
+        findings.append(f"unknown schema {table.get('schema')!r}")
+    entries = table.get("entries")
+    if not isinstance(entries, dict) or not entries:
+        findings.append("no entries")
+        return findings
+    for key, ent in entries.items():
+        parts = key.split(":")
+        if len(parts) != 3:
+            findings.append(f"{key}: key is not device_family:shape:family")
+            continue
+        family = parts[2]
+        if family not in PLAN_TABLE:
+            findings.append(f"{key}: unknown servable family {family!r} "
+                            f"(known: {sorted(PLAN_TABLE)})")
+        for p in plan_entry_problems(ent):
+            findings.append(f"{key}: {p}")
+        if not isinstance(ent, dict):
+            continue
+        if parts[1][:1] == "n" and parts[1][1:].isdigit():
+            n = int(parts[1][1:])
+            ms = ent.get("mesh_shape")
+            if "mesh_shape" not in ent:
+                findings.append(f"{key}: device-count key without a "
+                                f"mesh_shape")
+            elif isinstance(ms, list) and ms \
+                    and all(isinstance(x, int) for x in ms) \
+                    and int(np.prod(ms)) != n:
+                findings.append(f"{key}: mesh_shape {ms} does not factor "
+                                f"{n} devices")
+            continue
+        try:
+            shape = tuple(int(x) for x in parts[1].split("x"))
+        except ValueError:
+            findings.append(f"{key}: shape {parts[1]!r} is not x-joined "
+                            f"ints")
+            continue
+        if "mesh_shape" in ent:
+            findings.append(f"{key}: shape key carrying a factorization "
+                            f"entry (mesh_shape belongs under nN keys)")
+            continue
+        if any(s < 1 for s in shape):
+            findings.append(f"{key}: shape {shape} has a dim < 1")
+        axes = ent.get("axes")
+        if isinstance(axes, list) and axes and len(axes) != len(shape):
+            findings.append(f"{key}: {len(axes)} axes vs "
+                            f"{len(shape)}-d shape {parts[1]}")
+    return findings
