@@ -354,7 +354,7 @@ class TestRepoGateAndRunner:
     def test_repo_proto_pass_clean(self):
         findings, scanned = proto.run(REPO_ROOT)
         assert findings == [], [f.render() for f in findings]
-        assert scanned == 5  # the five PROTO_MODULES all parsed
+        assert scanned == 6  # the six PROTO_MODULES all parsed
 
     def test_gate_of_routes_rule_families(self):
         assert gate_of("GL-PROTO-EPOCH") == "protolint"
@@ -394,7 +394,7 @@ class TestRepoGateAndRunner:
         data = json.loads(out.read_text(encoding="utf-8"))
         assert set(data["gates"]) == {"protolint"}
         assert data["gates"]["protolint"]["active"] == 0
-        assert data["gates"]["protolint"]["files"] == 5
+        assert data["gates"]["protolint"]["files"] == 6
 
     def test_cli_comma_separated_only(self, capsys):
         from vainplex_openclaw_tpu.analysis.__main__ import main
@@ -402,7 +402,7 @@ class TestRepoGateAndRunner:
                    "--only", "GL-PROTO-EPOCH,GL-PROTO-ORDER"])
         assert rc == 0
         outerr = capsys.readouterr()
-        assert outerr.out.splitlines()[-1].startswith("protolint: files=5 ")
+        assert outerr.out.splitlines()[-1].startswith("protolint: files=6 ")
 
 
 # ── ProtocolWitness ──────────────────────────────────────────────────

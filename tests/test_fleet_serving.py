@@ -1,0 +1,565 @@
+"""Fleet-scale model serving chaos (ISSUE 17): replica meshes as cluster
+residents. A seeded storm kills replica-hosting workers MID-BATCH and
+scales down under load — zero verdict losses, bit-identical reruns per
+``CHAOS_SEED``, ``LockOrderWitness`` + ``ProtocolWitness`` armed. Plus:
+the autoscale-decision determinism pin, the SLO A/B gate (autoscaled run
+holds the p99 budget through spawn + retire; the no-autoscaler run
+breaches), verdict parity against the single-process oracle, scoped
+batcher teardown, fleet adoption by a replacement supervisor, the sitrep
+replica panel, and the ``cluster.fleetServing`` escape hatch.
+
+``CHAOS_SEED`` (env) parameterizes the storms; CI runs seeds 0/1/2.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+from vainplex_openclaw_tpu.analysis.witness import (LockOrderWitness,
+                                                    ProtocolWitness)
+from vainplex_openclaw_tpu.cluster import ClusterSupervisor
+from vainplex_openclaw_tpu.cluster.fleet import (FLEET_DEFAULTS, ReplicaFleet,
+                                                 autoscale_decision)
+from vainplex_openclaw_tpu.core.api import list_logger
+from vainplex_openclaw_tpu.events.transport import MemoryTransport
+from vainplex_openclaw_tpu.models.batching import (ContinuousBatcher,
+                                                   render_verdict)
+from vainplex_openclaw_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                     installed)
+from vainplex_openclaw_tpu.slo.harness import _run_fleet_sim, sim_severity
+from vainplex_openclaw_tpu.slo.workload import (generate_fleet_workload,
+                                                generate_workload)
+from vainplex_openclaw_tpu.storage.journal import reset_journals
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+BASE_T = 1_753_772_400.0
+N_OPS = 120
+TENANTS = 4
+
+# Ack-boundary-only journal commits (the exactly-once alignment — see
+# tests/test_cluster_failover.py for the full rationale).
+JOURNAL_CFG = {"maxBatchRecords": 1_000_000, "windowMs": 0.0}
+
+
+class SetClock:
+    def __init__(self, t: float = BASE_T):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def det_factory(clock):
+    """Deterministic replica batchers: no collector thread, a pure-function
+    severity head, the shared settable clock. What the chaos storm injects
+    through the ReplicaFleet's construction seam."""
+    def factory(rid: str, worker_id: str):
+        batcher = ContinuousBatcher(
+            max_batch=8, window_ms=0.0, clock=clock, autostart=False,
+            model_fn=lambda texts: [sim_severity(t) for t in texts])
+        return batcher, None
+    return factory
+
+
+def build_ws_ops(seed: int, root) -> list:
+    ops = generate_workload(seed, N_OPS, TENANTS)
+    return [{"i": op.index, "at": BASE_T + op.arrival,
+             "ws": str(root / "tenants" / f"tenant{op.tenant}"),
+             "wsKey": f"tenant{op.tenant}", "kind": op.kind,
+             "content": op.content, "ids": f"{seed}:{op.index}"}
+            for op in ops]
+
+
+def strip_durations(rows: list) -> list:
+    return [{k: v for k, v in r.items() if k != "durationMs"} for r in rows]
+
+
+def run_fleet_storm(root, seed: int, kill: bool = True,
+                    retire_under_load: bool = True) -> dict:
+    """One seeded storm through a 3-worker supervisor with fleet serving
+    armed: workspace traffic AND validator traffic interleave; a seeded
+    step kills the worker hosting the FULLEST replica (mid-batch death →
+    redelivery), another retires the fullest replica under load (drain-
+    before-retire). Returns a duration-free summary."""
+    reset_journals()
+    clock = SetClock()
+    ws_results: dict[int, dict] = {}
+    fleet_results: dict[int, dict] = {}
+    sup = ClusterSupervisor(
+        root, {"workers": 3, "ackEveryOps": 6, "deterministicIds": True,
+               "fleetServing": True,
+               "fleet": {"replicas": 3, "maxBatch": 8, "windowMs": 0.0,
+                         "ackEvery": 4}},
+        clock=clock, wall_timers=False, settable_clock=clock,
+        journal_cfg=JOURNAL_CFG, logger=list_logger(),
+        on_result=lambda op, obs: ws_results.__setitem__(op.get("i"), obs))
+    fleet = sup.enable_fleet(
+        batcher_factory=det_factory(clock),
+        on_result=lambda op, obs: fleet_results.__setitem__(op.get("i"),
+                                                            obs))
+    assert fleet is not None and sup.fleet is fleet
+
+    witness = LockOrderWitness()
+    witness.wrap_attr(sup, "_lock", "ClusterSupervisor._lock")
+    witness.wrap_attr(fleet, "_lock", "ReplicaFleet._lock")
+    witness.wrap_attr(sup.leases, "_lock", "LeaseTable._lock")
+    if sup.leases.journal is not None:
+        witness.wrap_attr(sup.leases.journal, "_commit_lock",
+                          "Journal._commit_lock")
+        witness.wrap_attr(sup.leases.journal, "_buffer_lock",
+                          "Journal._buffer_lock")
+    witness.wrap_attr(sup.timer, "_lock", "ClusterSupervisor.timer._lock")
+    proto_witness = ProtocolWitness()
+    proto_witness.arm_supervisor(sup)
+
+    ws_ops = build_ws_ops(seed, root)
+    chaos = random.Random(f"fleetstorm:{seed}")
+    kill_step = chaos.randrange(40, 80) if kill else None
+    retire_step = chaos.randrange(80, 110) if retire_under_load else None
+    chaos_log: list = []
+    plan = FaultPlan([FaultSpec("journal.fsync", rate=0.05)], seed=seed)
+    with installed(plan):
+        for step, op in enumerate(ws_ops):
+            sup.submit(op)
+            sup.tick()
+            fleet.submit({"i": step, "text": op["content"],
+                          "tenant": op["wsKey"], "at": clock.t})
+            if step == kill_step:
+                # Mid-batch death: the request just submitted is pending,
+                # and fullest-open-window routing concentrated the forming
+                # batch on ONE replica — kill its worker.
+                occ = fleet.occupancy()
+                victim_rid = max(sorted(occ),
+                                 key=lambda r: occ[r]["pending"])
+                victim = occ[victim_rid]["workerId"]
+                assert occ[victim_rid]["pending"] > 0
+                chaos_log.append({"chaos": "kill", "worker": victim,
+                                  "rid": victim_rid, "step": step})
+                sup.failover(victim, reason="chaos kill")
+            if step == retire_step:
+                occ = fleet.occupancy()
+                live = [r for r in sorted(occ) if occ[r]["alive"]]
+                victim_rid = max(live,
+                                 key=lambda r: (occ[r]["pending"], r))
+                chaos_log.append({"chaos": "retire", "rid": victim_rid,
+                                  "pending": occ[victim_rid]["pending"],
+                                  "step": step})
+                fleet.retire_replica(victim_rid, reason="chaos scale-down")
+            if step % 6 == 5:
+                fleet.pump()
+        fleet.drain()
+        sup.drain()
+    fstats = fleet.stats()
+    sstats = sup.stats()
+    summary = {
+        "wsResults": {i: ws_results.get(i) for i in range(N_OPS)},
+        "fleetResults": {i: fleet_results.get(i) for i in range(N_OPS)},
+        "chaos": chaos_log,
+        "fired": dict(plan.fired),
+        "fleet": {
+            "membership": fstats["membership"],
+            "routed": fstats["routed"], "served": fstats["served"],
+            "shed": fstats["shed"], "redelivered": fstats["redelivered"],
+            "inflight": fstats["inflight"],
+            "watermark": fstats["watermark"],
+            "failovers": fstats["failovers"],
+            "replicas": {rid: {k: v for k, v in row.items()
+                               if k != "meanBatch"} or row
+                         for rid, row in fstats["replicas"].items()},
+        },
+        "supFailovers": strip_durations(sstats["failovers"]),
+        "fencedRecords": sstats["fencedRecords"],
+        "membership": sstats["membership"],
+    }
+    sup.stop()
+    witness.assert_acyclic()
+    proto_witness.assert_clean()
+    reset_journals()
+    return summary
+
+
+class TestFleetChaosStorm:
+    def test_replica_death_mid_batch_zero_verdict_losses(self, tmp_path):
+        s = run_fleet_storm(tmp_path / "storm", CHAOS_SEED)
+        # The storm was real: one worker killed, one replica retired hot.
+        kinds = [c["chaos"] for c in s["chaos"]]
+        assert kinds == ["kill", "retire"]
+        assert len(s["supFailovers"]) == 1
+        dead_worker = s["supFailovers"][0]["worker"]
+        assert s["membership"]["dead"] == [dead_worker]
+        # Replica death rode the failover path: the dead worker's replica
+        # became a corpse, its in-flight requests were redelivered to
+        # survivors, and a replacement spawned.
+        assert len(s["fleet"]["failovers"]) == 1
+        frec = s["fleet"]["failovers"][0]
+        assert frec["worker"] == dead_worker
+        assert len(frec["replicasLost"]) == 1
+        assert len(frec["respawned"]) == 1
+        assert frec["redelivered"] >= 1, "the kill landed mid-batch"
+        # ZERO verdict losses on BOTH planes, through kill + hot retire.
+        for i in range(N_OPS):
+            assert s["fleetResults"][i] is not None, f"fleet op {i} lost"
+            assert "verdict" in s["fleetResults"][i], f"fleet op {i} lost"
+            assert s["wsResults"][i] is not None, f"ws op {i} lost"
+        # Verdicts are the pure function of the text — redelivery re-ran
+        # requests, it never invented or corrupted one.
+        ws_ops = build_ws_ops(CHAOS_SEED, tmp_path / "storm")
+        for i, op in enumerate(ws_ops):
+            assert s["fleetResults"][i]["verdict"] == \
+                render_verdict(sim_severity(op["content"]))
+        # No fenced write leaked, nothing left in flight.
+        assert s["fencedRecords"] == 0
+        assert s["fleet"]["inflight"] == 0
+        assert s["fleet"]["served"] == N_OPS
+
+    def test_storm_bit_identical_per_seed(self, tmp_path):
+        a = run_fleet_storm(tmp_path / "a", CHAOS_SEED)
+        b = run_fleet_storm(tmp_path / "b", CHAOS_SEED)
+        assert a == b
+
+    def test_different_seed_different_storm(self, tmp_path):
+        a = run_fleet_storm(tmp_path / "a", CHAOS_SEED)
+        c = run_fleet_storm(tmp_path / "c", CHAOS_SEED + 17)
+        assert a["chaos"] != c["chaos"] or a["fleetResults"] != \
+            c["fleetResults"]
+
+    def test_planned_worker_retirement_drains_replicas_first(self, tmp_path):
+        """retire_worker with fleet armed: every replica resident on the
+        retiring worker drains (its accepted requests SERVE) before the
+        workspaces hand off — the drain-before-retire protocol invariant,
+        end to end."""
+        reset_journals()
+        clock = SetClock()
+        fleet_results: dict[int, dict] = {}
+        sup = ClusterSupervisor(
+            tmp_path, {"workers": 2, "ackEveryOps": 6,
+                       "deterministicIds": True, "fleetServing": True,
+                       "fleet": {"replicas": 2, "maxBatch": 8,
+                                 "windowMs": 0.0}},
+            clock=clock, wall_timers=False, settable_clock=clock,
+            journal_cfg=JOURNAL_CFG, logger=list_logger())
+        fleet = sup.enable_fleet(
+            batcher_factory=det_factory(clock),
+            on_result=lambda op, obs: fleet_results.__setitem__(
+                op.get("i"), obs))
+        for i in range(12):
+            fleet.submit({"i": i, "text": f"req {i}", "tenant": "t0",
+                          "at": clock.t})
+        occ = fleet.occupancy()
+        loaded_rid = max(sorted(occ), key=lambda r: occ[r]["pending"])
+        victim = occ[loaded_rid]["workerId"]
+        assert occ[loaded_rid]["pending"] > 0
+        sup.retire_worker(victim, reason="planned")
+        stats = fleet.stats()
+        # The retiring worker's replica served its queue and is GONE (no
+        # corpse, no redelivery — this was planned, not a failure).
+        assert loaded_rid in stats["membership"]["retired"]
+        assert loaded_rid not in stats["membership"]["alive"]
+        assert stats["redelivered"] == 0
+        assert all(row["worker"] != victim
+                   for row in stats["replicas"].values())
+        served_before_drain = {i for i, obs in fleet_results.items()
+                               if obs and "verdict" in obs}
+        assert occ[loaded_rid]["pending"] > 0 and served_before_drain, \
+            "the hot replica's accepted requests were served by the drain"
+        fleet.drain()
+        assert sorted(fleet_results) == list(range(12))
+        sup.stop()
+        reset_journals()
+
+
+class TestAutoscaleDeterminism:
+    def test_scale_schedule_is_bit_identical_per_seed(self):
+        from vainplex_openclaw_tpu.slo import run_fleet_slo_report
+
+        a = run_fleet_slo_report(seed=CHAOS_SEED, n_ops=800)
+        b = run_fleet_slo_report(seed=CHAOS_SEED, n_ops=800)
+        assert a == b, "the whole report is a pure function of its args"
+        assert a["losses"] == 0
+
+    def test_decision_policy_is_pure(self):
+        cfg = dict(FLEET_DEFAULTS)
+        assert autoscale_decision(cfg, 2, 0, None, 1)[0] == "hold"
+        action, reason = autoscale_decision(cfg, 2, 100, None, 0)
+        assert action == "spawn" and "queue depth" in reason
+        action, reason = autoscale_decision(cfg, 2, 0, 500.0, 0)
+        assert action == "spawn" and "over budget" in reason
+        # At the ceiling no spawn fires, whatever the pressure.
+        assert autoscale_decision(cfg, cfg["maxReplicas"], 10_000, 500.0,
+                                  0)[0] != "spawn"
+        action, reason = autoscale_decision(cfg, 3, 0, 1.0, 0)
+        assert action == "retire"
+        # At the floor no retire fires.
+        assert autoscale_decision(cfg, cfg["minReplicas"], 0, 1.0,
+                                  0)[0] == "hold"
+
+
+class TestSloABGate:
+    """The acceptance gate: under the diurnal trace whose peak exceeds one
+    replica's batched capacity, the autoscaled fleet holds the p99 budget
+    through BOTH a spawn ramp and a retire tail; the fixed single replica
+    breaches. Virtual time end to end — bit-reproducible per seed."""
+
+    def test_autoscaled_run_holds_budget_through_scale_events(self):
+        from vainplex_openclaw_tpu.slo import run_fleet_slo_report
+
+        report = run_fleet_slo_report(seed=CHAOS_SEED, autoscale=True)
+        assert report["losses"] == 0
+        assert report["breached"] is False
+        assert report["latencyMs"]["p99"] <= report["p99BudgetMs"]
+        # The budget held THROUGH scale events, not in their absence.
+        assert report["spawns"] > 0, "the ramp forced scale-ups"
+        assert report["retires"] > 0, "the tail scaled back down"
+        assert report["replicas"]["final"] <= report["replicas"]["max"]
+
+    def test_fixed_fleet_breaches_same_trace(self):
+        from vainplex_openclaw_tpu.slo import run_fleet_slo_report
+
+        report = run_fleet_slo_report(seed=CHAOS_SEED, autoscale=False)
+        assert report["losses"] == 0
+        assert report["breached"] is True
+        assert report["spawns"] == 0 and report["retires"] == 0
+
+    def test_burst_profile_serves_everything(self):
+        from vainplex_openclaw_tpu.slo import run_fleet_slo_report
+
+        report = run_fleet_slo_report(seed=CHAOS_SEED, n_ops=400,
+                                      profile="burst")
+        assert report["losses"] == 0
+        assert report["profile"] == "burst"
+
+    def test_unknown_profile_rejected(self):
+        from vainplex_openclaw_tpu.slo import run_fleet_slo_report
+
+        with pytest.raises(ValueError):
+            run_fleet_slo_report(n_ops=10, profile="sinusoid")
+
+
+class TestVerdictParity:
+    def test_fleet_matches_single_process_oracle(self):
+        """The default-off escape hatch's contract: the fleet path and the
+        PR 14-16 single-process batcher produce IDENTICAL verdicts — they
+        share the severity head, so any disagreement is a scheduling bug
+        (lost, duplicated, or cross-wired requests)."""
+        ops = generate_fleet_workload(CHAOS_SEED, 300, TENANTS,
+                                      base_rate=600.0, peak_factor=2.0)
+        run = _run_fleet_sim(
+            ops, {"replicas": 3, "minReplicas": 3, "maxReplicas": 3,
+                  "autoscale": False}, CHAOS_SEED)
+        oracle = ContinuousBatcher(
+            max_batch=32, window_ms=0.0, autostart=False,
+            model_fn=lambda texts: [sim_severity(t) for t in texts])
+        tickets = [(op.index, oracle.enqueue(op.content,
+                                             f"tenant{op.tenant}"))
+                   for op in ops]
+        oracle.drain()
+        oracle.close()
+        assert len(run["results"]) == len(ops)
+        for i, ticket in tickets:
+            assert run["results"][i]["verdict"] == ticket.result, i
+
+
+class TestScopedTeardown:
+    def test_scoped_close_touches_only_the_owner(self):
+        """Worker-scoped registry teardown (the satellite): closing one
+        worker's scope leaves every other scope's batchers resident — the
+        pre-ISSUE-17 process-global close stranded ALL of them."""
+        from vainplex_openclaw_tpu.models import serve
+
+        serve.close_batchers()  # clean slate
+        scfg = dict(serve.SERVE_DEFAULTS)
+        scfg["maxBatch"] = 4
+        b0 = serve.shared_batcher(None, scfg, scope="w0:fleet:r0")
+        b1 = serve.shared_batcher(None, scfg, scope="w1:fleet:r1")
+        assert b0 is not b1, "scope is part of the registry key"
+        assert serve.shared_batcher(None, scfg, scope="w0:fleet:r0") is b0
+        serve.close_batchers(scope="w0:fleet:r0")
+        with serve._batchers_lock:
+            scopes = {k[0] for k in serve._batchers}
+        assert "w0:fleet:r0" not in scopes
+        assert "w1:fleet:r1" in scopes, "the other worker kept its replica"
+        serve.close_batchers(scope="nonexistent")  # no-op, no error
+        serve.close_batchers()  # process-teardown contract unchanged
+        with serve._batchers_lock:
+            assert not serve._batchers
+
+    def test_worker_serve_scope_is_per_worker(self, tmp_path):
+        from vainplex_openclaw_tpu.cluster.worker import InProcessWorker
+
+        a = InProcessWorker("wA", tmp_path, journal_cfg=JOURNAL_CFG)
+        b = InProcessWorker("wB", tmp_path, journal_cfg=JOURNAL_CFG)
+        try:
+            assert a.serve_scope != b.serve_scope
+            assert "wA" in a.serve_scope
+        finally:
+            a.stop()
+            b.stop()
+            reset_journals()
+
+
+class TestFleetAdoption:
+    def test_replacement_fleet_adopts_schedule_and_redelivers(self):
+        """A replacement supervisor's fleet rebuilds itself FROM the route
+        log: ctl replay recovers the fleet size, the published watermark
+        bounds redelivery, and every request the dead generation left
+        unacked re-runs — at-least-once delivery read as exactly-once."""
+        clock = SetClock()
+        transport = MemoryTransport(clock=clock)
+        cfg = {"replicas": 2, "maxBatch": 8, "windowMs": 0.0, "ackEvery": 4}
+        results_a: dict[int, dict] = {}
+        a = ReplicaFleet(
+            cfg, transport=transport, clock=clock,
+            workers=lambda: ["w0"], batcher_factory=det_factory(clock),
+            on_result=lambda op, obs: results_a.__setitem__(op.get("i"),
+                                                            obs))
+        n = 40
+        for i in range(n):
+            a.submit({"i": i, "text": f"fleet op {i}", "tenant": "t0",
+                      "at": clock.t})
+            if i == 19:
+                a.pump()  # first half served + watermark published
+        acked_a = a.stats()["watermark"]
+        assert 0 < len(results_a) < n, "generation A died mid-flight"
+        # A's process is gone: no drain, no close — its queues are exactly
+        # what the route log must cover.
+        results_b: dict[int, dict] = {}
+        b = ReplicaFleet(
+            cfg, transport=transport, clock=clock,
+            workers=lambda: ["w0"], batcher_factory=det_factory(clock),
+            on_result=lambda op, obs: results_b.__setitem__(op.get("i"),
+                                                            obs),
+            adopt=True)
+        assert b.redelivered > 0
+        assert b.stats()["lastFailover"]["reason"] == "supervisor adoption"
+        b.drain()
+        b.close()
+        # Union coverage: every op has a verdict somewhere, and re-run
+        # requests produced the same pure-function verdict.
+        for i in range(n):
+            obs = results_b.get(i) or results_a.get(i)
+            assert obs is not None and "verdict" in obs, f"op {i} lost"
+            assert obs["verdict"] == \
+                render_verdict(sim_severity(f"fleet op {i}"))
+        # Redelivery covered at least everything past A's watermark.
+        assert b.redelivered >= n - acked_a - len(results_a) or \
+            b.redelivered >= n - a.stats()["watermark"]
+
+    def test_recover_watermark_empty_log_is_zero(self):
+        clock = SetClock()
+        fleet = ReplicaFleet(
+            {"replicas": 1}, transport=MemoryTransport(clock=clock),
+            clock=clock, workers=lambda: ["w0"],
+            batcher_factory=det_factory(clock))
+        assert fleet.recover_watermark() == 0
+        fleet.close()
+
+
+class TestSitrepFleetPanel:
+    def _status(self, fleet_over=None, **over):
+        fleet = {
+            "replicas": {
+                "r0": {"worker": "w0", "alive": True, "pending": 3,
+                       "windowOpen": True, "maxBatch": 32,
+                       "mesh": {"shape": [2, 2]}, "served": 10,
+                       "batches": 2, "meanBatch": 5.0},
+                "r1": {"worker": "w1", "alive": True, "pending": 0,
+                       "windowOpen": False, "maxBatch": 32,
+                       "mesh": {"shape": [2, 2]}, "served": 8,
+                       "batches": 1, "meanBatch": 8.0}},
+            "membership": {"alive": ["r0", "r1"], "dead": [],
+                           "retired": []},
+            "routed": 21, "served": 18, "shed": 0, "redelivered": 0,
+            "inflight": 3, "watermark": 18,
+            "p99Ms": 42.0, "p99BudgetMs": 100.0, "sloBreached": False,
+            "autoscaler": {"enabled": True, "cooldown": 0, "decisions": 3,
+                           "lastDecision": {"atOp": 16, "action": "hold",
+                                            "reason": "steady",
+                                            "replicas": 2, "queued": 3},
+                           "scaleEvents": []},
+            "failovers": [], "lastFailover": None}
+        fleet.update(fleet_over or {})
+        base = {
+            "workers": {"w0": {"alive": True,
+                               "breaker": {"state": "closed"}}},
+            "membership": {"live": ["w0", "w1"], "dead": []},
+            "leases": {}, "routed": 10, "redelivered": 0,
+            "routeFaults": 0, "inflight": 0, "fencedRecords": 0,
+            "lastFailover": None, "failovers": [],
+            "routeLog": {"published": 10}, "fleet": fleet}
+        base.update(over)
+        return base
+
+    def test_healthy_fleet_panel(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        out = collect_cluster(
+            {}, {"cluster_status": self._status})
+        assert out["status"] == "ok"
+        panel = out["items"][0]["fleet"]
+        assert sorted(panel["byWorker"]) == ["w0", "w1"]
+        assert panel["byWorker"]["w0"][0]["rid"] == "r0"
+        assert panel["byWorker"]["w0"][0]["mesh"] == {"shape": [2, 2]}
+        assert panel["openWindows"] == 1
+        assert panel["autoscaler"]["lastDecision"]["action"] == "hold"
+        assert "fleet: 2 replicas (1 windows open)" in out["summary"]
+        assert "autoscaler: hold (steady)" in out["summary"]
+
+    def test_dead_replicas_warn(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = self._status(fleet_over={
+            "membership": {"alive": ["r1"], "dead": ["r0"],
+                           "retired": []}})
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "warn"
+        assert "fleet.dead=['r0']" in out["summary"]
+
+    def test_slo_breach_warns_with_numbers(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = self._status(fleet_over={"p99Ms": 141.7,
+                                          "sloBreached": True})
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "warn"
+        assert "fleet p99 141.7ms over budget 100.0ms" in out["summary"]
+
+    def test_no_fleet_key_keeps_panel_absent(self):
+        from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
+
+        status = self._status()
+        del status["fleet"]
+        out = collect_cluster({}, {"cluster_status": lambda: status})
+        assert out["status"] == "ok"
+        assert out["items"][0]["fleet"] is None
+        assert "fleet:" not in out["summary"]
+
+
+class TestEscapeHatch:
+    def test_fleet_serving_defaults_off(self, tmp_path):
+        """cluster.fleetServing=False (the default) keeps the supervisor
+        byte-for-byte the single-process PR 14-16 serving path: no fleet
+        is ever built, stats carry no fleet section."""
+        from vainplex_openclaw_tpu.cluster.supervisor import CLUSTER_DEFAULTS
+
+        assert CLUSTER_DEFAULTS["fleetServing"] is False
+        reset_journals()
+        clock = SetClock()
+        sup = ClusterSupervisor(
+            tmp_path, {"workers": 1, "deterministicIds": True},
+            clock=clock, wall_timers=False, settable_clock=clock,
+            journal_cfg=JOURNAL_CFG, logger=list_logger())
+        try:
+            assert sup.enable_fleet() is None
+            assert sup.fleet is None
+            assert "fleet" not in sup.stats()
+        finally:
+            sup.stop()
+            reset_journals()
+
+    def test_fleet_defaults_disabled_and_admission_free(self):
+        assert FLEET_DEFAULTS["enabled"] is False
+        assert FLEET_DEFAULTS["autoscale"] is False
+        assert FLEET_DEFAULTS["admission"] is None

@@ -240,7 +240,8 @@ class TestGenerateAndRotate:
     def test_cluster_collector_item_shape_pin(self, tmp_path):
         """The cluster item's key set is an operator contract (/ops and
         the slo report both read it): ISSUE 12 added the route-log
-        transport view, lastHandoff and the admission surface — a key
+        transport view, lastHandoff and the admission surface; ISSUE 17
+        the fleet panel (None when fleet serving is off) — a key
         silently dropped here would blank a dashboard panel, not fail."""
         from vainplex_openclaw_tpu.sitrep.collectors import collect_cluster
 
@@ -265,8 +266,9 @@ class TestGenerateAndRotate:
             "membership", "workers", "leaseEpochs", "lastFailover",
             "lastHandoff", "handoffAborts", "ingressShed", "admission",
             "routed", "redelivered", "routeFaults", "inflight",
-            "fencedRecords", "routeLog"}
+            "fencedRecords", "routeLog", "fleet"}
         assert out["items"][0]["routeLog"]["kind"] == "memory"
+        assert out["items"][0]["fleet"] is None
         assert "last handoff: tenant0 w0→w1" in out["summary"]
 
     def test_custom_collectors_namespaced(self, tmp_path):

@@ -67,6 +67,9 @@ CONFIG_SITES: tuple = (
     ("vainplex_openclaw_tpu/parallel/plan_search.py",
      ("PLAN_SEARCH_DEFAULTS",), ("scfg",),
      ("search", "_measure_validator", "_measure_embeddings")),
+    ("vainplex_openclaw_tpu/cluster/fleet.py",
+     ("FLEET_DEFAULTS",), ("cfg", "self.cfg"),
+     None),
 )
 
 

@@ -216,6 +216,19 @@ GUARDED: tuple = (
         locks={"_lock": ("_leases",)},
         hot=("_lock",),
     ),
+    # Replica fleet (ISSUE 17): the routing table, in-flight/watermark
+    # bookkeeping, latency window, and autoscaler state share one hot lock
+    # on the request path — batcher enqueue/step, route-log publishes, and
+    # result callbacks all deliberately run OUTSIDE it.
+    GuardSpec(
+        module="vainplex_openclaw_tpu/cluster/fleet.py", cls="ReplicaFleet",
+        locks={"_lock": ("_replicas", "_inflight", "_acked", "_ack_unpub",
+                         "_last_seq", "_next_idx", "_lat_window",
+                         "_decisions", "_scale_events", "_failovers",
+                         "_retired", "_ops_since_eval", "_cooldown",
+                         "routed", "served", "shed", "redelivered")},
+        hot=("_lock",),
+    ),
     # Workspace lifecycle (ISSUE 11): recency bookkeeping is read by the
     # ingest path per message — hot, and eviction callbacks (journal close,
     # tracker flush: blocking I/O) deliberately run OUTSIDE it.

@@ -119,6 +119,7 @@ PROTO_MODULES: tuple = (
     f"{_PKG}/cluster/supervisor.py",
     f"{_PKG}/cluster/worker.py",
     f"{_PKG}/cluster/ring.py",
+    f"{_PKG}/cluster/fleet.py",
     f"{_PKG}/storage/journal.py",
     f"{_PKG}/storage/lifecycle.py",
 )
@@ -188,12 +189,29 @@ ORDER_RULES: tuple = (
     OrderRule(f"{_PKG}/cluster/ring.py", "LeaseTable.grant",
               first="commit", then="write_fence", forbid_early=True,
               invariant="fence-before-traffic"),
+    # drain-before-retire (ISSUE 17): a planned replica scale-down must
+    # serve everything the replica already accepted BEFORE unregistering
+    # and closing it — flipping the order strands accepted requests
+    # exactly like the pre-fleet process-global teardown did.
+    OrderRule(f"{_PKG}/cluster/fleet.py", "ReplicaFleet.retire_replica",
+              first="_drain_replica", then="_unregister", forbid_early=True,
+              invariant="drain-before-retire"),
+    # worker retirement drains its resident replicas before workspace
+    # handoff begins — the fleet side of the same invariant.
+    OrderRule(f"{_PKG}/cluster/supervisor.py",
+              "ClusterSupervisor.retire_worker",
+              first="drain_worker", then="handoff", forbid_early=True,
+              invariant="drain-before-retire"),
 )
 
 ACK_RULES: tuple = (
     AckRule(f"{_PKG}/cluster/worker.py", "InProcessWorker._ack",
             kind="commit-before-release"),
     AckRule(f"{_PKG}/cluster/supervisor.py", "ClusterSupervisor._note_ack",
+            kind="monotonic-watermark", attr="_acked"),
+    # The fleet's request watermark (ISSUE 17) advances exactly like the
+    # supervisor's: min(inflight)-1, stored behind an ordered guard.
+    AckRule(f"{_PKG}/cluster/fleet.py", "ReplicaFleet._reap",
             kind="monotonic-watermark", attr="_acked"),
 )
 

@@ -4,8 +4,14 @@
 ``cluster.enabled: false`` (the default everywhere) keeps the single-process
 gateway path byte-for-byte untouched; this package is pure opt-in scale-out
 infrastructure. See docs/cluster.md for the design walkthrough.
+
+Fleet serving (ISSUE 17, ``cluster.fleetServing``): model replicas as
+cluster residents — ``fleet.ReplicaFleet`` routes stage-3 validator traffic
+across worker-owned ContinuousBatchers on the same route-log/failover
+machinery workspaces ride, with SLO-driven autoscaling.
 """
 
+from .fleet import FLEET_DEFAULTS, ReplicaFleet, autoscale_decision
 from .ring import FENCE_FILE, HashRing, LeaseTable
 from .supervisor import (CLUSTER_DEFAULTS, SHEDDABLE_KINDS,
                          ClusterSupervisor, build_route_transport)
@@ -16,12 +22,15 @@ __all__ = [
     "CLUSTER_DEFAULTS",
     "ClusterSupervisor",
     "FENCE_FILE",
+    "FLEET_DEFAULTS",
     "HashRing",
     "InProcessWorker",
     "LeaseTable",
     "ProcessWorker",
+    "ReplicaFleet",
     "SHEDDABLE_KINDS",
     "WorkerCrashed",
+    "autoscale_decision",
     "build_route_transport",
     "build_worker_gateway",
     "dispatch_op",

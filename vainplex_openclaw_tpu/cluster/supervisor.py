@@ -92,6 +92,16 @@ CLUSTER_DEFAULTS = {
     # same root names its workers distinctly (e.g. "b") so lease history
     # reads unambiguously across supervisor generations.
     "workerPrefix": "w",
+    # Fleet serving (ISSUE 17): model replicas as cluster residents — the
+    # supervisor routes stage-3 validator traffic across worker-resident
+    # ContinuousBatcher replicas (cluster/fleet.py), replica death rides
+    # the failover path, and an SLO-driven autoscaler spawns/retires
+    # through the planned drain-before-retire sequence. Default OFF: the
+    # single-process make_local_call_llm path (PR 14–16) is the
+    # equivalence oracle for verdict parity, never deleted. ``fleet`` is
+    # the FLEET_DEFAULTS overlay armed by enable_fleet().
+    "fleetServing": False,
+    "fleet": None,
 }
 
 # Ingress kinds the supervisor may shed under admission pressure: message
@@ -249,6 +259,11 @@ class ClusterSupervisor:
         self.handoff_aborts = 0
         self.ingress_shed = 0
 
+        # Replica fleet (ISSUE 17): armed by enable_fleet() when
+        # cfg["fleetServing"] — never built implicitly, so the default
+        # supervisor is byte-for-byte the pre-fleet one.
+        self.fleet = None
+
         for i in range(int(cfg.get("workers", 2))):
             self.add_worker(f"{str(cfg.get('workerPrefix', 'w'))}{i}")
         if adopt:
@@ -287,6 +302,31 @@ class ClusterSupervisor:
     def workers(self) -> dict:
         with self._lock:
             return dict(self._workers)
+
+    def _live_worker_ids(self) -> list:
+        with self._lock:
+            return [w for w, s in self._workers.items() if s.alive]
+
+    def enable_fleet(self, batcher_factory=None, on_result=None,
+                     adopt: bool = False):
+        """Arm fleet serving (ISSUE 17) behind ``cluster.fleetServing`` —
+        the escape hatch: when the flag is off this returns None and the
+        single-process serve path (models/serve.make_local_call_llm) is
+        untouched, byte-for-byte the PR 14–16 oracle. When on, the fleet
+        places replica batchers on live workers, publishes its schedule on
+        this supervisor's route transport, and rides failover/retirement
+        through on_worker_failed/drain_worker."""
+        if not self.cfg.get("fleetServing"):
+            return None
+        from .fleet import ReplicaFleet
+
+        self.fleet = ReplicaFleet(
+            dict(self.cfg.get("fleet") or {}),
+            transport=self.transport, clock=self.clock,
+            workers=self._live_worker_ids, logger=self.logger,
+            batcher_factory=batcher_factory,
+            on_result=on_result or self.on_result, adopt=adopt)
+        return self.fleet
 
     def _worker(self, worker_id: str) -> Optional[_WorkerState]:
         with self._lock:
@@ -654,6 +694,11 @@ class ClusterSupervisor:
             self.timer.add("recover", (pc() - t_rec) * 1000.0)
             replayed_records += (replay or {}).get("records", 0)
             redelivered += self._redeliver(ws, new_state)
+        if self.fleet is not None:
+            # Replica death rides the same path (ISSUE 17): the fleet
+            # re-fetches the dead worker's in-flight requests past its
+            # watermark and re-routes them, then respawns capacity.
+            self.fleet.on_worker_failed(worker_id, reason=reason)
         with self._lock:
             self.redelivered += redelivered
             self._failovers.append({
@@ -913,6 +958,13 @@ class ClusterSupervisor:
         from the ring. Workspaces whose handoff aborted stay owned and are
         moved by the failover path when the worker actually goes away."""
         moved, aborted = 0, 0
+        if self.fleet is not None:
+            # Fleet first (ISSUE 17, drain-before-retire — protolint
+            # GL-PROTO-ORDER): every replica resident here serves out its
+            # accepted queue and closes before the workspace handoffs run,
+            # so a retired worker strands neither requests nor collector
+            # threads.
+            self.fleet.drain_worker(worker_id)
         for ws in self.leases.owned_by(worker_id):
             rec = self.handoff(ws, reason=reason)
             if rec is not None:
@@ -970,6 +1022,9 @@ class ClusterSupervisor:
                 time.sleep(0.01)
 
     def stop(self) -> None:
+        if self.fleet is not None:
+            self.fleet.drain()
+            self.fleet.close()
         self.drain()
         with self._lock:
             snapshot = list(self._workers.values())
@@ -1012,6 +1067,8 @@ class ClusterSupervisor:
         and the ``cluster.status`` method the sitrep collector reads."""
         gw.stage_timers["cluster"] = self.timer
         gw.methods["cluster.status"] = self.stats
+        if self.fleet is not None:
+            gw.stage_timers["fleet"] = self.fleet.timer
 
     def stage_snapshots(self, qs=(0.5, 0.95, 0.99)) -> dict:
         """Merged per-edge snapshots across every worker (prefix stripped,
@@ -1073,6 +1130,8 @@ class ClusterSupervisor:
         stats["handoffs"] = handoffs
         stats["lastHandoff"] = handoffs[-1] if handoffs else None
         stats["routeLog"] = self._route_log_stats()
+        if self.fleet is not None:
+            stats["fleet"] = self.fleet.stats()
         if self.admission is not None:
             stats["admission"] = self.admission.stats()
         if self.leases.journal is not None:

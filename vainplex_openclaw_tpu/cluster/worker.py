@@ -81,7 +81,7 @@ def build_worker_gateway(worker_root: str | Path, worker_id: str,
                          clock: Callable[[], float] = time.time,
                          wall_timers: bool = True,
                          journal_cfg: Any = True, lifecycle_cfg: Any = True,
-                         logger=None):
+                         logger=None, serve_cfg: Optional[dict] = None):
     """The standard worker profile: governance (credential guard +
     redaction, audit at the worker root) + cortex (per-tenant trackers over
     the shared workspace journals). Stage-timer keys carry the worker's
@@ -114,6 +114,25 @@ def build_worker_gateway(worker_root: str | Path, worker_id: str,
                                    "storage": {"journal": journal_cfg,
                                                "lifecycle": lifecycle_cfg}})
     gw.start()
+    if serve_cfg is not None:
+        # Fleet serving (ISSUE 17): this worker OWNS a replica batcher out
+        # of the PR-15 scoped registry — scope keyed to the worker id so
+        # stop()/retirement closes exactly its own collector threads, never
+        # a peer's. Built only when a checkpoint is actually servable: the
+        # gateway must stay constructible on model-less CI workers (the
+        # fleet's injected-factory seam covers those).
+        from ..config.loader import deep_merge
+        from ..models.pretrained import available
+        from ..models.serve import SERVE_DEFAULTS, shared_batcher
+
+        merged = deep_merge(SERVE_DEFAULTS, serve_cfg)
+        ckpt = merged.pop("checkpointDir", None)
+        if available(ckpt):
+            gw.serve_batcher = shared_batcher(
+                ckpt, merged, scope=f"{worker_id}@{root}")
+        elif logger is not None:
+            logger.warn(f"[cluster] worker {worker_id}: serve_cfg given but "
+                        "no servable checkpoint; replica batcher skipped")
     return gw, cortex, gov
 
 
@@ -128,9 +147,15 @@ class InProcessWorker:
                  deterministic_ids: bool = False,
                  settable_clock: Any = None,
                  journal_cfg: Any = True, lifecycle_cfg: Any = True,
-                 logger=None, gateway_builder: Optional[Callable] = None):
+                 logger=None, gateway_builder: Optional[Callable] = None,
+                 serve_cfg: Optional[dict] = None):
         self.worker_id = worker_id
         self.root = Path(root)
+        # Registry scope for any serve batchers this worker's gateway owns
+        # (ISSUE 17): stop() closes exactly this scope — drain first, so
+        # planned retirement strands nothing; crash() deliberately leaves
+        # it (a corpse's queue is redelivery's job, not teardown's).
+        self.serve_scope = f"{worker_id}@{self.root}"
         self.clock = clock
         self.ack_every = max(1, int(ack_every))
         self.deterministic_ids = deterministic_ids
@@ -152,11 +177,16 @@ class InProcessWorker:
         # protolint's interleaving explorer substitutes a stub executor
         # here so exhaustive schedule enumeration doesn't pay a full
         # governance+cortex build per schedule.
+        builder_kwargs = dict(
+            clock=clock, wall_timers=wall_timers, journal_cfg=journal_cfg,
+            lifecycle_cfg=lifecycle_cfg, logger=logger)
+        if serve_cfg is not None and gateway_builder is None:
+            # Only the default builder understands serve_cfg — injected
+            # builders (protolint's stub executor) keep their signature.
+            builder_kwargs["serve_cfg"] = serve_cfg
         self.gw, self.cortex, self.gov = (gateway_builder
                                           or build_worker_gateway)(
-            self.root, worker_id, clock=clock, wall_timers=wall_timers,
-            journal_cfg=journal_cfg, lifecycle_cfg=lifecycle_cfg,
-            logger=logger)
+            self.root, worker_id, **builder_kwargs)
 
     # ── shard management ─────────────────────────────────────────────
 
@@ -339,6 +369,13 @@ class InProcessWorker:
             return
         self._ack()
         self.gw.stop()
+        # Scoped batcher teardown (ISSUE 17): drain + close ONLY this
+        # worker's registry batchers. Before this, close_batchers was
+        # process-global atexit — a retired worker stranded its queued
+        # serve requests and leaked its collector threads until exit.
+        from ..models.serve import close_batchers
+
+        close_batchers(scope=self.serve_scope, drain=True)
         self.alive = False
 
     # ── observability ────────────────────────────────────────────────

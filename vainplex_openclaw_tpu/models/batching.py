@@ -90,15 +90,23 @@ class ContinuousBatcher:
                  clock: Callable[[], float] = time.perf_counter,
                  autostart: bool = True, mesh=None,
                  plan_family: str = "encoder_validator",
-                 searched_plans: bool = True):
-        from .pretrained import available
+                 searched_plans: bool = True,
+                 model_fn: Optional[Callable] = None):
+        # Fleet sim seam (ISSUE 17): ``model_fn(texts) -> [severity]``
+        # replaces the checkpoint forward entirely — queue/window/verdict
+        # plumbing runs verbatim while service time is whatever the
+        # injected fn (and its virtual clock) says. Checkpoint-backed
+        # construction keeps the LOUD no-checkpoint contract.
+        self.model_fn = model_fn
+        if model_fn is None:
+            from .pretrained import available
 
-        if not available(checkpoint_dir):
-            # Same LOUD construction contract as the one-shot path: a
-            # silent per-call "pass" would override fail_mode='closed'.
-            raise RuntimeError(
-                "continuous batching serve path refused: no trained "
-                f"checkpoint at {checkpoint_dir or 'the shipped default'}")
+            if not available(checkpoint_dir):
+                # Same LOUD construction contract as the one-shot path: a
+                # silent per-call "pass" would override fail_mode='closed'.
+                raise RuntimeError(
+                    "continuous batching serve path refused: no trained "
+                    f"checkpoint at {checkpoint_dir or 'the shipped default'}")
         # Mesh serving (ISSUE 15): a jax Mesh routes _run_batch through the
         # declarative sharding plan (parallel/plan.py) — params placed per
         # the family rule table (validate_rule_table armed at placement),
@@ -133,11 +141,14 @@ class ContinuousBatcher:
 
     # ── request surface ──────────────────────────────────────────────
 
-    def submit(self, text: str, tenant: str = "serve",
-               timeout_s: float = 60.0) -> str:
-        """Serve one extracted message text; blocks until its batch ran.
-        Raises :class:`ServeSheddedError` when admission sheds, whatever
-        the batch worker raised when serving failed."""
+    def enqueue(self, text: str, tenant: str = "serve",
+                at: Optional[float] = None) -> _Pending:
+        """Queue one request WITHOUT waiting — the fleet router's surface
+        (ISSUE 17): the supervisor enqueues on the chosen replica and pumps
+        batches itself, acking the route log as tickets complete. Admission
+        and shed semantics are byte-for-byte :meth:`submit`'s; ``at``
+        overrides the enqueue timestamp so virtual-time drivers attribute
+        queue wait in sim seconds. Returns the ticket."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher closed")
@@ -149,10 +160,19 @@ class ContinuousBatcher:
                     self.shed += 1
                 raise ServeSheddedError(
                     f"serve admission shed (queue depth {depth})")
-        req = _Pending(text=text, tenant=tenant, enqueued_at=self._clock())
+        req = _Pending(text=text, tenant=tenant,
+                       enqueued_at=self._clock() if at is None else at)
         with self._nonempty:
             self._queue.append(req)
             self._nonempty.notify()
+        return req
+
+    def submit(self, text: str, tenant: str = "serve",
+               timeout_s: float = 60.0) -> str:
+        """Serve one extracted message text; blocks until its batch ran.
+        Raises :class:`ServeSheddedError` when admission sheds, whatever
+        the batch worker raised when serving failed."""
+        req = self.enqueue(text, tenant)
         if not req.done.wait(timeout_s):
             raise TimeoutError(f"serve request not batched in {timeout_s}s")
         if req.error is not None:
@@ -183,6 +203,29 @@ class ContinuousBatcher:
         batch = self._drain()
         self._run_batch(batch)
         return len(batch)
+
+    def drain(self) -> int:
+        """Step until the queue is empty (teardown/retire path, ISSUE 17):
+        a retiring replica serves everything it accepted before closing, so
+        scale-down can never strand a queued request. Returns total served."""
+        total = 0
+        while True:
+            n = self.step()
+            if n == 0:
+                return total
+            total += n
+
+    def occupancy(self) -> dict:
+        """Bucket-window snapshot for the fleet router (ISSUE 17): a replica
+        with ``0 < queued < maxBatch`` has an OPEN window — joining its
+        forming batch is free amortization. ``oldestAt`` is the enqueue
+        timestamp of the head request (None when idle), the window-expiry
+        input for the pump."""
+        with self._lock:
+            return {"queued": len(self._queue),
+                    "maxBatch": self.max_batch,
+                    "oldestAt": self._queue[0].enqueued_at
+                    if self._queue else None}
 
     def _collector(self) -> None:
         while True:
@@ -222,6 +265,24 @@ class ContinuousBatcher:
         t0 = self._clock()
         for req in batch:
             self.timer.add("queue", (t0 - req.enqueued_at) * 1e3)
+        if self.model_fn is not None:
+            # Injected-model step (fleet sim / tests): same per-request
+            # verdict render and counters, service time owned by model_fn
+            # (which may advance a virtual clock — stages then read in
+            # sim milliseconds).
+            t1 = self._clock()
+            self.timer.add("batch", (t1 - t0) * 1e3)
+            classes = self.model_fn([r.text for r in batch])
+            t2 = self._clock()
+            self.timer.add("prefill", (t2 - t1) * 1e3)
+            for req, cls in zip(batch, classes):
+                req.result = render_verdict(int(cls))
+                req.done.set()
+            with self._lock:
+                self.served += len(batch)
+                self.batches += 1
+            self.timer.add("decode", (self._clock() - t2) * 1e3)
+            return
         loaded = load_pretrained(self.checkpoint_dir)
         if loaded is None:
             raise RuntimeError("continuous serve: checkpoint no longer loadable")

@@ -84,10 +84,14 @@ def _extract_message(prompt: str) -> str:
     return body.strip()
 
 
-# One batcher per (checkpoint dir, knob tuple): every call_llm closure a
-# process builds for the same serving config shares one queue — that IS
-# the continuous-batching win (two validators batching together), and it
-# keeps the collector-thread count bounded.
+# One batcher per (scope, checkpoint dir, knob tuple): every call_llm
+# closure a process builds for the same serving config shares one queue —
+# that IS the continuous-batching win (two validators batching together),
+# and it keeps the collector-thread count bounded. ``scope`` (ISSUE 17)
+# partitions the registry per cluster worker so worker retirement closes
+# ONLY that worker's batchers — before it, close_batchers was process-
+# global atexit and a retired worker stranded queued requests and leaked
+# collector threads until exit.
 _batchers: dict = {}
 _batchers_lock = threading.Lock()
 
@@ -136,11 +140,13 @@ def _resolve_mesh(serve_cfg: dict):
     return cached_mesh(tuple(int(s) for s in shape), axes)
 
 
-def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict):
+def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict,
+                   scope: str = "global"):
     from ..resilience.admission import AdmissionController
     from .batching import ContinuousBatcher
 
-    key = (checkpoint_dir, serve_cfg["maxBatch"], serve_cfg["windowMs"],
+    key = (scope, checkpoint_dir, serve_cfg["maxBatch"],
+           serve_cfg["windowMs"],
            tuple(sorted((serve_cfg.get("admission") or {}).items())),
            _mesh_key(serve_cfg))
     with _batchers_lock:
@@ -158,12 +164,27 @@ def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict):
         return batcher
 
 
-def close_batchers() -> None:
-    """Stop every shared collector thread (tests / process teardown)."""
+def close_batchers(scope: Optional[str] = None, drain: bool = False) -> None:
+    """Stop shared collector threads. ``scope=None`` closes EVERY batcher
+    (tests / atexit process teardown, unchanged contract); a specific
+    scope closes only that owner's — the worker-retirement path (ISSUE
+    17). ``drain=True`` serves whatever is still queued before closing,
+    so planned retirement cannot strand an accepted request; a crash path
+    passes ``drain=False`` and lets fleet redelivery re-route the queue."""
     with _batchers_lock:
-        batchers = list(_batchers.values())
-        _batchers.clear()
-    for b in batchers:
+        if scope is None:
+            items = list(_batchers.items())
+            _batchers.clear()
+        else:
+            items = [(k, v) for k, v in _batchers.items() if k[0] == scope]
+            for k, _ in items:
+                del _batchers[k]
+    for _, b in items:
+        if drain:
+            try:
+                b.drain()
+            except Exception:  # noqa: BLE001 — teardown must reach close()
+                pass
         b.close()
 
 

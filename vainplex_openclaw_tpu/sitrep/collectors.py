@@ -305,6 +305,49 @@ def collect_cluster(config: dict, ctx: dict) -> dict:
             worries.append(f"routeLog breaker={rl_breaker}")
     epochs = {ws: lease.get("epoch")
               for ws, lease in (s.get("leases") or {}).items()}
+    # Replica-fleet panel (ISSUE 17): per-worker replica health, mesh
+    # config, bucket-window occupancy, and the autoscaler's last decision
+    # WITH its reason — every scale event must be explainable from /ops.
+    # Warns only on CURRENT conditions: replicas dead right now (corpses
+    # pending respawn) and an SLO breach in the live p99 window — retired
+    # replicas are history, not a worry.
+    fleet = s.get("fleet") or {}
+    fleet_panel = None
+    if fleet:
+        freps = fleet.get("replicas") or {}
+        by_worker: dict = {}
+        for rid, row in freps.items():
+            by_worker.setdefault(row.get("worker"), []).append(
+                {"rid": rid, "alive": row.get("alive"),
+                 "pending": row.get("pending"),
+                 "windowOpen": row.get("windowOpen"),
+                 "maxBatch": row.get("maxBatch"),
+                 "mesh": row.get("mesh"),
+                 "meanBatch": row.get("meanBatch")})
+        auto = fleet.get("autoscaler") or {}
+        fleet_panel = {
+            "byWorker": {w: rows for w, rows in sorted(by_worker.items())},
+            "membership": fleet.get("membership"),
+            "openWindows": sum(1 for r in freps.values()
+                               if r.get("windowOpen")),
+            "p99Ms": fleet.get("p99Ms"),
+            "p99BudgetMs": fleet.get("p99BudgetMs"),
+            "sloBreached": fleet.get("sloBreached"),
+            "autoscaler": {"enabled": auto.get("enabled"),
+                           "cooldown": auto.get("cooldown"),
+                           "lastDecision": auto.get("lastDecision")},
+            "served": fleet.get("served"), "shed": fleet.get("shed"),
+            "redelivered": fleet.get("redelivered"),
+            "inflight": fleet.get("inflight"),
+            "watermark": fleet.get("watermark"),
+            "lastFailover": fleet.get("lastFailover")}
+        fdead = (fleet.get("membership") or {}).get("dead") or []
+        if fdead:
+            worries.append(f"fleet.dead={fdead}")
+        if fleet.get("sloBreached"):
+            worries.append(
+                f"fleet p99 {fleet.get('p99Ms')}ms over budget "
+                f"{fleet.get('p99BudgetMs')}ms")
     items = [{"membership": membership, "workers": workers,
               "leaseEpochs": epochs, "lastFailover": last,
               "lastHandoff": last_handoff,
@@ -314,7 +357,8 @@ def collect_cluster(config: dict, ctx: dict) -> dict:
               "routed": s.get("routed"), "redelivered": s.get("redelivered"),
               "routeFaults": s.get("routeFaults"),
               "inflight": s.get("inflight"),
-              "fencedRecords": fenced, "routeLog": route_log}]
+              "fencedRecords": fenced, "routeLog": route_log,
+              "fleet": fleet_panel}]
     live = membership.get("live") or []
     summary = (f"{len(live)} live / {len(dead)} dead workers, "
                f"{len(epochs)} leases, routed={s.get('routed', 0)}")
@@ -330,6 +374,16 @@ def collect_cluster(config: dict, ctx: dict) -> dict:
                     f"{last_handoff.get('from')}→{last_handoff.get('to')} "
                     f"({last_handoff.get('replayedRecords')} replayed, "
                     f"{last_handoff.get('durationMs')}ms)")
+    if fleet_panel is not None:
+        n_alive = len((fleet_panel.get("membership") or {}).get("alive")
+                      or [])
+        summary += (f", fleet: {n_alive} replicas "
+                    f"({fleet_panel['openWindows']} windows open), "
+                    f"served={fleet_panel.get('served', 0)}")
+        decision = (fleet_panel.get("autoscaler") or {}).get("lastDecision")
+        if decision:
+            summary += (f", autoscaler: {decision.get('action')} "
+                        f"({decision.get('reason')})")
     if worries:
         summary += " — " + ", ".join(worries)
     return {"status": "warn" if worries else "ok", "items": items,

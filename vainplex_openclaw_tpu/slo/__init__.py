@@ -1,10 +1,17 @@
 """SLO load harness (ISSUE 6): deterministic seeded multi-tenant traffic
 through the full gateway→governance→cortex→knowledge→events pipeline, with
 p50/p95/p99 per stage and end-to-end, admission-control degradation at
-saturation, and bit-reproducible simulated-time runs for CI gating."""
+saturation, and bit-reproducible simulated-time runs for CI gating.
 
-from .harness import run_slo_report, slo_stage_records
-from .workload import generate_workload, workload_digest
+ISSUE 17 adds the replica-fleet plane: ``generate_fleet_workload`` produces
+rate-modulated (diurnal/burst) validator traffic in virtual seconds, and
+``run_fleet_slo_report`` drives it through a real :class:`ReplicaFleet` in
+virtual time — the autoscaler's bit-reproducible A/B gate."""
 
-__all__ = ["generate_workload", "run_slo_report", "slo_stage_records",
-           "workload_digest"]
+from .harness import (run_fleet_slo_report, run_slo_report, sim_severity,
+                      slo_stage_records)
+from .workload import generate_fleet_workload, generate_workload, workload_digest
+
+__all__ = ["generate_fleet_workload", "generate_workload",
+           "run_fleet_slo_report", "run_slo_report", "sim_severity",
+           "slo_stage_records", "workload_digest"]

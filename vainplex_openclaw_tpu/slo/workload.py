@@ -179,6 +179,59 @@ def generate_workload(seed: int = 0, n_ops: int = 2000,
     return ops
 
 
+def generate_fleet_workload(seed: int = 0, n_ops: int = 2000,
+                            tenants: int = 4, profile: str = "diurnal",
+                            base_rate: float = 400.0,
+                            peak_factor: float = 4.0,
+                            period_s: float = 1.0) -> list:
+    """Stage-3 validator traffic for the replica fleet (ISSUE 17): message
+    texts (the fleet serves verdicts, so every op is a validation) on
+    rate-modulated arrivals in virtual SECONDS.
+
+    Profiles:
+
+    - ``diurnal`` — the arrival rate rides one raised-cosine day: ``lo`` at
+      the edges, ``lo * peak_factor`` mid-trace. One trace therefore holds
+      exactly the autoscaler's A/B story: under-provisioned at the peak
+      unless it spawns, over-provisioned after unless it retires.
+    - ``burst`` — flat baseline punctuated by seeded flash crowds (~20x
+      rate for 8–48 requests), the window-thrash regime for routing.
+
+    A separate rng stream (``fleet:<profile>:<seed>``) and a brand-new
+    function: ``generate_workload`` and every existing profile stay
+    byte-for-byte untouched (the drawing discipline the module pins)."""
+    import math
+
+    if profile not in ("diurnal", "burst"):
+        raise ValueError(f"unknown fleet workload profile {profile!r}")
+    rng = random.Random(f"fleet:{profile}:{seed}")
+    lo = float(base_rate)
+    hi = lo * float(peak_factor)
+    ops: list[Op] = []
+    t = 0.0
+    burst_left = 0
+    for i in range(n_ops):
+        if profile == "diurnal":
+            phase = min(1.0, t / float(period_s))
+            rate = lo + (hi - lo) * 0.5 * (1.0 - math.cos(
+                2.0 * math.pi * phase))
+            t += rng.expovariate(rate)
+        else:
+            if burst_left > 0:
+                burst_left -= 1
+                t += rng.expovariate(lo * 20.0)
+            elif rng.random() < 0.04:
+                burst_left = rng.randint(8, 48)
+                t += rng.expovariate(lo)
+            else:
+                t += rng.expovariate(lo)
+        tenant = rng.randrange(tenants)
+        lang = rng.choice(ALL_LANGS)
+        ops.append(Op(i, t, tenant, "validate", lang,
+                      _message(rng, lang, i)))
+    return ops
+
+
 def workload_digest(ops: list) -> dict:
     """Checksum + mix breakdown — the deterministic identity of a run."""
     blob = json.dumps([op.to_tuple() for op in ops],
