@@ -2052,6 +2052,237 @@ def _serve_cli(argv: list) -> dict:
     return bench_serve_latency(**kwargs)
 
 
+# Per-length budgets for the big-model long-context sweep (ISSUE 18): the
+# flash_len_budget discipline extended up the length ladder — a wedged 1M
+# compile can't eat the 16k/64k points, and rounds the budget DID cover are
+# recorded (partial: true), never discarded.
+LONG_LEN_BUDGETS = {16384: 420.0, 65536: 600.0, 262144: 600.0,
+                    1048576: 600.0}
+
+
+def long_len_budget(L: int) -> float:
+    return LONG_LEN_BUDGETS.get(L, max(LONG_LEN_BUDGETS.values()))
+
+
+def long_context_config(L: int):
+    """Deliberately tiny encoder at long seq_len: the sweep measures the
+    ring-attention SERVING path's length scaling, not model capacity, so
+    width stays minimal while L walks 16k → 1M."""
+    from vainplex_openclaw_tpu.models.encoder import EncoderConfig
+
+    return EncoderConfig(vocab_size=512, seq_len=L, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, attn_impl="dense")
+
+
+def write_serving_checkpoint(ckpt_dir: str, cfg, seed: int = 0) -> None:
+    """Random-init checkpoint in the shipped pretrained layout (config.json
+    manifest + step npz) — what the batcher's LOUD no-checkpoint contract
+    requires; tests/test_big_model_serving.py uses the same writer."""
+    import os
+
+    import jax
+
+    from vainplex_openclaw_tpu.models.checkpoint import save_checkpoint
+    from vainplex_openclaw_tpu.models.encoder import init_params
+    from vainplex_openclaw_tpu.models.pretrained import _config_to_manifest
+
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    save_checkpoint(ckpt_dir, params, step=1)
+    with open(os.path.join(ckpt_dir, "config.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"config": _config_to_manifest(cfg), "eval": {}}, f)
+
+
+def bench_serve_long_context(lengths: tuple = (16384, 65536, 262144, 1048576),
+                             rounds: int = 6, concurrency: int = 4,
+                             seed: int = 0, long_threshold: int = 256,
+                             skip_above: "int | None" = None,
+                             budget_s: "float | None" = None) -> dict:
+    """Big-model long-context serving sweep (ISSUE 18): p99 + retraces per
+    length through the REAL continuous batcher on the encoder_validator_long
+    family — requests whose token occupancy clears ``long_threshold`` route
+    to the ring-attention ``forward_long`` program over a (dp, sp) mesh.
+
+    Per-length discipline mirrors the flash-vs-dense sweep: each length owns
+    a budget (``LONG_LEN_BUDGETS``); when sampling overruns, the rounds that
+    DID complete are recorded with ``partial: true`` — a cut-off length
+    yields a truncated measurement, never a silent absence. On the CPU
+    virtual mesh, lengths whose dense ring step ([B, H, L/sp, L/sp] scores
+    per device) exceeds what the host can hold get an honest skip record
+    with the memory estimate (``skip_above``, default 16384 on cpu; an
+    accelerator run lifts it). The RetraceWitness pins the measured phase
+    compile-free per length: after the warmup round, the long program must
+    trace NOTHING (retraces: 0)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+    from vainplex_openclaw_tpu.models import long_context as lc
+    from vainplex_openclaw_tpu.models.batching import ContinuousBatcher
+    from vainplex_openclaw_tpu.parallel import plan as sharding_plan
+    from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+    platform, kind, _ = _device_peak()
+    if skip_above is None:
+        skip_above = 16384 if platform == "cpu" else max(lengths)
+    n_dev = len(jax.devices())
+    sp = 1
+    while sp * 2 <= n_dev:
+        sp *= 2
+    mesh = cached_mesh((max(1, n_dev // sp), sp), ("dp", "sp"))
+    rng = np.random.default_rng(seed)
+    words = ("deploy", "failed", "regressed", "migration", "shipped",
+             "audit", "benchmark", "recovered")
+
+    per_len: list[dict] = []
+    total_retraces = 0
+    for L in lengths:
+        budget = float(budget_s if budget_s is not None else long_len_budget(L))
+        if L > skip_above:
+            sp_sz = mesh.shape["sp"]
+            est_mb = (concurrency * 2 * (L // sp_sz) ** 2 * 4) / 2 ** 20
+            per_len.append({
+                "len": L, "skipped": True, "budget_s": budget,
+                "reason": f"dense ring step [B,H,L/sp,L/sp] ≈ "
+                          f"{est_mb:.0f} MB/device exceeds the {platform} "
+                          f"host budget (skip_above={skip_above}); run on "
+                          f"an accelerator to lift"})
+            continue
+        cfg = long_context_config(L)
+        # Every request carries > long_threshold real tokens, so the whole
+        # seeded mix routes through the ring program — the short-path twin
+        # is the per-family parity oracle in tests, not a bench axis.
+        n_words = int(long_threshold * 1.5)
+        texts = [" ".join(rng.choice(words) for _ in range(n_words))
+                 for _ in range(rounds * concurrency + concurrency)]
+        with tempfile.TemporaryDirectory() as ckpt_dir:
+            write_serving_checkpoint(ckpt_dir, cfg, seed=seed)
+            batcher = ContinuousBatcher(
+                checkpoint_dir=ckpt_dir, max_batch=concurrency,
+                window_ms=0.0, autostart=False, mesh=mesh,
+                plan_family="encoder_validator_long",
+                long_threshold=long_threshold)
+            try:
+                plan = sharding_plan.resolve_plan("encoder_validator_long",
+                                                  mesh)
+                # Warmup round: compiles the long program at the serve
+                # bucket; the timed phase below must compile nothing.
+                for t in texts[:concurrency]:
+                    batcher.enqueue(t)
+                batcher.step()
+                witness = RetraceWitness()
+                witness.probe(f"long_{L}", lc._build_run(
+                    cfg, mesh, plan.axes[0], plan.axes[1]))
+                base = witness.baseline()
+
+                lats: list[float] = []
+                t_len = time.perf_counter()
+                partial = False
+                for r in range(rounds):
+                    if time.perf_counter() - t_len > budget:
+                        partial = True
+                        break
+                    batch_texts = texts[(r + 1) * concurrency:
+                                        (r + 2) * concurrency]
+                    for t in batch_texts:
+                        batcher.enqueue(t)
+                    t0 = time.perf_counter()
+                    served = batcher.step()
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                    assert served == concurrency, \
+                        f"serve_long_context[{L}]: step served {served}"
+                measured_s = time.perf_counter() - t_len
+                retraces = int(witness.traces(f"long_{L}")
+                               - base.get(f"long_{L}", 0))
+                total_retraces += retraces
+                srt = sorted(lats)
+
+                def _q(q: float) -> float:
+                    return round(srt[min(len(srt) - 1,
+                                         int(q * (len(srt) - 1)))], 3)
+
+                per_len.append({
+                    "len": L, "p50_ms": _q(0.5), "p99_ms": _q(0.99),
+                    "rounds_completed": len(lats), "rounds_target": rounds,
+                    "partial": partial, "budget_s": budget,
+                    "retraces": retraces,
+                    "long_routed": int(batcher.long_routed),
+                    "tokens_per_s": round(
+                        len(lats) * concurrency * L / max(measured_s, 1e-9))})
+            finally:
+                batcher.close()
+
+    measured = [r for r in per_len if not r.get("skipped")]
+    rec = {"metric": "serve_long_context",
+           "value": (max(r["p99_ms"] for r in measured) if measured
+                     else None),
+           "unit": "ms", "lengths": per_len,
+           "rounds": rounds, "concurrency": concurrency, "seed": seed,
+           "long_threshold": long_threshold, "skip_above": skip_above,
+           "mesh_shape": "x".join(str(mesh.shape[a]) for a in ("dp", "sp")),
+           "retraces": total_retraces,
+           "families": sorted(sharding_plan.PLAN_TABLE),
+           "plan_provenance": sharding_plan.plan_provenance(
+               "encoder_validator_long", mesh),
+           "device": platform, "device_kind": kind}
+    return rec
+
+
+def _serve_long_cli(argv: list) -> dict:
+    """``python bench.py serve_long_context [--lengths 16384,65536]
+    [--rounds N] [--concurrency N] [--seed N] [--long-threshold N]
+    [--skip-above N] [--budget-s X]``. Re-execs onto virtual CPU host
+    devices when the process is short (the mesh_serve pattern), so the
+    (dp, sp) mesh exists from a plain single-device shell."""
+    import os
+    import subprocess
+
+    kwargs: dict = {}
+    flags = {"--rounds": ("rounds", int),
+             "--concurrency": ("concurrency", int), "--seed": ("seed", int),
+             "--long-threshold": ("long_threshold", int),
+             "--skip-above": ("skip_above", int),
+             "--budget-s": ("budget_s", float)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--lengths" and i + 1 < len(argv):
+            kwargs["lengths"] = tuple(int(x)
+                                      for x in argv[i + 1].split(","))
+            i += 2
+            continue
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"serve_long_context: bad or valueless arg "
+                             f"{arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    import jax
+
+    need = 8
+    if len(jax.devices()) < need \
+            and os.environ.get("OPENCLAW_SERVE_LONG_CHILD") != "1":
+        env = dict(os.environ)
+        env["OPENCLAW_SERVE_LONG_CHILD"] = "1"  # no re-exec loops
+        env["JAX_PLATFORMS"] = "cpu"
+        xf = [f for f in env.get("XLA_FLAGS", "").split()
+              if "host_platform_device_count" not in f]
+        xf.append(f"--xla_force_host_platform_device_count={need}")
+        env["XLA_FLAGS"] = " ".join(xf)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "serve_long_context", *argv],
+            env=env, capture_output=True, text=True, timeout=2700)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"serve_long_context child failed (rc={proc.returncode}): "
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    return bench_serve_long_context(**kwargs)
+
+
 def mesh_serve_stage_records(stage_quantiles: dict) -> list[dict]:
     """Per-stage quantile lines for the mesh-served path — the PR-14
     serve stages plus the mesh-only ``shard`` (params/token placement)
@@ -2997,6 +3228,12 @@ if __name__ == "__main__":
         for srec in serve_stage_records(rec.get("serve_stage_quantiles")):
             print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         print(json.dumps(rec, ensure_ascii=False))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "serve_long_context":
+        # Subcommand mode (ISSUE 18): ONE stdout line = the long-context
+        # sweep record (per-length p99 + retraces + honest skips). The CLI
+        # re-execs onto virtual CPU host devices for the (dp, sp) mesh.
+        print(json.dumps(_serve_long_cli(sys.argv[2:]), ensure_ascii=False))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "mesh_serve":
         # Subcommand mode (ISSUE 15): ONE stdout line = the mesh-serving

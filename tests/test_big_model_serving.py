@@ -1,0 +1,388 @@
+"""Big-model serving families ≡ the single-device oracle (ISSUE 18).
+
+Three dormant parallelism modes are now first-class serving families
+behind the continuous batcher: ``encoder_validator_pp`` (GPipe microbatch
+wavefront over a pp mesh), ``encoder_validator_long`` (ring-attention
+routing for requests past a token threshold over dp×sp), and the
+expert-parallel MoE pair (``encoder_validator_moe`` /
+``embeddings_forward_moe`` over dp×ep). These tests pin:
+
+- per-family batched verdicts EQUAL to the single-device one-shot oracle
+  through the real serve gateway (the test_mesh_serving discipline),
+- the length-threshold routing policy: long rows take the ring program,
+  short rows the dense short-path twin over the SAME placed weights, and
+  the split is visible in serve stats (``longRouted``),
+- pipeline checkpoint restore: ``restore_checkpoint`` with a pipeline
+  plan returns the STACKED stage tree (leaves lead [S, per_stage]) placed
+  over pp, and serving from it matches the flat-tree oracle,
+- ``serve_bucket`` flooring at the pipeline plan's microbatch count (the
+  B % M structural guarantee the GPipe reshape needs),
+- ``ring_attention_local``'s finite NEG_INF carry: a fully-masked row at
+  serving shapes must come out finite, never NaN (exp(-inf − -inf)),
+- MoE load-balance stats on the serve status surface, and the LOUD
+  armed-validation failure when the MoE family meets a dense checkpoint,
+- plan-table validation admitting the new families (runner/microbatches/
+  collectives fields), with the jax-free analysis twins pinned equal,
+- the batcher registry keying on planFamily (two families never share a
+  compiled batcher).
+
+conftest forces the 8-device virtual CPU mesh, so every shape here runs
+in any environment the suite runs in.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from test_serve_batching import seeded_texts
+
+
+class _CkptCase:
+    """One family's tmp checkpoint + the oracle/mesh gateway pair."""
+
+    def __init__(self, tmp_path, cfg, serve_cfg, seed=0):
+        import bench
+
+        self.cfg = cfg
+        self.ckpt_dir = str(tmp_path / "ckpt")
+        bench.write_serving_checkpoint(self.ckpt_dir, cfg, seed=seed)
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        self.oneshot = make_local_call_llm(
+            checkpoint_dir=self.ckpt_dir, force=True,
+            serve_cfg={"continuousBatching": False})
+        self.meshy = make_local_call_llm(
+            checkpoint_dir=self.ckpt_dir, force=True,
+            serve_cfg={"windowMs": 0.0, **serve_cfg})
+
+
+def _prompts(n, seed=0):
+    from vainplex_openclaw_tpu.governance.validation.llm_validator import \
+        build_prompt
+
+    return [build_prompt(t, []) for t in seeded_texts(n, seed=seed)]
+
+
+def _teardown():
+    from vainplex_openclaw_tpu.models.serve import close_batchers
+
+    close_batchers()
+
+
+# ── plan families + table validation ─────────────────────────────────
+
+
+class TestPlanFamilies:
+    def test_new_families_resolve(self):
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        pp = splan.serving_plan("encoder_validator_pp")
+        assert pp.runner == "pipeline" and pp.microbatches >= 1
+        assert pp.axes == ("pp",)
+        long = splan.serving_plan("encoder_validator_long")
+        assert long.runner == "long" and long.axes == ("dp", "sp")
+        for fam in ("encoder_validator_moe", "embeddings_forward_moe"):
+            moe = splan.serving_plan(fam)
+            assert moe.runner == "forward" and "ep" in moe.axes
+            assert any("moe/" in pat for pat, _ in moe.rules)
+        # every family's rule table stays closed by the explicit catch-all
+        for fam in splan.PLAN_TABLE:
+            assert splan.serving_plan(fam).rules[-1][0] == ""
+
+    def test_runner_constants_pinned_to_analysis_twins(self):
+        """parallel/plan.py and the jax-free analysis/sharding.py twins
+        must agree — tracelint validates the table file with the twins."""
+        from vainplex_openclaw_tpu.analysis import sharding as asharding
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        assert splan.RUNNERS == asharding.RUNNERS
+        assert splan.COLLECTIVE_KINDS == asharding.COLLECTIVE_KINDS
+
+    def test_shipped_plan_table_validates_with_new_families(self):
+        from vainplex_openclaw_tpu.analysis import sharding as asharding
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        table = splan.load_plan_table()
+        # family is the third key segment: device_family:shape:family
+        fams = {k.split(":", 2)[2] for k in table["entries"]}
+        for fam in ("encoder_validator_pp", "encoder_validator_long",
+                    "encoder_validator_moe", "embeddings_forward_moe"):
+            assert fam in fams, f"shipped table missing {fam}"
+        for key, ent in table["entries"].items():
+            assert splan.plan_entry_problems(ent) == [], key
+        assert asharding.check_plan_table_file(
+            splan.PLAN_TABLE_PATH, "parallel/plan_table.json") == []
+
+    def test_entry_problems_reject_bad_runner_fields(self):
+        from vainplex_openclaw_tpu.parallel import plan as splan
+
+        ent = splan.load_plan_table()["entries"]["cpu:2:encoder_validator_pp"]
+        bad = dict(ent, runner="warp")
+        assert any("runner" in p for p in splan.plan_entry_problems(bad))
+        nomb = dict(ent, microbatches=0)
+        assert any("microbatch" in p for p in splan.plan_entry_problems(nomb))
+        oddmb = dict(ent, microbatches=3)
+        assert any("microbatch" in p for p in splan.plan_entry_problems(oddmb))
+        badcoll = dict(ent, collectives=[["teleport", "wavefront"]])
+        assert any("collective" in p
+                   for p in splan.plan_entry_problems(badcoll))
+
+
+# ── pipeline-parallel family ─────────────────────────────────────────
+
+
+def _pp_cfg():
+    from vainplex_openclaw_tpu.models import EncoderConfig
+
+    return EncoderConfig(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                         n_layers=4, d_ff=128, attn_impl="dense")
+
+
+class TestPipelineFamily:
+    def teardown_method(self):
+        _teardown()
+
+    def test_serve_bucket_floors_at_microbatches(self):
+        from vainplex_openclaw_tpu.parallel import plan as splan
+        from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+        mesh = cached_mesh((4,), ("pp",))
+        plan = splan.resolve_plan("encoder_validator_pp", mesh)
+        assert plan.microbatches >= 4
+        # one request still forms a B % M == 0 wavefront batch
+        assert splan.serve_bucket(1, mesh, plan=plan) >= plan.microbatches
+        assert splan.serve_bucket(1, mesh, plan=plan) % plan.microbatches == 0
+
+    def test_gateway_verdicts_match_oneshot_oracle(self, tmp_path):
+        case = _CkptCase(tmp_path, _pp_cfg(), {
+            "meshServing": True, "meshShape": [4], "meshAxes": ["pp"],
+            "planFamily": "encoder_validator_pp"})
+        assert case.meshy.batcher.mesh is not None
+        for prompt in _prompts(8, seed=3):
+            assert case.meshy(prompt) == case.oneshot(prompt)
+        stats = case.meshy.batcher.stats()
+        assert stats["served"] >= 8
+        # per-microbatch wavefront attribution rides the serve StageTimer
+        assert case.meshy.batcher.timer.snapshot()["counts"].get(
+            "microbatch", 0) >= 1
+
+    def test_restore_checkpoint_stacks_and_serves(self, tmp_path):
+        import jax
+
+        from vainplex_openclaw_tpu.models import (
+            cast_params, encode_texts, forward, init_params)
+        from vainplex_openclaw_tpu.models.checkpoint import (
+            restore_checkpoint, save_checkpoint)
+        from vainplex_openclaw_tpu.ops.similarity import pad_rows
+        from vainplex_openclaw_tpu.parallel import plan as splan
+        from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+        cfg = _pp_cfg()
+        mesh = cached_mesh((4,), ("pp",))
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        ckpt = str(tmp_path / "pp-ckpt")
+        save_checkpoint(ckpt, params, step=1)
+        restored = restore_checkpoint(
+            ckpt, like=init_params(jax.random.PRNGKey(2), cfg),
+            mesh=mesh, plan="encoder_validator_pp")
+        # the returned tree is the STACKED stage tree: block leaves lead
+        # [S, per_stage] and are sharded over pp
+        stacked = restored["blocks"]
+        assert isinstance(stacked, dict)
+        first = jax.tree_util.tree_leaves(stacked)[0]
+        assert first.shape[0] == 4
+        texts = seeded_texts(4, seed=5)
+        toks = pad_rows(encode_texts(texts, cfg.seq_len, cfg.vocab_size),
+                        splan.serve_bucket(len(texts), mesh,
+                                           plan="encoder_validator_pp"))
+        out = splan.serve_forward(
+            restored, splan.place_tokens(toks, mesh, "encoder_validator_pp"),
+            cfg, mesh, "encoder_validator_pp")
+        oracle = forward(cast_params(params, cfg.dtype),
+                         toks[:len(texts)], cfg)
+        assert (np.asarray(out["severity"])[:len(texts)].argmax(-1)
+                == np.asarray(oracle["severity"]).argmax(-1)).all()
+
+
+# ── long-context family ──────────────────────────────────────────────
+
+
+def _long_cfg():
+    from vainplex_openclaw_tpu.models import EncoderConfig
+
+    return EncoderConfig(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                         n_layers=2, d_ff=128, attn_impl="dense")
+
+
+class TestLongContextFamily:
+    def teardown_method(self):
+        _teardown()
+
+    def test_threshold_routing_and_parity(self, tmp_path):
+        """A mixed batch splits at the token threshold: long rows route to
+        the ring program, short rows to the dense twin, verdicts all match
+        the one-shot oracle, and the split is visible in stats."""
+        case = _CkptCase(tmp_path, _long_cfg(), {
+            "meshServing": True, "meshShape": [2, 4],
+            "meshAxes": ["dp", "sp"],
+            "planFamily": "encoder_validator_long",
+            "longContext": {"thresholdTokens": 8}})
+        from vainplex_openclaw_tpu.governance.validation.llm_validator import \
+            build_prompt
+
+        long_texts = [
+            f"the deploy failed with code {i} and the retry stalled while "
+            f"throughput regressed badly across every shard" for i in range(3)]
+        short_texts = ["ok", "fine", "done"]
+        for text in long_texts + short_texts:
+            prompt = build_prompt(text, [])
+            assert case.meshy(prompt) == case.oneshot(prompt)
+        stats = case.meshy.batcher.stats()
+        assert stats["longRouted"] >= len(long_texts)
+        # short rows did NOT ride the ring program
+        assert stats["longRouted"] < stats["served"]
+
+    def test_fully_padded_row_stays_finite_through_forward_long(self):
+        import jax.numpy as jnp
+
+        from vainplex_openclaw_tpu.models import encode_texts, forward_long
+        from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+        cfg = _long_cfg()
+        mesh = cached_mesh((2, 4), ("dp", "sp"))
+        toks = encode_texts(["the deploy failed", "x", "", "retry stalled"],
+                            cfg.seq_len, cfg.vocab_size)
+        toks[2, :] = 0  # all-padding row: every attention key masked
+        out = forward_long(jax_params(cfg), jnp.asarray(toks), cfg, mesh)
+        for head in ("severity", "keep", "mood", "embedding"):
+            assert np.isfinite(np.asarray(out[head])).all(), head
+
+    def test_ring_attention_local_masked_row_finite(self):
+        """The finite NEG_INF carry at serving shapes: a row whose kv_mask
+        is all False must produce finite output (a true -inf would make
+        the online-softmax carry NaN through exp(m_old - m_new))."""
+        import jax
+        import jax.numpy as jnp
+
+        from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+        from vainplex_openclaw_tpu.parallel.ring_attention import \
+            ring_attention
+
+        B, H, L, Dh = 2, 4, 64, 16
+        mesh = cached_mesh((2, 4), ("dp", "sp"))
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (B, H, L, Dh), jnp.float32)
+                   for kk in ks)
+        mask = jnp.ones((B, L), bool).at[1, :].set(False)
+        out = ring_attention(q, k, v, mask, mesh)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+def jax_params(cfg):
+    import jax
+
+    from vainplex_openclaw_tpu.models import cast_params, init_params
+
+    return cast_params(init_params(jax.random.PRNGKey(0), cfg), cfg.dtype)
+
+
+# ── expert-parallel MoE family ───────────────────────────────────────
+
+
+def _moe_cfg():
+    from vainplex_openclaw_tpu.models import EncoderConfig
+
+    return EncoderConfig(vocab_size=512, seq_len=64, d_model=64, n_heads=4,
+                         n_layers=2, d_ff=128, n_experts=2,
+                         attn_impl="dense")
+
+
+class TestMoEFamily:
+    def teardown_method(self):
+        _teardown()
+
+    def test_gateway_verdicts_match_oneshot_oracle(self, tmp_path):
+        case = _CkptCase(tmp_path, _moe_cfg(), {
+            "meshServing": True, "meshShape": [2, 2],
+            "meshAxes": ["dp", "ep"],
+            "planFamily": "encoder_validator_moe"})
+        for prompt in _prompts(8, seed=7):
+            assert case.meshy(prompt) == case.oneshot(prompt)
+        # load-balance observability on the serve status surface
+        moe_stats = case.meshy.batcher.stats().get("moe")
+        assert moe_stats is not None and moe_stats["batches"] >= 1
+        assert np.isfinite(moe_stats["auxLast"])
+        assert np.isfinite(moe_stats["auxMean"])
+
+    def test_embeddings_moe_family_parity(self, tmp_path):
+        """embeddings_forward_moe over dp×ep matches the single-device
+        embedding for the same MoE checkpoint."""
+        import bench
+        import jax.numpy as jnp
+
+        from vainplex_openclaw_tpu.models import encode_texts, forward
+        from vainplex_openclaw_tpu.models.pretrained import load_pretrained
+        from vainplex_openclaw_tpu.ops.similarity import pad_rows
+        from vainplex_openclaw_tpu.parallel import plan as splan
+        from vainplex_openclaw_tpu.parallel.mesh import cached_mesh
+
+        cfg = _moe_cfg()
+        ckpt = str(tmp_path / "moe-emb")
+        bench.write_serving_checkpoint(ckpt, cfg, seed=4)
+        cfg2, params = load_pretrained(ckpt)
+        mesh = cached_mesh((2, 2), ("dp", "ep"))
+        texts = seeded_texts(5, seed=8)
+        toks = pad_rows(encode_texts(texts, cfg2.seq_len, cfg2.vocab_size),
+                        splan.serve_bucket(len(texts), mesh,
+                                           plan="embeddings_forward_moe"))
+        placed = splan.sharded_params("test-moe-emb", params, mesh,
+                                      "embeddings_forward_moe")
+        out = splan.serve_forward(
+            placed, splan.place_tokens(toks, mesh, "embeddings_forward_moe"),
+            cfg2, mesh, "embeddings_forward_moe")
+        oracle = forward(params, jnp.asarray(toks[:len(texts)]), cfg2)
+        np.testing.assert_allclose(
+            np.asarray(out["embedding"])[:len(texts)],
+            np.asarray(oracle["embedding"]), atol=2e-2)
+
+    def test_moe_family_on_dense_checkpoint_fails_loud(self, tmp_path):
+        """Armed validate_rule_table: the MoE rules match nothing in a
+        dense (no-experts) checkpoint, so placement raises instead of
+        silently replicating what it was supposed to expert-shard."""
+        import bench
+
+        from vainplex_openclaw_tpu.models import EncoderConfig
+
+        dense_cfg = EncoderConfig(vocab_size=512, seq_len=64, d_model=64,
+                                  n_heads=4, n_layers=2, d_ff=128)
+        case = _CkptCase(tmp_path, dense_cfg, {
+            "meshServing": True, "meshShape": [2, 2],
+            "meshAxes": ["dp", "ep"],
+            "planFamily": "encoder_validator_moe"})
+        del bench
+        with pytest.raises(ValueError, match="rule-table validation"):
+            case.meshy(_prompts(1)[0])
+
+
+# ── registry keying ──────────────────────────────────────────────────
+
+
+class TestRegistryKeying:
+    def teardown_method(self):
+        _teardown()
+
+    def test_plan_family_keys_distinct_batchers(self):
+        from vainplex_openclaw_tpu.models.serve import make_local_call_llm
+
+        base = {"windowMs": 0.0, "meshServing": True, "meshShape": [2, 4]}
+        default_fam = make_local_call_llm(force=True, serve_cfg=dict(base))
+        long_fam = make_local_call_llm(force=True, serve_cfg=dict(
+            base, meshAxes=["dp", "sp"],
+            planFamily="encoder_validator_long"))
+        thresh = make_local_call_llm(force=True, serve_cfg=dict(
+            base, meshAxes=["dp", "sp"],
+            planFamily="encoder_validator_long",
+            longContext={"thresholdTokens": 7}))
+        assert default_fam.batcher is not long_fam.batcher
+        assert long_fam.batcher is not thresh.batcher

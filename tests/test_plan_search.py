@@ -647,9 +647,26 @@ class TestShippedTable:
         """Property test: every shape-keyed entry builds a plan that
         passes the ARMED validate_rule_table against a real encoder
         param tree and places cleanly on its mesh."""
+        import jax
+
+        from vainplex_openclaw_tpu.models import (
+            EncoderConfig, cast_params, init_params)
+
         splan = _splan()
         table = _shipped_table()
         _cfg, params = _tiny_cfg_params()
+        # The ISSUE 18 families validate against different real trees:
+        # moe rules must WIN on moe/{gate,w1,w2} paths, pipeline rules on
+        # the stage-stacked blocks dict (n_layers divisible by |pp|).
+        moe_cfg = EncoderConfig(vocab_size=512, seq_len=64, d_model=64,
+                                n_heads=4, n_layers=2, d_ff=128,
+                                n_experts=4)
+        moe_params = cast_params(
+            init_params(jax.random.PRNGKey(0), moe_cfg), moe_cfg.dtype)
+        pp_cfg = EncoderConfig(vocab_size=512, seq_len=64, d_model=64,
+                               n_heads=4, n_layers=4, d_ff=128)
+        pp_params = cast_params(
+            init_params(jax.random.PRNGKey(0), pp_cfg), pp_cfg.dtype)
         checked = 0
         for key, ent in table["entries"].items():
             _dev, shape_s, family = key.split(":")
@@ -661,9 +678,21 @@ class TestShippedTable:
                 continue  # conftest mesh is 8 virtual devices
             assert splan.plan_entry_problems(ent) == [], key
             plan = splan._plan_from_entry(family, key, ent)
-            axes = ("dp", "tp")[:len(shape)] if len(shape) <= 2 else None
+            # Since ISSUE 18 entries declare their own axes (pp / dp,sp /
+            # dp,ep); the mesh must carry exactly those. Fall back to the
+            # dp×tp convention only for legacy entries without the field.
+            if plan.axes:
+                axes = tuple(plan.axes)
+            else:
+                axes = ("dp", "tp")[:len(shape)] if len(shape) <= 2 else None
             mesh = _mesh(shape, axes)
-            shardings = splan.plan_shardings(plan, params, mesh)
+            if plan.runner == "pipeline":
+                fam_params = splan.prepare_params(plan, pp_params, mesh)
+            elif family.endswith("_moe"):
+                fam_params = moe_params
+            else:
+                fam_params = params
+            shardings = splan.plan_shardings(plan, fam_params, mesh)
             assert shardings is not None
             assert splan.serve_bucket(1, mesh, plan=plan) >= \
                 plan.bucket_min

@@ -161,6 +161,13 @@ JIT_TABLE: tuple[JitEntry, ...] = (
                   "(cfg, mesh, axes) so repeat calls hit the jit cache",
         builders=("_build_run",),
         entry_names=("forward_long",),
+        fixed_callers=(
+            (f"{_PKG}/parallel/plan.py", "serve_forward",
+             "runner dispatch (ISSUE 18): every serve_forward caller "
+             "buckets its batch through serve_bucket + pad_rows before "
+             "placement, so the long-context runner sees O(log N) batch "
+             "shapes per (cfg, mesh)"),
+        ),
     ),
     JitEntry(
         module=f"{_PKG}/parallel/ring_attention.py",
@@ -193,6 +200,33 @@ JIT_TABLE: tuple[JitEntry, ...] = (
         wrapper="LocalEmbeddings._embed",
         shape_policy=BUCKETED,
         builders=("LocalEmbeddings._ensure_model",),
+    ),
+    JitEntry(
+        # Pipeline-parallel serving forward (ISSUE 18): the GPipe
+        # wavefront behind the encoder_validator_pp family. Both the
+        # jitted runner and the stage callable come from lru_cache
+        # factories — _stage_fn(cfg) keeps the stage function identity-
+        # stable so _build_pipe_run's own cache (keyed on the function
+        # object) hits across batches.
+        module=f"{_PKG}/models/pipeline_serve.py",
+        jit_fns=("_build_pp_serve.run", "_stage_fn.stage"),
+        static=("cfg", "mesh", "plan_axes", "microbatches", "plan"),
+        shape_policy=FIXED,
+        rationale="compiled per (cfg, mesh, pp axis, microbatch count); "
+                  "seq_len is fixed by config and the batch dim arrives "
+                  "through serve_bucket, which floors at the plan's "
+                  "microbatches so B % M is structural — callers are the "
+                  "plan.serve_forward dispatch and the plan-search "
+                  "warmup, both bucketed",
+        builders=("_build_pp_serve", "_stage_fn"),
+        entry_names=("pp_serve_forward",),
+        fixed_callers=(
+            (f"{_PKG}/parallel/plan.py", "serve_forward",
+             "runner dispatch (ISSUE 18): serve_forward callers bucket "
+             "through serve_bucket, which floors at the plan's "
+             "microbatches, so B % M holds and the pipeline runner sees "
+             "O(log N) batch shapes per (cfg, mesh, plan)"),
+        ),
     ),
     JitEntry(
         # Mesh-serving compiled variants (ISSUE 15): the declarative

@@ -294,6 +294,14 @@ def check_rule_tables_source(src: str, path: str, tree=None) -> list:
 # of jax imports; tests/test_plan_search.py pins the two equal.
 PLAN_TABLE_SCHEMA = "plan-table-v1"
 
+# jax-free twins of plan.RUNNERS / plan.COLLECTIVE_KINDS (ISSUE 18) —
+# pinned equal in tests/test_big_model_serving.py, same discipline as the
+# schema twin above. The CommSketch grammar's declared-collective rows in
+# the JSON artifact are linted against these.
+RUNNERS = ("forward", "pipeline", "long")
+COLLECTIVE_KINDS = ("psum", "all_gather", "ppermute", "all_to_all",
+                    "reduce_scatter")
+
 
 def check_plan_table_file(path, rel: str) -> list:
     """GL-SHARD-RULE over the CHECKED-IN searched plan table
@@ -367,6 +375,26 @@ def check_plan_table_file(path, rel: str) -> list:
         if patterns:
             findings.extend(_pattern_findings(patterns, rel, 1,
                                               where=key))
+        # Big-model family fields (ISSUE 18): an unknown runner would
+        # make the loader fall back loudly at serve time — catch the
+        # typo here; collectives rows are the CommSketch grammar's
+        # serialized signature and must use declared kinds.
+        runner = ent.get("runner", "forward")
+        if runner not in RUNNERS:
+            findings.append(Finding(
+                "GL-SHARD-RULE", rel, 1,
+                f"plan-table entry {key!r}: unknown runner {runner!r} "
+                f"(known: {RUNNERS})",
+                detail=f"table:runner:{key}"))
+        for coll in (ent.get("collectives") or []):
+            kind = coll[0] if isinstance(coll, list) and coll else None
+            if kind not in COLLECTIVE_KINDS:
+                findings.append(Finding(
+                    "GL-SHARD-RULE", rel, 1,
+                    f"plan-table entry {key!r}: collective row {coll!r} "
+                    f"does not name a known collective kind "
+                    f"(known: {COLLECTIVE_KINDS})",
+                    detail=f"table:coll:{key}"))
         try:
             rank = len(shape_s.split("x"))
             axes = ent.get("axes")

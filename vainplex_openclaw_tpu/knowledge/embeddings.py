@@ -140,7 +140,8 @@ class LocalEmbeddings:
     def __init__(self, logger, seed: int = 11, learned_weight: float = 0.5,
                  checkpoint_dir: Optional[str] = None,
                  timer: Optional[StageTimer] = None,
-                 query_cache_size: int = 256, mesh=None):
+                 query_cache_size: int = 256, mesh=None,
+                 plan_family: str = "embeddings_forward"):
         self.logger = logger
         self.seed = seed
         self.learned_weight = learned_weight
@@ -154,7 +155,11 @@ class LocalEmbeddings:
         # (parallel/plan.py — replicated weights, batch over dp) and arena
         # search through a dp-sharded score matmul. None keeps the
         # single-device path verbatim — the equivalence oracle.
+        # ``plan_family`` (ISSUE 18) selects the serving family — the
+        # expert-parallel "embeddings_forward_moe" over (dp, ep) for MoE
+        # checkpoints; the default stays the dp-only plan.
         self._mesh = mesh
+        self._plan_family = plan_family
         # Device-committed arena copy for mesh search: re-committed (and
         # "shard"-attributed in the timer) only after host mutations —
         # sync/remove flip the dirty flag under the lock.
@@ -237,14 +242,14 @@ class LocalEmbeddings:
             from ..parallel import plan as sharding_plan
 
             padded = pad_rows(tokens, sharding_plan.serve_bucket(
-                n, self._mesh, plan="embeddings_forward"))
+                n, self._mesh, plan=self._plan_family))
             placed = sharding_plan.sharded_params(
                 (self.checkpoint_dir or "shipped-default", self.seed),
-                params, self._mesh, "embeddings_forward")
+                params, self._mesh, self._plan_family)
             tokens_dev = sharding_plan.place_tokens(
-                padded, self._mesh, "embeddings_forward")
+                padded, self._mesh, self._plan_family)
             out = sharding_plan.serve_forward(
-                placed, tokens_dev, cfg, self._mesh, "embeddings_forward")
+                placed, tokens_dev, cfg, self._mesh, self._plan_family)
             learned = np.asarray(out["embedding"],
                                  dtype=np.float32)[:n]  # already L2-normed
         else:
@@ -343,7 +348,7 @@ class LocalEmbeddings:
         from ..parallel import plan as sharding_plan
 
         rows = sharding_plan.serve_bucket(size, self._mesh,
-                                          plan="embeddings_forward")
+                                          plan=self._plan_family)
         if self._arena_dirty or self._device_arena_rows != rows:
             with self.timer.stage("shard"):
                 padded = np.zeros((rows, self._arena.shape[1]), np.float32)
@@ -417,24 +422,37 @@ def create_embeddings(config: dict, logger, http_post: Callable = _default_http_
         return ChromaEmbeddings(config, logger, http_post)
     if backend == "local":
         mesh = None
+        plan_family = (config or {}).get("planFamily", "embeddings_forward")
         if (config or {}).get("meshServing"):
-            # Opt-in (like serve.meshServing): builds the dp mesh NOW — a
+            # Opt-in (like serve.meshServing): builds the mesh NOW — a
             # deliberate eager jax touch, because a serving config that
             # cannot get its devices must fail at construction, not on
             # the first sync. meshShape null = every local device. The
-            # embeddings plan is dp-only, so a multi-dim shape (the serve
-            # config's [2, 4] form, which the schema accepts) flattens to
-            # its device count instead of crashing Mesh construction.
+            # default embeddings plan is dp-only, so under the default
+            # axes a multi-dim shape (the serve config's [2, 4] form,
+            # which the schema accepts) flattens to its device count
+            # instead of crashing Mesh construction. ``meshAxes``
+            # (ISSUE 18) opts into multi-axis families — the
+            # expert-parallel plan wants ("dp", "ep"); a shape of the
+            # wrong rank then auto-factors over the first two axes.
             import math
 
             import jax
 
-            from ..parallel.mesh import cached_mesh
+            from ..parallel.mesh import _factor, cached_mesh
 
+            axes = tuple((config or {}).get("meshAxes") or ("dp",))
             shape = (config or {}).get("meshShape") or (len(jax.devices()),)
-            n = math.prod(int(s) for s in shape)
-            mesh = cached_mesh((n,), ("dp",))
+            shape = tuple(int(s) for s in shape)
+            n = math.prod(shape)
+            if len(axes) == 1:
+                mesh = cached_mesh((n,), axes)
+            else:
+                if len(shape) != len(axes):
+                    shape = _factor(n) + (1,) * (len(axes) - 2)
+                mesh = cached_mesh(shape, axes)
         return LocalEmbeddings(logger,
                                checkpoint_dir=(config or {}).get("checkpointDir"),
-                               timer=timer, mesh=mesh)
+                               timer=timer, mesh=mesh,
+                               plan_family=plan_family)
     return None

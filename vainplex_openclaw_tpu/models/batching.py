@@ -91,6 +91,7 @@ class ContinuousBatcher:
                  autostart: bool = True, mesh=None,
                  plan_family: str = "encoder_validator",
                  searched_plans: bool = True,
+                 long_threshold: int = 1024,
                  model_fn: Optional[Callable] = None):
         # Fleet sim seam (ISSUE 17): ``model_fn(texts) -> [severity]``
         # replaces the checkpoint forward entirely — queue/window/verdict
@@ -120,6 +121,17 @@ class ContinuousBatcher:
         self.mesh = mesh
         self.plan_family = plan_family
         self.searched_plans = bool(searched_plans)
+        # Big-model families (ISSUE 18): when the resolved plan's runner is
+        # "long", rows whose real token occupancy reaches this threshold
+        # route to the ring-attention program; shorter rows take the dense
+        # short-path twin over the SAME placed weights. MoE aux-loss stats
+        # (load-balance observability) accumulate whenever the checkpoint
+        # config declares experts.
+        self.long_threshold = max(1, int(long_threshold))
+        self.long_routed = 0
+        self._moe_aux_last: Optional[float] = None
+        self._moe_aux_sum = 0.0
+        self._moe_batches = 0
         self.checkpoint_dir = checkpoint_dir
         self.max_batch = max(1, int(max_batch))
         self.window_ms = float(window_ms)
@@ -304,26 +316,68 @@ class ContinuousBatcher:
 
             plan = sharding_plan.resolve_plan(
                 self.plan_family, self.mesh, searched=self.searched_plans)
-            padded = pad_rows(tokens, sharding_plan.serve_bucket(
-                len(batch), self.mesh, plan=plan))
+            # Long-context routing (ISSUE 18): with a "long"-runner plan,
+            # rows at/above the occupancy threshold run the ring-attention
+            # program over (dp, sp); the rest take the dense short-path
+            # twin — same rule table, so BOTH sub-batches serve from one
+            # placed param tree. The router reads real token occupancy
+            # (post-tokenize), not byte lengths.
+            subs = []  # (row indices, sub-plan, padded tokens)
+            if plan.runner == "long":
+                occ = (np.asarray(tokens) > 0).sum(axis=1)
+                is_long = occ >= self.long_threshold
+                short_plan = sharding_plan.short_path_plan(plan)
+                for sub_plan, idx in ((plan, np.nonzero(is_long)[0]),
+                                      (short_plan, np.nonzero(~is_long)[0])):
+                    if idx.size:
+                        subs.append((idx, sub_plan, pad_rows(
+                            tokens[idx], sharding_plan.serve_bucket(
+                                int(idx.size), self.mesh, plan=sub_plan))))
+                with self._lock:
+                    self.long_routed += int(is_long.sum())
+            else:
+                subs.append((np.arange(len(batch)), plan, pad_rows(
+                    tokens, sharding_plan.serve_bucket(
+                        len(batch), self.mesh, plan=plan))))
             t1 = self._clock()
             self.timer.add("batch", (t1 - t0) * 1e3)
             from .pretrained import DEFAULT_DIR
 
             ckpt_key = os.path.abspath(self.checkpoint_dir or DEFAULT_DIR)
-            placed_params = sharding_plan.sharded_params(
-                ckpt_key, params, self.mesh, plan)
-            placed_tokens = sharding_plan.place_tokens(
-                padded, self.mesh, plan)
+            placed = [
+                (idx, sub_plan,
+                 sharding_plan.sharded_params(ckpt_key, params, self.mesh,
+                                              sub_plan),
+                 sharding_plan.place_tokens(padded, self.mesh, sub_plan))
+                for idx, sub_plan, padded in subs]
             t_sh = self._clock()
             self.timer.add("shard", (t_sh - t1) * 1e3)
-            out = sharding_plan.serve_forward(
-                placed_params, placed_tokens, cfg, self.mesh, plan)
-            jax.block_until_ready(out["severity"])
+            outs = [(idx, sharding_plan.serve_forward(
+                sub_params, sub_tokens, cfg, self.mesh, sub_plan))
+                for idx, sub_plan, sub_params, sub_tokens in placed]
+            for _idx, out in outs:
+                jax.block_until_ready(out["severity"])
             t2 = self._clock()
             self.timer.add("prefill", (t2 - t_sh) * 1e3)
-            severity = np.asarray(out["severity"])  # one copy (or per-shard
-            # assembly when the plan gathers "sharded")
+            if plan.runner == "pipeline" and plan.microbatches:
+                # Per-microbatch attribution: the wavefront is ONE XLA
+                # program, so each microbatch is charged the amortized
+                # share of the prefill — a mean, not a measured per-hop
+                # wall time (docs/serving-perf.md says so too).
+                per_mb = (t2 - t_sh) * 1e3 / plan.microbatches
+                for _ in range(plan.microbatches):
+                    self.timer.add("microbatch", per_mb)
+            severity = np.zeros((len(batch), int(cfg.n_severity)),
+                                np.float32)
+            for idx, out in outs:  # one copy per sub-batch (or per-shard
+                # assembly when the plan gathers "sharded")
+                severity[idx] = np.asarray(out["severity"])[:idx.size]
+            if getattr(cfg, "n_experts", 0) > 0 and outs:
+                aux = float(np.asarray(outs[0][1]["moe_aux"]))
+                with self._lock:
+                    self._moe_aux_last = aux
+                    self._moe_aux_sum += aux
+                    self._moe_batches += 1
             t_g = self._clock()
             self.timer.add("gather", (t_g - t2) * 1e3)
             t2 = t_g
@@ -362,6 +416,18 @@ class ContinuousBatcher:
                              if self.mesh is not None else None)}
         base["meanBatch"] = round(base["served"] / base["batches"], 2) \
             if base["batches"] else 0.0
+        if self.mesh is not None:
+            base["longRouted"] = self.long_routed
+        if self._moe_batches:
+            # Expert load-balance observability (ISSUE 18): the MoE aux
+            # loss IS the router's imbalance score — flat routing scores
+            # n_experts × the balance term's minimum, a hot expert scores
+            # higher. Surfaced per-batch (last) and as the serving mean.
+            base["moe"] = {
+                "auxLast": round(self._moe_aux_last, 6),
+                "auxMean": round(self._moe_aux_sum / self._moe_batches, 6),
+                "batches": self._moe_batches,
+            }
         if self.admission is not None:
             base["admission"] = self.admission.stats()
         base["stages"] = self.timer.snapshot()

@@ -172,6 +172,7 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
         dtypes = json.load(f).get("dtypes", {})
 
     flat_shardings = None
+    pipe_plan = None
     if mesh is not None:
         from ..parallel.plan import plan_shardings as _plan_shardings
         from ..parallel.plan import serving_plan
@@ -180,11 +181,24 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
             raise ValueError("restore_checkpoint: mesh given without a plan")
         if isinstance(plan, str):
             plan = serving_plan(plan)
-        # Armed validation + rule match over the TEMPLATE tree (same paths
-        # and shapes as the checkpoint), then one NamedSharding per leaf
-        # in flatten order (NamedShardings are pytree leaves themselves).
-        flat_shardings = jax.tree_util.tree_leaves(
-            _plan_shardings(plan, like, mesh))
+        if getattr(plan, "runner", "forward") == "pipeline":
+            # Pipeline plans (ISSUE 18) shard the STACKED stage tree —
+            # rules like ("blocks/", P("pp")) are written against leaves
+            # with a leading [S, per_stage] axis that the flat checkpoint
+            # layout does not have (and per-leaf specs against the flat
+            # layout would mis-shard weight matrix dims over pp). So:
+            # restore host-side first, stack_stage_params, THEN place.
+            # NOTE: the returned tree's "blocks" is the stacked pytree,
+            # not ``like``'s per-layer list — the shape serve_forward's
+            # pipeline runner consumes.
+            pipe_plan = plan
+        else:
+            # Armed validation + rule match over the TEMPLATE tree (same
+            # paths and shapes as the checkpoint), then one NamedSharding
+            # per leaf in flatten order (NamedShardings are pytree leaves
+            # themselves).
+            flat_shardings = jax.tree_util.tree_leaves(
+                _plan_shardings(plan, like, mesh))
     leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
     restored = []
     for i, (leaf_path, leaf) in enumerate(leaves):
@@ -195,6 +209,11 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
         saved_dtype = _resolve_dtype(dtypes[key]) if key in dtypes else arr.dtype
         if arr.dtype != saved_dtype:  # stored as a same-itemsize uint view
             arr = arr.view(saved_dtype)
+        if pipe_plan is not None:
+            target_dtype = leaf.dtype if hasattr(leaf, "dtype") else arr.dtype
+            restored.append(arr.astype(target_dtype)
+                            if arr.dtype != target_dtype else arr)
+            continue
         if flat_shardings is not None:
             target_dtype = leaf.dtype if hasattr(leaf, "dtype") else arr.dtype
             arr = arr.astype(target_dtype) if arr.dtype != target_dtype else arr
@@ -216,4 +235,12 @@ def restore_checkpoint(ckpt_dir: str, like: Any,
             restored.append(arr)
     if arrays:
         raise KeyError(f"checkpoint {path} has extra leaves: {sorted(arrays)[:5]}")
-    return jax.tree_util.tree_unflatten(treedef, restored)
+    tree = jax.tree_util.tree_unflatten(treedef, restored)
+    if pipe_plan is not None:
+        from ..parallel.plan import plan_shardings as _plan_shardings
+        from ..parallel.plan import prepare_params
+
+        prepared = prepare_params(pipe_plan, tree, mesh)
+        return jax.device_put(
+            prepared, _plan_shardings(pipe_plan, prepared, mesh))
+    return tree

@@ -1,0 +1,107 @@
+"""Pipeline-parallel serving forward for the ``encoder_validator_pp``
+family (ISSUE 18 / ROADMAP item 3).
+
+The checkpoint's layer stack is resharded into S = |pp| stages
+(``parallel.pipeline.stack_stage_params`` — done host-side in
+``parallel.plan.prepare_params`` before placement, so the P("pp") rules
+match the STACKED tree whose leaves lead [S, per_stage]); the batch runs
+the GPipe (M + S − 1)-step wavefront from ``pipeline_apply``. Embedding,
+final norm, pooling and the output heads live OUTSIDE the wavefront and
+replicate — they are a few d_model-sized matmuls, not worth a pipeline
+bubble — so the block math is the only thing the ring carries.
+
+The hopped state must be ONE array for ``ppermute``: the padding mask
+rides as an extra activation channel (0/1 is exact in bf16; ``> 0.5``
+recovers the bool on every stage). Honest caveat: this family targets
+DENSE layer stacks — MoE checkpoints route through the expert-parallel
+family instead, and ``moe_aux`` is reported as 0 here.
+
+PR-10 contract: both builders are lru_cache-memoized; ``_stage_fn`` is a
+memoized factory so the stage callable is identity-stable and
+``_build_pipe_run``'s own cache (keyed on the function object) hits
+across batches.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline import pipeline_apply
+from .encoder import EncoderConfig, _block, _rmsnorm
+
+
+@lru_cache(maxsize=8)
+def _stage_fn(cfg: EncoderConfig):
+    """Identity-stable stage callable for ``_build_pipe_run``'s cache:
+    applies one stage's ``per_stage`` layers to a microbatch whose last
+    channel is the padding mask."""
+    D = cfg.d_model
+
+    def stage(local, state):
+        x = state[..., :D]
+        mask = state[..., D] > 0.5
+        per = jax.tree_util.tree_leaves(local)[0].shape[0]
+        for i in range(per):
+            p = jax.tree_util.tree_map(lambda a: a[i], local)
+            x, _aux = _block(x, p, cfg.n_heads, mask, cfg.attn_impl, cfg)
+        return jnp.concatenate([x, state[..., D:]], axis=-1)
+
+    return stage
+
+
+@lru_cache(maxsize=8)
+def _build_pp_serve(cfg: EncoderConfig, mesh: Mesh, plan_axes: tuple,
+                    microbatches: int):
+    """Jitted pipeline serving forward, memoized per (cfg, mesh, pp axis,
+    microbatch count). Mirrors ``encoder.forward``'s embedding/pool/head
+    math exactly so the single-device oracle stays the parity reference;
+    only the block stack runs through the wavefront."""
+    pp_axis = plan_axes[0]
+    stage = _stage_fn(cfg)
+
+    @partial(jax.jit, out_shardings=NamedSharding(mesh, P()))
+    def run(params, tokens):
+        dt = cfg.dtype
+        mask = tokens > 0
+        x = (params["embed"]["tok"].astype(dt)[tokens]
+             + params["embed"]["pos"].astype(dt)[None, :, :])
+        state = jnp.concatenate([x, mask.astype(dt)[..., None]], axis=-1)
+        state = pipeline_apply(params["blocks"], state, stage, mesh,
+                               n_microbatches=microbatches, pp_axis=pp_axis)
+        x = _rmsnorm(state[..., :cfg.d_model],
+                     params["final_norm"]["scale"])
+        denom = jnp.maximum(mask.sum(axis=1, keepdims=True),
+                            1).astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / denom
+        heads = params["heads"]
+        emb = pooled @ heads["embed_proj"]
+        return {
+            "severity": pooled @ heads["severity"],
+            "keep": pooled @ heads["keep"],
+            "mood": pooled @ heads["mood"],
+            "embedding": emb / (jnp.linalg.norm(emb, axis=-1,
+                                                keepdims=True) + 1e-6),
+            "moe_aux": jnp.zeros((), jnp.float32),
+        }
+
+    return run
+
+
+def pp_serve_forward(params, tokens, cfg: EncoderConfig, mesh: Mesh, plan):
+    """Serve-path entry: GPipe wavefront forward per the resolved plan.
+    ``params["blocks"]`` must already be the stacked stage tree
+    (``prepare_params`` does this inside ``sharded_params`` /
+    ``restore_checkpoint``); the batch is already floored at
+    ``plan.microbatches`` by ``serve_bucket``, making B % M structural."""
+    return _build_pp_serve(cfg, mesh, tuple(plan.axes),
+                           int(plan.microbatches))(params, tokens)
+
+
+def clear_pp_caches() -> None:
+    """Drop the memoized pipeline builders (tests / plan-table rewrite)."""
+    _build_pp_serve.cache_clear()
+    _stage_fn.cache_clear()

@@ -57,6 +57,18 @@ SERVE_DEFAULTS = {
     "meshServing": False,
     "meshShape": None,
     "meshAxes": ["dp", "tp"],
+    # Big-model families (ISSUE 18): which serving plan family the batcher
+    # resolves — the default is the tensor-parallel validator family;
+    # deployments opt into "encoder_validator_pp" (GPipe over a pp mesh),
+    # "encoder_validator_long" (ring attention over dp×sp), or
+    # "encoder_validator_moe" (expert-parallel over dp×ep). meshAxes must
+    # name the axes the family's plan shards over.
+    "planFamily": "encoder_validator",
+    # Length-threshold policy for the "long" runner: rows whose token
+    # occupancy reaches thresholdTokens route to the ring-attention
+    # program; shorter rows take the dense short-path twin (same placed
+    # weights). Irrelevant (and harmless) for other families.
+    "longContext": {"thresholdTokens": 1024},
     # Searched placement (ISSUE 16): resolve the serving plan through the
     # checked-in parallel/plan_table.json (regression-gated winners from
     # `bench.py plan_search`), hand-written rules as the fallback. `false`
@@ -105,7 +117,10 @@ def _mesh_key(serve_cfg: dict):
     shape = serve_cfg.get("meshShape")
     return (tuple(int(s) for s in shape) if shape is not None else "auto",
             tuple(serve_cfg.get("meshAxes") or ("dp", "tp")),
-            bool(serve_cfg.get("searchedPlans", True)))
+            bool(serve_cfg.get("searchedPlans", True)),
+            str(serve_cfg.get("planFamily", "encoder_validator")),
+            int((serve_cfg.get("longContext") or {})
+                .get("thresholdTokens", 1024)))
 
 
 def _resolve_mesh(serve_cfg: dict):
@@ -159,7 +174,10 @@ def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict,
                 admission=AdmissionController.from_config(
                     serve_cfg.get("admission")),
                 mesh=_resolve_mesh(serve_cfg),
-                searched_plans=serve_cfg.get("searchedPlans", True))
+                plan_family=serve_cfg.get("planFamily", "encoder_validator"),
+                searched_plans=serve_cfg.get("searchedPlans", True),
+                long_threshold=(serve_cfg.get("longContext") or {})
+                .get("thresholdTokens", 1024))
             _batchers[key] = batcher
         return batcher
 

@@ -48,6 +48,7 @@ workflow: docs/serving-perf.md, artifact lint: docs/static-analysis.md.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import time
 from dataclasses import dataclass
@@ -108,7 +109,17 @@ class CommSketch:
     without sharded compute, and is rejected before compilation.
     ``loose_sites`` may each contribute at most one gather-class
     collective, capped by ``max_loose_collectives``; every site the
-    sketch does not name must stay replicated."""
+    sketch does not name must stay replicated.
+
+    ISSUE 18 grammar extensions for the big-model families: ``sites``
+    are structural split points whose choice selects a rule table but
+    induces no pairwise collective of its own (the GPipe stage split,
+    the MoE expert placement); ``declared`` are the collectives the
+    family's RUNNER induces by construction at its sharded configuration
+    (the wavefront ppermute, the sequence-pool psum, the expert-combine
+    psum) — they are the family's symbolic signature, appear in every
+    candidate's collectives list, and land in the plan-table entry where
+    GL-SHARD-RULE lints their kinds against ``plan.COLLECTIVE_KINDS``."""
 
     family: str
     pairs: tuple = ()
@@ -116,6 +127,8 @@ class CommSketch:
     loose_sites: tuple = ()
     loose_allowed: tuple = ("rep",)
     max_loose_collectives: int = 0
+    sites: tuple = ()      # (site, allowed_choices) structural splits
+    declared: tuple = ()   # (kind, site) runner-induced collectives
 
 
 SKETCHES = {
@@ -130,6 +143,26 @@ SKETCHES = {
     # zero weight collectives — a sharded-weights candidate is enumerated
     # (the sketch must DO something) and always rejected here.
     "embeddings_forward": CommSketch(family="embeddings_forward"),
+    # Big-model families (ISSUE 18). The pp/long grammars have exactly one
+    # legal structural configuration — the sketch's job there is declaring
+    # the runner's collective signature, which rides into the plan-table
+    # entry and the GL-SHARD-RULE artifact lint.
+    "encoder_validator_pp": CommSketch(
+        family="encoder_validator_pp",
+        sites=(("stages", ("pp",)),),
+        declared=(("ppermute", "wavefront"),)),
+    "encoder_validator_long": CommSketch(
+        family="encoder_validator_long",
+        sites=(("weights", ("rep",)),),
+        declared=(("psum", "pool"),)),
+    "encoder_validator_moe": CommSketch(
+        family="encoder_validator_moe",
+        sites=(("experts", ("ep", "rep")),),
+        declared=(("psum", "expert_combine"),)),
+    "embeddings_forward_moe": CommSketch(
+        family="embeddings_forward_moe",
+        sites=(("experts", ("ep", "rep")),),
+        declared=(("psum", "expert_combine"),)),
 }
 
 
@@ -143,12 +176,20 @@ def sketch_check(family: str, assignment: tuple,
     a = dict(assignment)
     covered = {s for pair in sketch.pairs for s in pair}
     covered |= set(sketch.loose_sites)
+    covered |= {s for s, _allowed in sketch.sites}
     for site, choice in assignment:
         if site not in covered and choice != "rep":
             return (False, f"{site}={choice}: site outside the sketch's "
                            f"declared collective pattern must stay "
                            f"replicated", [])
-    colls: list = []
+    for site, allowed in sketch.sites:
+        choice = a.get(site, allowed[0])
+        if choice not in allowed:
+            return (False, f"{site}={choice} not in the sketch's allowed "
+                           f"structural choices {allowed}", [])
+    # Runner-induced collectives ride in every legal candidate — the
+    # family's symbolic signature, not a per-candidate trace.
+    colls: list = list(sketch.declared)
     for prod_site, cons_site in sketch.pairs:
         pat = (a.get(prod_site, "rep"), a.get(cons_site, "rep"))
         if pat not in sketch.allowed_pairs:
@@ -181,6 +222,7 @@ class PlanCandidate:
     family: str
     plan: ShardingPlan
     assignment: tuple = ()
+    collectives: tuple = ()  # the sketch's symbolic (kind, site) signature
 
 
 def _cand_id(assignment: tuple, bucket_min: int, gather: str) -> str:
@@ -194,6 +236,14 @@ def _assignments(family: str, mesh_shape: tuple) -> list:
     can report how much of the space the sketch pruned)."""
     if family == "embeddings_forward":
         return [(("weights", "rep"),), (("weights", "split"),)]
+    if family in ("encoder_validator_moe", "embeddings_forward_moe"):
+        # expert placement: sharded over ep (the point of the family) or
+        # replicated (the sketch must have something to reject/compare).
+        return [(("experts", "ep"),), (("experts", "rep"),)]
+    if family == "encoder_validator_pp":
+        return [(("stages", "pp"),)]
+    if family == "encoder_validator_long":
+        return [(("weights", "rep"),)]
     tp = int(mesh_shape[1]) if len(mesh_shape) > 1 else 1
     if tp <= 1:
         # degenerate model axis: every split collapses to replication —
@@ -208,6 +258,29 @@ def _assignments(family: str, mesh_shape: tuple) -> list:
 def _candidate_plan(family: str, assignment: tuple, bucket_min: int,
                     gather: str) -> ShardingPlan:
     a = dict(assignment)
+    if family in ("encoder_validator_moe", "embeddings_forward_moe"):
+        base = PLAN_TABLE[family]
+        return dataclasses.replace(
+            base,
+            rules=base.rules if a.get("experts", "ep") == "ep"
+            else (("", P()),),
+            bucket_min=int(bucket_min), gather=gather,
+            description="plan-search candidate "
+                        + _cand_id(assignment, bucket_min, gather),
+            source="candidate")
+    if family in ("encoder_validator_pp", "encoder_validator_long"):
+        # one structural configuration each — the sweep explores the
+        # schedule/bucket knobs (a pipeline's microbatch count IS its
+        # bucket floor, keeping B % M structural through serve_bucket).
+        base = PLAN_TABLE[family]
+        return dataclasses.replace(
+            base, bucket_min=int(bucket_min),
+            microbatches=int(bucket_min) if base.runner == "pipeline"
+            else base.microbatches,
+            gather=gather,
+            description="plan-search candidate "
+                        + _cand_id(assignment, bucket_min, gather),
+            source="candidate")
     if family == "embeddings_forward":
         spec = P() if a.get("weights", "rep") == "rep" else P("dp", None)
         rules: tuple = (("", spec),)
@@ -234,23 +307,31 @@ def enumerate_candidates(family: str, mesh_shape: tuple,
     bucket/gather variants — they are rejected once, compile-free, and
     returned as ``{"assignment", "reason"}`` records."""
     base = PLAN_TABLE[family]
-    cands = [PlanCandidate("incumbent", family, base)]
+    cands = [PlanCandidate("incumbent", family, base,
+                           collectives=tuple(
+                               SKETCHES[family].declared
+                               if family in SKETCHES else ()))]
     rejected: list = []
+    # Non-"forward" runners own their gather by construction (the GPipe
+    # psum replicates, the long path's host assembly is the sharded
+    # gather) — sweeping the other mode would measure a program that
+    # never serves.
+    gathers = GATHER_MODES if base.runner == "forward" else (base.gather,)
     for assignment in _assignments(family, mesh_shape):
-        legal, reason, _colls = sketch_check(family, assignment, mesh_shape)
+        legal, reason, colls = sketch_check(family, assignment, mesh_shape)
         if not legal:
             rejected.append({"assignment": dict(assignment),
                              "reason": reason})
             continue
         for bm in bucket_mins:
-            for gather in GATHER_MODES:
+            for gather in gathers:
                 plan = _candidate_plan(family, assignment, bm, gather)
                 if plan.rules == base.rules and bm == base.bucket_min \
                         and gather == base.gather:
                     continue  # identical to the incumbent baseline
                 cands.append(PlanCandidate(
                     _cand_id(assignment, bm, gather), family, plan,
-                    tuple(assignment)))
+                    tuple(assignment), tuple(colls)))
     return cands, rejected
 
 
@@ -303,8 +384,28 @@ def _seeded_queries(n: int, seed: int) -> list:
 # ── one measured candidate ───────────────────────────────────────────
 
 
+def _probe_runner_builder(plan: ShardingPlan, cfg, mesh):
+    """The compiled artifact the RetraceWitness watches for one plan —
+    the runner's OWN memoized builder, not always _build_serve_forward
+    (a pipeline plan that retraced its wavefront would otherwise read
+    clean)."""
+    from . import plan as sharding_plan
+
+    if plan.runner == "pipeline":
+        from ..models.pipeline_serve import _build_pp_serve
+
+        return _build_pp_serve(cfg, mesh, tuple(plan.axes),
+                               int(plan.microbatches))
+    if plan.runner == "long":
+        from ..models.long_context import _build_run
+
+        return _build_run(cfg, mesh, plan.axes[0], plan.axes[1])
+    return sharding_plan._build_serve_forward(cfg, mesh, plan)
+
+
 def _measure_validator(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
-                       fx: dict, clock) -> dict:
+                       fx: dict, clock, family: str = "encoder_validator",
+                       ) -> dict:
     import threading
 
     from ..analysis import RetraceWitness
@@ -316,33 +417,41 @@ def _measure_validator(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
     from .mesh import cached_mesh
 
     texts, ref = fx["texts"], fx["ref"]
-    mesh = cached_mesh(tuple(mesh_shape))
+    mesh = cached_mesh(tuple(mesh_shape), tuple(plan.axes))
     loaded = load_pretrained(None)
     if loaded is None:
         raise RuntimeError("plan_search: no shipped checkpoint")
     cfg = loaded[0]
     n = len(texts)
-    with sharding_plan.plan_override("encoder_validator", plan):
+    with sharding_plan.plan_override(family, plan):
         batcher = ContinuousBatcher(max_batch=int(scfg.get("maxBatch")),
                                     window_ms=float(scfg.get("windowMs")),
-                                    mesh=mesh)
+                                    mesh=mesh, plan_family=family)
         try:
             # Warm every bucket this run can form under THIS plan (its
             # bucket_min moves the floor) so the timed phase is
             # compile-free by construction — the mesh_serve discipline.
-            placed = sharding_plan.sharded_params(
-                "plan-search", loaded[1], mesh, plan)
-            buckets = sorted({sharding_plan.serve_bucket(b, mesh, plan=plan)
-                              for b in range(1, batcher.max_batch + 1)})
-            for b in buckets:
-                toks = pad_rows(encode_texts(["warmup"], cfg.seq_len,
-                                             cfg.vocab_size), b)
-                np.asarray(sharding_plan.serve_forward(
-                    placed, sharding_plan.place_tokens(toks, mesh, plan),
-                    cfg, mesh, plan)["severity"])
+            # A "long" plan serves through TWO programs (the ring path
+            # and its dense short twin) — warm both.
+            warm_plans = [plan]
+            if plan.runner == "long":
+                warm_plans.append(sharding_plan.short_path_plan(plan))
+            for wp in warm_plans:
+                placed = sharding_plan.sharded_params(
+                    "plan-search", loaded[1], mesh, wp)
+                buckets = sorted({
+                    sharding_plan.serve_bucket(b, mesh, plan=wp)
+                    for b in range(1, batcher.max_batch + 1)})
+                for b in buckets:
+                    toks = pad_rows(encode_texts(["warmup"], cfg.seq_len,
+                                                 cfg.vocab_size), b)
+                    np.asarray(sharding_plan.serve_forward(
+                        placed, sharding_plan.place_tokens(toks, mesh, wp),
+                        cfg, mesh, wp)["severity"])
             witness = RetraceWitness()
-            witness.probe("plan_search_forward",
-                          sharding_plan._build_serve_forward(cfg, mesh, plan))
+            for i, wp in enumerate(warm_plans):
+                witness.probe(f"plan_search_forward{i or ''}",
+                              _probe_runner_builder(wp, cfg, mesh))
             base = witness.baseline()
 
             results: list = [None] * n
@@ -378,8 +487,10 @@ def _measure_validator(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
             return {
                 "rps": round(n / dt, 2),
                 "mismatches": sum(1 for a, b in zip(results, ref) if a != b),
-                "retraces": int(witness.traces("plan_search_forward")
-                                - base.get("plan_search_forward", 0)),
+                "retraces": sum(
+                    int(witness.traces(f"plan_search_forward{i or ''}")
+                        - base.get(f"plan_search_forward{i or ''}", 0))
+                    for i in range(len(warm_plans))),
                 "mean_batch": batcher.stats()["meanBatch"],
                 "shard_ms_p95": (q.get("shard") or {}).get("p95"),
                 "gather_ms_p95": (q.get("gather") or {}).get("p95"),
@@ -389,7 +500,8 @@ def _measure_validator(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
 
 
 def _measure_embeddings(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
-                        fx: dict, clock) -> dict:
+                        fx: dict, clock,
+                        family: str = "embeddings_forward") -> dict:
     from ..analysis import RetraceWitness
     from ..knowledge.embeddings import create_embeddings
     from . import plan as sharding_plan
@@ -397,10 +509,13 @@ def _measure_embeddings(plan: ShardingPlan, mesh_shape: tuple, scfg: dict,
 
     facts, queries, ref = fx["facts"], fx["queries"], fx["ref_search"]
     n = int(np.prod(mesh_shape))
-    mesh = cached_mesh((n,), ("dp",))
-    with sharding_plan.plan_override("embeddings_forward", plan):
+    axes = tuple(plan.axes)
+    mesh = cached_mesh((n,) if len(axes) == 1 else tuple(mesh_shape), axes)
+    with sharding_plan.plan_override(family, plan):
         emb = create_embeddings(
-            {"backend": "local", "meshServing": True, "meshShape": [n]},
+            {"backend": "local", "meshServing": True,
+             "meshShape": [n] if len(axes) == 1 else list(mesh_shape),
+             "meshAxes": list(axes), "planFamily": family},
             _NullLog())
         t0 = clock()
         emb.sync(facts)  # untimed: model init + embed compiles + placement
@@ -458,12 +573,12 @@ def measure_candidate(family: str, plan: ShardingPlan, mesh_shape: tuple,
     rec: dict = {"family": family, "mesh_shape": list(mesh_shape)}
     t0 = clock()
     try:
-        if family == "embeddings_forward":
+        if family.startswith("embeddings_forward"):
             rec.update(_measure_embeddings(plan, mesh_shape, scfg,
-                                           fixtures, clock))
+                                           fixtures, clock, family=family))
         else:
             rec.update(_measure_validator(plan, mesh_shape, scfg,
-                                          fixtures, clock))
+                                          fixtures, clock, family=family))
     except Exception as exc:  # noqa: BLE001 — a rejected candidate is data
         rec["error"] = str(exc)[:200]
     rec["elapsed_s"] = round(clock() - t0, 2)
@@ -511,7 +626,7 @@ def search(settings: "dict | None" = None, *,
     # Seeded fixtures + single-device oracle references, computed ONCE —
     # every candidate on every shape is pinned against the same oracle.
     fixtures: dict = {}
-    if "encoder_validator" in families:
+    if any(f.startswith("encoder_validator") for f in families):
         from ..models.serve import make_local_call_llm
 
         texts = _seeded_texts(int(scfg.get("requests")), seed)
@@ -519,7 +634,7 @@ def search(settings: "dict | None" = None, *,
             serve_cfg={"continuousBatching": False}, force=True)
         fixtures["texts"] = texts
         fixtures["ref"] = [oneshot(t) for t in texts]
-    if "embeddings_forward" in families:
+    if any(f.startswith("embeddings_forward") for f in families):
         from ..knowledge.embeddings import create_embeddings
 
         facts = _synth_facts(int(scfg.get("facts")), seed)
@@ -534,8 +649,11 @@ def search(settings: "dict | None" = None, *,
     for family in families:
         seen: set = set()
         for shape in shapes:
-            # embeddings meshes are 1-D over dp: a (2, 4) serve shape
-            # collapses to (8,), and duplicate counts sweep once.
+            # dp-only embeddings meshes are 1-D: a (2, 4) serve shape
+            # collapses to (8,), and duplicate counts sweep once. The
+            # multi-axis families (moe's dp×ep, long's dp×sp, pp's 1-D
+            # stage mesh) take the shape as given — its rank must match
+            # the family plan's axes or cached_mesh raises loudly.
             mesh_shape = (int(np.prod(shape)),) \
                 if family == "embeddings_forward" else shape
             if mesh_shape in seen:
@@ -603,16 +721,18 @@ def search(settings: "dict | None" = None, *,
                    "skipped_candidates": skipped,
                    "partial": bool(skipped)}
             if improved:
-                res["entry"] = entry_from_plan(best_cand.plan, best,
-                                               baseline, seed)
+                res["entry"] = entry_from_plan(
+                    best_cand.plan, best, baseline, seed,
+                    collectives=best_cand.collectives)
             sweeps[key] = res
 
-    # Best dp×tp factorization per device count (encoder only — the
-    # embeddings mesh is dp-only, one shape per count): the nN entries
+    # Best dp×tp factorization per device count (the base encoder family
+    # only — the embeddings mesh is dp-only, and the big-model families'
+    # axes are structural, not a factorization choice): the nN entries
     # serve.meshShape:null consults.
     factorizations: dict = {}
     for family in families:
-        if family == "embeddings_forward":
+        if family != "encoder_validator":
             continue
         by_n: dict = {}
         for res in sweeps.values():
@@ -642,11 +762,14 @@ def search(settings: "dict | None" = None, *,
 
 
 def entry_from_plan(plan: ShardingPlan, rec: dict, baseline: dict,
-                    seed: int) -> dict:
+                    seed: int, collectives: tuple = ()) -> dict:
     """The plan-table-v1 JSON entry for one winning candidate — the
     serialization twin of ``plan._plan_from_entry`` (round-trip pinned in
-    tests/test_plan_search.py)."""
-    return {
+    tests/test_plan_search.py). Non-default runner fields and the
+    sketch's declared collective signature (ISSUE 18) ride as optional
+    keys, linted by ``plan_entry_problems`` and GL-SHARD-RULE's artifact
+    pass."""
+    entry = {
         "rules": [[pat, spec_to_json(spec)] for pat, spec in plan.rules],
         "data_spec": spec_to_json(plan.data_spec),
         "axes": list(plan.axes),
@@ -658,6 +781,13 @@ def entry_from_plan(plan: ShardingPlan, rec: dict, baseline: dict,
         "source": f"plan_search seed={seed} "
                   f"gate=faster+parity+zero-retraces",
     }
+    if plan.runner != "forward":
+        entry["runner"] = plan.runner
+    if plan.microbatches:
+        entry["microbatches"] = int(plan.microbatches)
+    if collectives:
+        entry["collectives"] = [[kind, site] for kind, site in collectives]
+    return entry
 
 
 def to_table(results: dict, base_table: "dict | None" = None) -> dict:
