@@ -974,7 +974,9 @@ def _cluster_cli(argv: list) -> dict:
 def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
                        seed: int = 0, workers: int = 3,
                        max_resident: int = 48, handoff_every: int = 200,
-                       windows: int = 4, chaos: bool = True) -> dict:
+                       windows: int = 4, chaos: bool = True,
+                       adversarial: bool = False,
+                       adversarial_packs=None) -> dict:
     """100k-workspace soak (ISSUE 12): seeded zipf tenant draws over an
     ``id_space``-sized workspace id space pushed through a real in-process
     cluster while THREE churn sources interleave — chaos storms (seeded
@@ -984,6 +986,14 @@ def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
     four soak gates: heap growth across windows (tracemalloc), disk/cold
     growth across windows, per-window p99 drift, and verdict losses —
     the slow-marked CI test asserts the bounds; this function measures.
+
+    ``adversarial=True`` (ISSUE 19) interleaves the seeded hostile packs
+    from ``slo/adversarial.py`` with the chaos storms above: attack ops
+    ride the same supervisor submit path (tenant-skew traffic pinned to
+    one hot workspace), zombie-writer ops replay stale-epoch journal
+    commits against the REAL lease fences the supervisor granted, and the
+    record gains attack/zombie/victim-p99 fields the adversarial-soak CI
+    job asserts. The combined stream stays a pure function of the seed.
     """
     import gc
     import tempfile
@@ -998,9 +1008,15 @@ def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
     from vainplex_openclaw_tpu.slo.workload import generate_workload
     from vainplex_openclaw_tpu.storage.journal import reset_journals
 
+    if adversarial:
+        from vainplex_openclaw_tpu.slo.adversarial import (
+            generate_adversarial_workload)
+        base_ops = generate_adversarial_workload(
+            seed, n_ops, 4, packs=adversarial_packs)
+    else:
+        base_ops = generate_workload(seed, n_ops, 4)  # kinds/content schedule
     rng = np.random.default_rng(seed)
-    ranks = np.minimum(rng.zipf(1.3, size=n_ops), id_space)
-    base_ops = generate_workload(seed, n_ops, 4)  # kinds/content schedule
+    ranks = np.minimum(rng.zipf(1.3, size=len(base_ops)), id_space)
     results: dict[int, dict] = {}
     window_lat: list[list] = [[] for _ in range(windows)]
     win_edges = [((w + 1) * n_ops) // windows for w in range(windows)]
@@ -1053,15 +1069,40 @@ def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
         handoff_rr = 0
         handoffs_done = 0
         win = 0
+        attack_ops = 0
+        zombie_writes = zombie_rejected = 0
+        friendly_lat: list = []
+        attack_lat: list = []
         with installed(plan):
             for i, op in enumerate(base_ops):
-                tenant = int(ranks[i])
-                cop = {"i": op.index, "ws": str(root / f"t{tenant}"),
-                       "wsKey": f"t{tenant}", "kind": op.kind,
-                       "content": op.content}
-                t0 = time.perf_counter()
-                sup.submit(cop)
-                window_lat[win].append((time.perf_counter() - t0) * 1000.0)
+                pack = getattr(op, "pack", "")
+                if pack:
+                    attack_ops += 1
+                if op.kind == "zombie_write":
+                    # Stale-epoch writer against a REAL granted fence —
+                    # never submitted; it spends no cluster capacity.
+                    # (Falls through to the periodic tick/chaos/window
+                    # blocks: window accounting must not skip an edge.)
+                    verdict = _soak_zombie_write(sup, op, zombie_writes)
+                    if verdict is not None:
+                        zombie_writes += 1
+                        zombie_rejected += verdict
+                else:
+                    if pack == "tenant_skew":
+                        # The skew attacker hammers ONE hot workspace;
+                        # victims keep their zipf spread — the per-class
+                        # latencies below are the isolation measurement.
+                        tenant_key = "attacker"
+                    else:
+                        tenant_key = f"t{int(ranks[i])}"
+                    cop = {"i": op.index, "ws": str(root / tenant_key),
+                           "wsKey": tenant_key, "kind": op.kind,
+                           "content": op.content}
+                    t0 = time.perf_counter()
+                    sup.submit(cop)
+                    lat_ms = (time.perf_counter() - t0) * 1000.0
+                    (attack_lat if pack else friendly_lat).append(lat_ms)
+                    window_lat[win].append(lat_ms)
                 if i % 32 == 0:
                     sup.tick()
                     live = sup.workers()
@@ -1098,6 +1139,7 @@ def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
         reset_journals()
 
     ops_by_i = {op.index: op for op in base_ops}
+    submitted = sum(1 for op in base_ops if op.kind != "zombie_write")
     expected_denials = sum(1 for op in base_ops if op.kind == "tool_denied")
     observed_denials = sum(
         1 for i, obs in results.items()
@@ -1106,7 +1148,7 @@ def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
     observed_red = sum(
         1 for i, obs in results.items()
         if ops_by_i[i].kind == "tool_secret" and (obs or {}).get("redacted"))
-    losses = (n_ops - len(results)) \
+    losses = (submitted - len(results)) \
         + (expected_denials - observed_denials) + (expected_red - observed_red)
 
     def _p99(samples: list) -> float:
@@ -1164,13 +1206,72 @@ def bench_cluster_soak(n_ops: int = 2400, id_space: int = 100_000,
             for w in stats["workers"].values()
             if isinstance(w, dict)),
         "faults_fired": sum(plan.fired.values()),
+        "adversarial": bool(adversarial),
+        "adversarial_packs": (sorted({op.pack for op in base_ops if op.pack})
+                              if adversarial else []),
+        "attack_ops": attack_ops,
+        "zombie_writes": zombie_writes,
+        "zombie_rejected": zombie_rejected,
+        "zombie_leaked": zombie_writes - zombie_rejected,
+        "victim_p99_ms": _p99(friendly_lat),
+        "attack_p99_ms": _p99(attack_lat),
         "vs_baseline": None,
     }
 
 
+def _soak_zombie_write(sup, op, counter: int):
+    """One fence-thrash zombie op against the live soak cluster: a fresh
+    journal pins an epoch ``lag`` behind the fence the supervisor's
+    REAL :class:`LeaseTable` granted for a currently-leased workspace,
+    then tries to commit. Returns 1 (rejected end to end), 0 (any write
+    or count leaked through — the gate failure), or None when no leased
+    fence exists yet to attack (not an attempt). The zombie journals
+    live in their own subdirectory: the live owner's files are the
+    fence's to protect, not this probe's to touch."""
+    import json as _json
+    from pathlib import Path
+
+    from vainplex_openclaw_tpu.cluster.ring import FENCE_FILE, LeaseTable
+    from vainplex_openclaw_tpu.storage.journal import (FencedWriteError,
+                                                       Journal)
+
+    leased = sorted(sup.leases.snapshot())
+    if not leased:
+        return None
+    ws = Path(leased[counter % len(leased)])
+    fence = LeaseTable.read_fence(ws)
+    if not isinstance(fence, dict) or "epoch" not in fence:
+        return None
+    try:
+        payload = _json.loads(op.content)
+    except ValueError:
+        payload = {}
+    lag = max(1, int(payload.get("lag", 1)))
+    zdir = ws / "zombie-journal"
+    z = Journal(zdir, {"maxBatchRecords": 1_000_000, "windowMs": 0.0},
+                wall=False)
+    try:
+        z.register_snapshot("zombie:state", zdir / "state.json", indent=None)
+        z.set_fence(ws / FENCE_FILE, max(int(fence["epoch"]) - lag, 0))
+        z.append("zombie:state", {"owner": "zombie", "i": op.index})
+        ok = (z.commit() is False
+              and z.stats().get("fencedRecords", 0) >= 1)
+        try:
+            z.append("zombie:state", {"owner": "zombie", "again": True})
+            ok = False
+        except FencedWriteError:
+            pass
+        if z.compact() is not False:
+            ok = False
+    finally:
+        z.close()
+    return 1 if ok else 0
+
+
 def _soak_cli(argv: list) -> dict:
     """``python bench.py soak [--ops N] [--id-space N] [--seed N]
-    [--workers N] [--max-resident N] [--handoff-every N] [--no-chaos]``"""
+    [--workers N] [--max-resident N] [--handoff-every N] [--no-chaos]
+    [--adversarial] [--packs a,b,c]``"""
     kwargs: dict = {}
     flags = {"--ops": ("n_ops", int), "--id-space": ("id_space", int),
              "--seed": ("seed", int), "--workers": ("workers", int),
@@ -1182,6 +1283,17 @@ def _soak_cli(argv: list) -> dict:
         if arg == "--no-chaos":
             kwargs["chaos"] = False
             i += 1
+            continue
+        if arg == "--adversarial":
+            kwargs["adversarial"] = True
+            i += 1
+            continue
+        if arg == "--packs":
+            if i + 1 >= len(argv):
+                raise SystemExit("soak: --packs needs a comma list")
+            kwargs["adversarial_packs"] = tuple(
+                p for p in argv[i + 1].split(",") if p)
+            i += 2
             continue
         if arg not in flags or i + 1 >= len(argv):
             raise SystemExit(f"soak: bad or valueless arg {arg!r}")

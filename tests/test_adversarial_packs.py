@@ -1,0 +1,293 @@
+"""Adversarial workload packs (ISSUE 19): seeded hostile traffic on the
+SLO harness and soak rig, with per-tenant isolation gates.
+
+Fast tier-1 tests pin the contracts:
+
+- ``worst_case_inputs`` ⟺ the ReDoS screen (drift pin): generated attack
+  strings are non-empty exactly for the patterns the screen flags, so the
+  generator and the screen can never drift apart silently;
+- every SHIPPED pattern screens clean and gets linear stress probes;
+- pack generation is a pure function of seed — identical workload
+  digests (with per-pack composition) on reruns, divergent across seeds,
+  and the friendly digest byte-unchanged by the new ``pack`` field;
+- sim-mode adversarial reports are bit-identical across reruns;
+- every pack survives: zero verdict losses, zero false blocks, zombies
+  fenced with zero leaks, unicode megamessages clear the long-context
+  routing threshold, and the 100× tenant-skew attacker cannot move the
+  victim tenants' p99 past budget vs the deterministic no-attack control;
+- the sitrep slo collector renders the last run's ``adversarial`` line.
+
+Slow tests (the CI adversarial-soak job, ``CHAOS_SEED`` 0/1/2 matrix)
+drive the full pack set through the real cluster soak rig and run the
+wall-mode ReDoS stage gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+import bench
+from vainplex_openclaw_tpu.analysis.redos import (pattern_safe, stress_inputs,
+                                                  worst_case_inputs)
+from vainplex_openclaw_tpu.sitrep.collectors import collect_slo
+from vainplex_openclaw_tpu.slo import (generate_adversarial_workload,
+                                       generate_workload,
+                                       read_adversarial_state,
+                                       run_adversarial_report,
+                                       run_redos_stage_gate, workload_digest)
+from vainplex_openclaw_tpu.slo.adversarial import (ADVERSARIAL_DEFAULTS,
+                                                   DEMOTED_PATTERN_CORPUS,
+                                                   shipped_patterns,
+                                                   unicode_pressure)
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+ALL_PACKS = tuple(ADVERSARIAL_DEFAULTS["packs"])
+
+# Patterns the screen must NOT flag — worst_case_inputs must return
+# nothing for these (the iff direction the drift pin needs).
+SAFE_CORPUS = (
+    r"abc",
+    r"a+b",
+    r"^foo(bar)?$",
+    r"[a-z]{3}\d{2}",
+    r"(?:red|green|blue) light",
+)
+
+
+# ── satellite (a): worst_case_inputs ⟺ screen drift pin ──────────────
+
+class TestWorstCaseInputs:
+    def test_flagged_iff_nonempty(self):
+        """The load-bearing contract: attack strings exist exactly for the
+        patterns the screen flags. If redos.py's repeat-walk conditions
+        change without the generator following, this pins the drift."""
+        for pattern in DEMOTED_PATTERN_CORPUS + SAFE_CORPUS:
+            flagged = not pattern_safe(pattern)
+            inputs = worst_case_inputs(pattern)
+            assert bool(inputs) == flagged, (
+                f"{pattern!r}: screen flagged={flagged} but "
+                f"worst_case_inputs returned {len(inputs)} strings")
+
+    def test_demoted_corpus_is_flagged_with_pumps(self):
+        for pattern in DEMOTED_PATTERN_CORPUS:
+            inputs = worst_case_inputs(pattern, pump=32)
+            assert inputs, pattern
+            # Pumped payloads, not token probes: the unit repeats.
+            assert max(len(s) for s in inputs) >= 32, (pattern, inputs)
+
+    def test_shipped_patterns_all_screen_clean(self):
+        """GL-REDOS in miniature: nothing the repo ships on the hot match
+        path may be flagged — and therefore nothing shipped gets an
+        exponential attack string."""
+        pats = shipped_patterns()
+        assert len(pats) > 50, "shipped-pattern enumeration went dark"
+        for pattern, flags in pats:
+            assert pattern_safe(pattern, flags), pattern
+            assert worst_case_inputs(pattern, flags) == [], pattern
+
+    def test_stress_inputs_cover_shipped_patterns(self):
+        for pattern, flags in shipped_patterns():
+            probes = stress_inputs(pattern, flags, pump=16)
+            assert probes, f"no stress probes for shipped {pattern!r}"
+            assert all(isinstance(p, str) and p for p in probes), pattern
+
+
+# ── satellite (c): digest determinism + per-pack composition ─────────
+
+class TestWorkloadDigest:
+    def test_same_seed_same_digest(self):
+        a = workload_digest(generate_adversarial_workload(CHAOS_SEED, 400, 4))
+        b = workload_digest(generate_adversarial_workload(CHAOS_SEED, 400, 4))
+        assert a == b
+        assert a["byPack"] and set(a["byPack"]) == set(ALL_PACKS)
+        assert sum(a["byPack"].values()) == int(400 * 0.30)
+
+    def test_cross_seed_digests_diverge(self):
+        a = workload_digest(generate_adversarial_workload(CHAOS_SEED, 300, 4))
+        b = workload_digest(
+            generate_adversarial_workload(CHAOS_SEED + 1, 300, 4))
+        assert a["checksum"] != b["checksum"]
+
+    def test_friendly_digest_unchanged_by_pack_field(self):
+        """The Op.pack extension must not disturb pre-ISSUE-19 digests:
+        friendly ops serialize to the same tuple as before, so the
+        checksum of a pure generate_workload stream has no byPack block
+        and stays stable across reruns."""
+        digest = workload_digest(generate_workload(CHAOS_SEED, 300, 4))
+        assert "byPack" not in digest
+        assert digest == workload_digest(generate_workload(CHAOS_SEED, 300, 4))
+
+    def test_unknown_pack_rejected(self):
+        with pytest.raises(ValueError, match="unknown adversarial pack"):
+            generate_adversarial_workload(0, 100, 4, packs=("no_such_pack",))
+
+
+# ── tentpole: sim-mode bit-identity + per-pack survival gates ────────
+
+class TestAdversarialReport:
+    def test_sim_report_bit_identical(self):
+        a = run_adversarial_report(seed=CHAOS_SEED, n_ops=300, tenants=4,
+                                   mode="sim")
+        b = run_adversarial_report(seed=CHAOS_SEED, n_ops=300, tenants=4,
+                                   mode="sim")
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+        assert a["metric"] == "adversarial_slo_report"
+        assert a["adversarial"]["survived"] is True, a["adversarial"]
+
+    @pytest.mark.parametrize("pack", ALL_PACKS)
+    def test_each_pack_zero_losses_zero_false_blocks(self, pack):
+        report = run_adversarial_report(seed=CHAOS_SEED, n_ops=240,
+                                        tenants=4, packs=(pack,),
+                                        mode="sim", control=False)
+        adv = report["adversarial"]
+        assert adv["byPack"].get(pack, 0) > 0, adv
+        assert adv["verdictLosses"] == 0, adv
+        assert adv["falseBlocks"] == 0, adv
+        assert adv["survived"] is True, adv
+
+    def test_fence_thrash_rejects_every_zombie(self):
+        report = run_adversarial_report(seed=CHAOS_SEED, n_ops=260,
+                                        tenants=4, packs=("fence_thrash",),
+                                        mode="sim", control=False)
+        fence = report["adversarial"]["fence"]
+        assert fence["zombieWrites"] > 0, fence
+        assert fence["rejected"] == fence["zombieWrites"], fence
+        assert fence["leaked"] == 0, fence
+        assert fence["anomalies"] == [], fence
+        assert fence["zombieAppends"] >= fence["zombieWrites"], fence
+
+    def test_unicode_megamessages_clear_long_context_threshold(self):
+        report = run_adversarial_report(seed=CHAOS_SEED, n_ops=260,
+                                        tenants=4,
+                                        packs=("unicode_pathology",),
+                                        mode="sim", control=False)
+        uni = report["adversarial"]["unicode"]
+        assert uni["ops"] > 0, uni
+        assert uni["longRouteEligible"] >= 1, uni
+        mega_chars = ADVERSARIAL_DEFAULTS["megaMessageBytes"] // 4
+        assert uni["maxMessageChars"] >= mega_chars, uni
+
+    def test_tenant_skew_isolation_within_budget(self):
+        """The acceptance gate: 100× fair-share skew from tenant 0 in a
+        deterministic sim A/B vs the no-attack control — the victim
+        tenants' p99 factor stays inside victimP99FactorBudget."""
+        report = run_adversarial_report(seed=CHAOS_SEED, n_ops=420,
+                                        tenants=4, packs=("tenant_skew",),
+                                        mode="sim", control=True)
+        iso = report["adversarial"]["isolation"]
+        assert iso["attackTenant"] == 0
+        assert iso["victimP99Ms"] > 0, iso
+        assert iso["controlVictimP99Ms"] > 0, iso
+        assert iso["withinBudget"] is True, iso
+        assert iso["factor"] <= iso["budgetFactor"], iso
+        # Per-tenant quantiles (satellite b) are what the gate reads.
+        assert set(report["e2e"]["byTenant"]) == {f"tenant{t}"
+                                                  for t in range(4)}
+
+    def test_by_tenant_quantiles_in_friendly_report(self):
+        from vainplex_openclaw_tpu.slo import run_slo_report
+        report = run_slo_report(seed=CHAOS_SEED, n_ops=200, tenants=3,
+                                mode="sim")
+        by_tenant = report["e2e"]["byTenant"]
+        assert set(by_tenant) == {"tenant0", "tenant1", "tenant2"}
+        for q in by_tenant.values():
+            assert q["p50"] <= q["p99"], by_tenant
+
+
+# ── satellite (d): the sitrep `adversarial` line ─────────────────────
+
+class TestSitrepAdversarialLine:
+    def test_state_roundtrip_and_collector_line(self, tmp_path):
+        report = run_adversarial_report(seed=CHAOS_SEED, n_ops=260,
+                                        tenants=4, mode="sim",
+                                        workspace=tmp_path)
+        state = read_adversarial_state(tmp_path)
+        assert state is not None
+        assert state["survived"] is True
+        assert state["checksum"] == report["workload"]["checksum"]
+        assert state["attackOps"] == report["adversarial"]["attackOps"]
+
+        # The slo collector renders the line even without a live gateway
+        # (the skipped path) — the last attack verdict outlives the run.
+        result = collect_slo({}, {"workspace": str(tmp_path)})
+        assert result["status"] == "skipped"
+        adv = result["adversarial"]
+        assert adv["line"].startswith("adversarial: ")
+        assert "survived" in adv["line"]
+        assert str(report["adversarial"]["attackOps"]) in adv["line"]
+        assert result["summary"].endswith(adv["line"])
+
+    def test_failed_run_warns(self, tmp_path):
+        from vainplex_openclaw_tpu.slo import write_adversarial_state
+        doctored = {"seed": 7, "mode": "sim",
+                    "workload": {"checksum": "deadbeef"},
+                    "adversarial": {"packs": ["fence_thrash"],
+                                    "attackOps": 12, "survived": False,
+                                    "verdictLosses": 3, "falseBlocks": 1}}
+        write_adversarial_state(tmp_path, doctored)
+        result = collect_slo({}, {"workspace": str(tmp_path)})
+        assert result["status"] == "warn"
+        assert "FAILED" in result["adversarial"]["line"]
+        assert "3 verdict losses" in result["adversarial"]["line"]
+
+    def test_no_state_no_line(self, tmp_path):
+        result = collect_slo({}, {"workspace": str(tmp_path)})
+        assert "adversarial" not in result
+
+
+# ── helpers stay honest ──────────────────────────────────────────────
+
+def test_unicode_pressure_counts_only_pack_ops():
+    ops = generate_adversarial_workload(CHAOS_SEED, 300, 4,
+                                        packs=("unicode_pathology",
+                                               "tenant_skew"))
+    stats = unicode_pressure(ops, threshold_tokens=1024)
+    tagged = sum(1 for op in ops
+                 if getattr(op, "pack", "") == "unicode_pathology")
+    assert stats["ops"] == tagged
+    assert stats["thresholdTokens"] == 1024
+
+
+# ── slow: the CI adversarial-soak job (CHAOS_SEED 0/1/2 matrix) ──────
+
+@pytest.mark.slow
+def test_adversarial_soak_full_pack_set():
+    """Every pack through the real cluster soak rig: chaos storms, a
+    worker kill with failover, handoffs and hibernation churn all stay
+    on — the hostile traffic rides the same machinery, and the gates are
+    the friendly soak's gates plus zero zombie leaks and a finite victim
+    p99."""
+    rec = bench.bench_cluster_soak(n_ops=900, id_space=50_000,
+                                   seed=CHAOS_SEED, max_resident=32,
+                                   handoff_every=150, adversarial=True)
+    assert rec["metric"] == "cluster_soak", rec
+    assert rec["adversarial"] is True, rec
+    assert sorted(rec["adversarial_packs"]) == sorted(ALL_PACKS), rec
+    assert rec["attack_ops"] > 0, rec
+    assert rec["verdict_losses"] == 0, rec
+    assert rec["fenced_records"] == 0, rec
+    assert rec["zombie_writes"] > 0, rec
+    assert rec["zombie_rejected"] == rec["zombie_writes"], rec
+    assert rec["zombie_leaked"] == 0, rec
+    assert rec["victim_p99_ms"] > 0, rec
+    assert rec["attack_p99_ms"] > 0, rec
+    assert rec["failovers"] >= 1, rec
+    json.loads(json.dumps(rec, ensure_ascii=False))
+
+
+@pytest.mark.slow
+def test_redos_stage_gate_wall_mode():
+    """The ReDoS acceptance pin: wall-clock A/B on the pattern-match
+    stages (governance:evaluate + cortex extract/mood). Sim mode cannot
+    see a regex blowup — only a real clock can — so this is the one gate
+    that pays for wall mode in CI."""
+    gate = run_redos_stage_gate(seed=CHAOS_SEED, n_ops=420, tenants=4)
+    assert gate["metric"] == "redos_stage_gate"
+    assert gate["stormVerdictLosses"] == 0, gate
+    assert gate["stormFalseBlocks"] == 0, gate
+    assert gate["baselineP99Ms"]["governance:evaluate"] > 0, gate
+    assert gate["withinBudget"] is True, gate

@@ -70,6 +70,9 @@ CONFIG_SITES: tuple = (
     ("vainplex_openclaw_tpu/cluster/fleet.py",
      ("FLEET_DEFAULTS",), ("cfg", "self.cfg"),
      None),
+    ("vainplex_openclaw_tpu/slo/adversarial.py",
+     ("ADVERSARIAL_DEFAULTS",), ("cfg",),
+     None),
 )
 
 

@@ -448,22 +448,68 @@ def collect_lifecycle(config: dict, ctx: dict) -> dict:
             "summary": summary}
 
 
+def _adversarial_line(config: dict, ctx: dict):
+    """Last adversarial-pack run (ISSUE 19), from the state file the
+    adversarial runner drops in the workspace. Returns ``(info, warn)`` —
+    ``info`` is None when no run has been recorded. Any verdict loss,
+    false block, or busted isolation budget warns: an attack the rig did
+    not survive is a standing condition until rerun clean."""
+    ws = ctx.get("workspace")
+    if not ws:
+        return None, False
+    from ..slo.adversarial import read_adversarial_state
+    state = read_adversarial_state(ws, config.get("adversarial"))
+    if state is None:
+        return None, False
+    packs = ",".join(state.get("packs") or []) or "none"
+    survived = bool(state.get("survived"))
+    line = (f"adversarial: {packs} (seed {state.get('seed')}) — "
+            f"{state.get('attackOps', 0)} attack ops, "
+            + ("survived" if survived else "FAILED"))
+    if state.get("verdictLosses", 0) or state.get("falseBlocks", 0):
+        line += (f", {state.get('verdictLosses', 0)} verdict losses, "
+                 f"{state.get('falseBlocks', 0)} false blocks")
+    if state.get("victimP99Ms") is not None:
+        line += (f", victim p99 {state['victimP99Ms']}ms = "
+                 f"{state.get('victimP99Factor')}x vs "
+                 f"{state.get('victimBudgetFactor')}x budget")
+    info = dict(state)
+    info["line"] = line
+    return info, not survived
+
+
+def _with_adversarial(result: dict, config: dict, ctx: dict) -> dict:
+    adv, warn = _adversarial_line(config, ctx)
+    if adv is not None:
+        result["adversarial"] = adv
+        result["summary"] += f"; {adv['line']}"
+        if warn and result["status"] != "error":
+            result["status"] = "warn"
+    return result
+
+
 def collect_slo(config: dict, ctx: dict) -> dict:
     """SLO-threshold rollup: p99 budgets (ms) from config against live
     stage quantiles. Keys: ``"edge:stage"`` beats ``"edge"`` beats
     ``defaultP99Ms``. A breach warns; a breach past 2× its budget errors
-    (the rollup drives the report's headline health)."""
+    (the rollup drives the report's headline health). When the workspace
+    carries an adversarial-run state file (ISSUE 19) the result gains an
+    ``adversarial`` line — rendered even on the skipped paths, since the
+    last attack run's verdict doesn't need a live gateway to matter."""
     timers_fn = ctx.get("stage_timers")
     if timers_fn is None:
-        return {"status": "skipped", "items": [], "summary": "no gateway wired"}
+        return _with_adversarial(
+            {"status": "skipped", "items": [], "summary": "no gateway wired"},
+            config, ctx)
     thresholds = config.get("p99Ms") or {}
     default = config.get("defaultP99Ms")
     snaps = timers_fn()
     if not snaps:
         # Same condition, same verdict as collect_stage_quantiles: an
         # "ok" here would imply budgets were validated when none could be.
-        return {"status": "skipped", "items": [],
-                "summary": "no stage timers registered"}
+        return _with_adversarial(
+            {"status": "skipped", "items": [],
+             "summary": "no stage timers registered"}, config, ctx)
     checked = 0
     breaches = []
     hard = False
@@ -480,8 +526,10 @@ def collect_slo(config: dict, ctx: dict) -> dict:
                                  "p99Ms": p99, "budgetMs": budget})
                 hard = hard or p99 > 2 * budget
     status = "error" if hard else ("warn" if breaches else "ok")
-    return {"status": status, "items": breaches,
-            "summary": f"{checked} SLOs checked, {len(breaches)} breached"}
+    return _with_adversarial(
+        {"status": status, "items": breaches,
+         "summary": f"{checked} SLOs checked, {len(breaches)} breached"},
+        config, ctx)
 
 
 def collect_pattern_safety(config: dict, ctx: dict) -> dict:

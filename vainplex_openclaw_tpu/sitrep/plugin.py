@@ -232,6 +232,8 @@ class SitrepPlugin:
         for b in slo.get("items", [])[:10]:
             lines.append(f"    BREACH {b['edge']}/{b['stage']}: "
                          f"p99 {b['p99Ms']}ms > budget {b['budgetMs']}ms")
+        if slo.get("adversarial"):
+            lines.append(f"    {slo['adversarial'].get('line', 'adversarial: n/a')}")
         ps = results.get("pattern_safety", {})
         lines.append(f"  {icon.get(ps.get('status'), '•')} pattern_safety: "
                      f"{ps.get('summary', 'n/a')}")

@@ -6,12 +6,24 @@ saturation, and bit-reproducible simulated-time runs for CI gating.
 ISSUE 17 adds the replica-fleet plane: ``generate_fleet_workload`` produces
 rate-modulated (diurnal/burst) validator traffic in virtual seconds, and
 ``run_fleet_slo_report`` drives it through a real :class:`ReplicaFleet` in
-virtual time — the autoscaler's bit-reproducible A/B gate."""
+virtual time — the autoscaler's bit-reproducible A/B gate.
 
+ISSUE 19 adds the hostile plane: ``adversarial.py`` ships five seeded
+attack packs (ReDoS storms, credential stuffing, pathological unicode,
+fence-thrashing zombies, tenant skew) as ordinary ``Op`` streams, with
+``run_adversarial_report`` gating zero verdict losses and victim-tenant
+p99 isolation against a deterministic no-attack control."""
+
+from .adversarial import (ADVERSARIAL_DEFAULTS, generate_adversarial_workload,
+                          read_adversarial_state, run_adversarial_report,
+                          run_redos_stage_gate, write_adversarial_state)
 from .harness import (run_fleet_slo_report, run_slo_report, sim_severity,
                       slo_stage_records)
 from .workload import generate_fleet_workload, generate_workload, workload_digest
 
-__all__ = ["generate_fleet_workload", "generate_workload",
-           "run_fleet_slo_report", "run_slo_report", "sim_severity",
-           "slo_stage_records", "workload_digest"]
+__all__ = ["ADVERSARIAL_DEFAULTS", "generate_adversarial_workload",
+           "generate_fleet_workload", "generate_workload",
+           "read_adversarial_state", "run_adversarial_report",
+           "run_fleet_slo_report", "run_redos_stage_gate", "run_slo_report",
+           "sim_severity", "slo_stage_records", "workload_digest",
+           "write_adversarial_state"]

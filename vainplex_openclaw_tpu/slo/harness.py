@@ -122,7 +122,9 @@ def _normalize_edge(name: str, root: Path) -> str:
 def _calibrate(ops, tenants: int, watermark: int) -> float:
     """Closed-loop ops/s on a throwaway gateway — the capacity that
     ``saturation`` scales. Uses the workload's own head so the calibration
-    mix matches the offered mix."""
+    mix matches the offered mix. Zombie-writer ops (ISSUE 19) never reach
+    the gateway, so they are not part of its capacity either."""
+    ops = [op for op in ops if op.kind != "zombie_write"]
     sample = ops[:min(220, len(ops))]
     # Warmup shrinks with tiny workloads so the timed set is never empty
     # (a 40-op warmup on a 40-op run would report garbage capacity).
@@ -174,7 +176,27 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
                                    admission=admission)
     ops = generate_workload(seed, n_ops, tenants)
     digest = workload_digest(ops)
+    return _run_single_report(ops, digest, seed=seed, tenants=tenants,
+                              saturation=saturation, mode=mode,
+                              admission=admission, watermark=watermark)
 
+
+def _run_single_report(ops, digest, *, seed: int, tenants: int,
+                       saturation: float, mode: str, admission: bool,
+                       watermark: int, metric: str = "slo_report",
+                       zombie_factory=None) -> dict:
+    """The single-process engine behind :func:`run_slo_report`, factored
+    out (ISSUE 19) so the adversarial runner can offer a merged
+    friendly+attack op stream through the IDENTICAL loop. Two additions
+    ride along for every caller:
+
+    - per-tenant e2e quantiles (``e2e.byTenant`` — the tenant-skew
+      isolation gate's measurement, and a useful ``/ops`` block on its own);
+    - ``zombie_factory(root)`` — when set, ops of kind ``zombie_write``
+      are routed to the returned handler instead of the gateway (they
+      model a PARTITIONED writer attacking the fence, not edge traffic),
+      and its ``stats()`` land in the report as ``fence``.
+    """
     if mode == "wall":
         capacity = _calibrate(ops, tenants, watermark)
         rate = capacity * saturation
@@ -194,6 +216,7 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
     with tempfile.TemporaryDirectory() as tmp:
         root = Path(tmp)
         gw, sitrep = _build_gateway(root, tenants, clock, admission, watermark)
+        zombie = zombie_factory(root) if zombie_factory is not None else None
         ctxs = {t: _tenant_ctx(root, t) for t in range(tenants)}
         for t in range(tenants):
             gw.session_start(ctxs[t])
@@ -210,6 +233,12 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
                 while now < sched:  # open-loop: honor the arrival schedule
                     time.sleep(min(sched - now, 0.0005))
                     now = time.perf_counter()
+                if op.kind == "zombie_write":
+                    # Fence attack, not edge traffic: it spends no gateway
+                    # capacity and earns no latency sample.
+                    if zombie is not None:
+                        zombie.handle(op)
+                    continue
                 if adm is not None:
                     while arrived < len(ops) and t0 + arrivals[arrived] <= now:
                         arrived += 1
@@ -218,6 +247,7 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
                 lat_ms = (time.perf_counter() - sched) * 1000.0
                 e2e.add("e2e", lat_ms)
                 e2e.add(f"kind:{op.kind}", lat_ms)
+                e2e.add(f"tenant:tenant{op.tenant}", lat_ms)
                 observed_denials += _denied(obs, op)
                 observed_redactions += _redacted(obs)
                 false_blocks += _false_block(obs, op)
@@ -229,6 +259,13 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
             base_t = clock.t
             arrived = 0
             for i, op in enumerate(ops):
+                if op.kind == "zombie_write":
+                    # max(): the busy server may already sit past this
+                    # arrival — a sim clock must never run backward.
+                    clock.t = max(clock.t, base_t + arrivals[i])
+                    if zombie is not None:
+                        zombie.handle(op)
+                    continue
                 start = max(arrivals[i], server_free)
                 clock.t = base_t + start
                 if adm is not None:
@@ -245,6 +282,7 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
                 lat_ms = (done - arrivals[i]) * 1000.0
                 e2e.add("e2e", lat_ms)
                 e2e.add(f"kind:{op.kind}", lat_ms)
+                e2e.add(f"tenant:tenant{op.tenant}", lat_ms)
                 observed_denials += _denied(obs, op)
                 observed_redactions += _redacted(obs)
                 false_blocks += _false_block(obs, op)
@@ -287,7 +325,7 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
     e2e_q = e2e_snap["quantiles"]
 
     report = {
-        "metric": "slo_report",
+        "metric": metric,
         "seed": seed,
         "mode": mode,
         "saturation": saturation,
@@ -309,13 +347,18 @@ def run_slo_report(seed: int = 0, n_ops: int = 2000, tenants: int = 4,
                 **{k: v for k, v in e2e_q.get("e2e", {}).items()},
                 "byKind": {k.split(":", 1)[1]: q
                            for k, q in sorted(e2e_q.items())
-                           if k.startswith("kind:")}},
+                           if k.startswith("kind:")},
+                "byTenant": {k.split(":", 1)[1]: q
+                             for k, q in sorted(e2e_q.items())
+                             if k.startswith("tenant:")}},
         "stage_counts": stage_counts,
         "hook_stats": hook_stats,
         "sitrep": sitrep_line,
         "elapsed_s": round(elapsed, 3),
         "throughput_ops_s": round(len(ops) / max(elapsed, 1e-9), 1),
     }
+    if zombie is not None:
+        report["fence"] = zombie.stats()
     if mode == "wall":
         # Real per-stage quantiles only exist under a real clock.
         report["stages"] = {edge: snap["quantiles"]
@@ -389,6 +432,7 @@ def _run_cluster_report(seed: int, n_ops: int, tenants: int,
             lat_ms = (time.perf_counter() - sched) * 1000.0
             e2e.add("e2e", lat_ms)
             e2e.add(f"kind:{op.kind}", lat_ms)
+            e2e.add(f"tenant:tenant{op.tenant}", lat_ms)
             if i % 50 == 0:
                 sup.tick()
         sup.drain()
@@ -447,7 +491,10 @@ def _run_cluster_report(seed: int, n_ops: int, tenants: int,
                 **{k: v for k, v in e2e_q.get("e2e", {}).items()},
                 "byKind": {k.split(":", 1)[1]: q
                            for k, q in sorted(e2e_q.items())
-                           if k.startswith("kind:")}},
+                           if k.startswith("kind:")},
+                "byTenant": {k.split(":", 1)[1]: q
+                             for k, q in sorted(e2e_q.items())
+                             if k.startswith("tenant:")}},
         "stage_counts": {edge: snap["counts"]
                          for edge, snap in sorted(edge_snaps.items())},
         "stages": {edge: snap["quantiles"]

@@ -102,10 +102,15 @@ class Op:
     kind: str
     lang: str
     content: str
+    pack: str = ""          # adversarial pack tag (ISSUE 19); "" = friendly
 
     def to_tuple(self) -> tuple:
-        return (self.index, round(self.arrival, 6), self.tenant, self.kind,
+        # The pack tag rides the tuple ONLY when set: every friendly
+        # workload digest (and the CI checksums pinned against them)
+        # stays byte-for-byte what it was before ISSUE 19.
+        base = (self.index, round(self.arrival, 6), self.tenant, self.kind,
                 self.lang, self.content)
+        return base + (self.pack,) if self.pack else base
 
 
 def _pick_kind(r: float) -> str:
@@ -238,16 +243,24 @@ def workload_digest(ops: list) -> dict:
                       ensure_ascii=False, separators=(",", ":"))
     by_kind: dict[str, int] = {}
     by_tenant: dict[str, int] = {}
+    by_pack: dict[str, int] = {}
     langs: set[str] = set()
     for op in ops:
         by_kind[op.kind] = by_kind.get(op.kind, 0) + 1
         key = f"tenant{op.tenant}"
         by_tenant[key] = by_tenant.get(key, 0) + 1
         langs.add(op.lang)
-    return {
+        if getattr(op, "pack", ""):
+            by_pack[op.pack] = by_pack.get(op.pack, 0) + 1
+    digest = {
         "checksum": hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16],
         "ops": len(ops),
         "byKind": dict(sorted(by_kind.items())),
         "byTenant": dict(sorted(by_tenant.items())),
         "languages": sorted(langs),
     }
+    if by_pack:
+        # Adversarial runs only (ISSUE 19): friendly digests keep their
+        # exact historical shape, attack runs add the per-pack breakdown.
+        digest["byPack"] = dict(sorted(by_pack.items()))
+    return digest
