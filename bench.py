@@ -2836,6 +2836,248 @@ def _fleet_serve_cli(argv: list) -> dict:
     return bench_fleet_serve(**kwargs)
 
 
+def model_swap_stage_records(swap_stage_ms: "dict | None") -> list[dict]:
+    if not swap_stage_ms:
+        return []
+    return [{"metric": "model_swap_stage_ms", "stage": name, "unit": "ms",
+             **qs} for name, qs in swap_stage_ms.items()]
+
+
+def bench_model_swap(n_requests: int = 160, concurrency: int = 8,
+                     seed: int = 0, max_batch: int = 16,
+                     window_ms: float = 1.0, n_swaps: int = 6,
+                     paging_rounds: int = 4) -> dict:
+    """Model lifecycle perf (ISSUE 20): hot weight swap under live load,
+    canary/promotion A/B, and LRU weight paging vs cold restore.
+
+    Two same-architecture versions (random-init twin checkpoints) serve a
+    seeded validator mix through ONE ContinuousBatcher + ModelRegistry.
+    Phase 1 measures steady-state request e2e quantiles; phase 2 repeats
+    the load while ``n_swaps`` alternating ``swap_to`` calls run the
+    drain → place → resume protocol live — the acceptance is request p99
+    under swapping ≤ 2x steady p99 (``swap_p99_ratio``), with per-stage
+    swap walls (``swap_stage_ms``) and a RetraceWitness pin: the whole
+    measured phase, swaps included, compiles NOTHING (same (cfg, mesh,
+    family) key ⇒ same compiled variants — docs/model-lifecycle.md).
+    The paging leg forces ``maxResidentVersions: 1`` so alternating
+    checkouts evict/wake each version: wake p99 (device_put from the
+    cached host tree) must land well under a cold ``restore_checkpoint``
+    (disk npz + cast) of the same checkpoint."""
+    import os
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from vainplex_openclaw_tpu.analysis import RetraceWitness
+    from vainplex_openclaw_tpu.models import encode_texts
+    from vainplex_openclaw_tpu.models import encoder as encoder_mod
+    from vainplex_openclaw_tpu.models import forward
+    from vainplex_openclaw_tpu.models.batching import (ContinuousBatcher,
+                                                       render_verdict)
+    from vainplex_openclaw_tpu.models.checkpoint import restore_checkpoint
+    from vainplex_openclaw_tpu.models.encoder import EncoderConfig
+    from vainplex_openclaw_tpu.models.pretrained import load_pretrained
+    from vainplex_openclaw_tpu.models.registry import (ModelRegistry,
+                                                       clear_registries)
+    from vainplex_openclaw_tpu.ops.similarity import pad_rows, pow2_bucket
+    from vainplex_openclaw_tpu.resilience.admission import AdmissionController
+    from vainplex_openclaw_tpu.slo.workload import generate_serve_texts
+
+    cfg = EncoderConfig(vocab_size=512, seq_len=64, d_model=32, n_heads=2,
+                        n_layers=2, d_ff=64, attn_impl="dense")
+    tmp = tempfile.mkdtemp(prefix="bench-model-swap-")
+    dir_a = os.path.join(tmp, "v1")
+    dir_b = os.path.join(tmp, "v2")
+    write_serving_checkpoint(dir_a, cfg, seed=seed)
+    write_serving_checkpoint(dir_b, cfg, seed=seed + 1)
+
+    texts = generate_serve_texts(seed, n_requests)
+    reg = ModelRegistry({"enabled": True, "maxResidentVersions": 4,
+                         "shadowWindow": 64, "benchRounds": 1},
+                        name="bench-swap")
+    reg.register("v1", dir_a, activate=True)
+    reg.register("v2", dir_b)
+    batcher = ContinuousBatcher(
+        dir_a, max_batch=max_batch, window_ms=window_ms, registry=reg,
+        admission=AdmissionController.from_config(
+            {"highWatermark": max(64, n_requests)}))
+    try:
+        # Warm every pow2 bucket both phases can form — the compiled
+        # variants are version-independent (params are an argument), so one
+        # pass over the buckets covers v1 AND v2 by construction.
+        cfg_a, params_a, _ = reg.checkout("v1")
+        b = 1
+        while b <= pow2_bucket(max_batch):
+            toks = pad_rows(encode_texts(["warmup"], cfg_a.seq_len,
+                                         cfg_a.vocab_size), b)
+            np.asarray(forward(params_a, toks, cfg_a)["severity"])
+            b *= 2
+        batcher.submit(texts[0])
+        # Verdict-equivalence oracle (the plain one-shot forward on the
+        # same params) — computed BEFORE the witness baseline: its
+        # full-set pow2 bucket is larger than any batch bucket, and that
+        # compile belongs to the oracle, not the serving path.
+        toks = encode_texts(texts, cfg_a.seq_len, cfg_a.vocab_size)
+        out = forward(params_a, pad_rows(toks, pow2_bucket(len(texts))),
+                      cfg_a)
+        classes = np.asarray(out["severity"])[:len(texts)].argmax(axis=-1)
+        oracle = [render_verdict(int(c)) for c in classes]
+
+        witness = RetraceWitness()
+        witness.probe("serve_forward", encoder_mod.forward)
+        base = witness.baseline()
+
+        def run_phase(phase_texts: list) -> list:
+            lat: list = [0.0] * len(phase_texts)
+            errors: list = [None] * len(phase_texts)
+            results: list = [None] * len(phase_texts)
+            next_idx = {"i": 0}
+            idx_lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with idx_lock:
+                        i = next_idx["i"]
+                        if i >= len(phase_texts):
+                            return
+                        next_idx["i"] = i + 1
+                    t = time.perf_counter()
+                    try:
+                        results[i] = batcher.submit(phase_texts[i])
+                    except Exception as exc:  # noqa: BLE001 — surfaced below
+                        errors[i] = exc
+                    lat[i] = (time.perf_counter() - t) * 1e3
+            threads = [threading.Thread(target=worker)
+                       for _ in range(max(1, concurrency))]
+            for t in threads:
+                t.start()
+            return [threads, lat, errors, results]
+
+        def finish_phase(phase) -> tuple:
+            threads, lat, errors, results = phase
+            for t in threads:
+                t.join()
+            failed = [e for e in errors if e is not None]
+            if failed:
+                raise RuntimeError(
+                    f"model_swap: {len(failed)} submits raised") from failed[0]
+            return sorted(lat), results
+
+        def _q(lat: list, q: float) -> float:
+            return round(lat[min(len(lat) - 1, int(q * (len(lat) - 1)))], 3)
+
+        # Phase 1: steady state on v1, scored against the oracle verdicts.
+        steady_lat, steady_results = finish_phase(run_phase(texts))
+        mismatches = sum(1 for a, b2 in zip(steady_results, oracle)
+                         if a != b2)
+
+        # Phase 2: the same load with n_swaps alternating hot swaps
+        # running concurrently (v1 → v2 → v1 → …).
+        phase = run_phase(texts)
+        swap_results: list = []
+        for k in range(n_swaps):
+            time.sleep(0.01)
+            swap_results.append(
+                batcher.swap_to("v2" if k % 2 == 0 else "v1"))
+        swap_lat, _ = finish_phase(phase)
+        retraces = (witness.traces("serve_forward")
+                    - base.get("serve_forward", 0))
+
+        totals = sorted(s["totalMs"] for s in swap_results)
+        stage_ms = {}
+        for stage in ("drain", "place", "resume"):
+            vals = sorted(s["stages"][stage] for s in swap_results)
+            stage_ms[stage] = {"p50": _q(vals, 0.5), "p99": _q(vals, 0.99)}
+
+        # Canary A/B + the promotion gate (incumbent-as-oracle).
+        reg.set_canary("v2", 0.25)
+        canary_texts = generate_serve_texts(seed + 1, 40)
+        before = reg.stats()["versions"]["v2"]["served"]
+        canary_phase = run_phase(canary_texts)
+        finish_phase(canary_phase)
+        canary_served = reg.stats()["versions"]["v2"]["served"] - before
+        promotion = reg.promotion_report("v2", texts=canary_texts[:16])
+        reg.clear_canary()
+        active_version = batcher.stats().get("activeVersion")
+    finally:
+        batcher.close()
+
+    # Paging leg: maxResidentVersions=1 forces evict/wake on every
+    # alternation; cold restore of the same checkpoint is the comparator.
+    reg2 = ModelRegistry({"enabled": True, "maxResidentVersions": 1},
+                         name="bench-paging")
+    reg2.register("v1", dir_a, activate=True)
+    reg2.register("v2", dir_b)
+    for _ in range(max(1, paging_rounds)):
+        reg2.checkout("v1")
+        reg2.checkout("v2")
+    paging = reg2.stats()["paging"]
+    import jax
+    host_like = jax.tree_util.tree_map(np.asarray, params_a)
+    cold: list = []
+    for _ in range(max(1, paging_rounds)):
+        t0 = time.perf_counter()
+        restored = restore_checkpoint(dir_a, host_like)
+        placed = jax.device_put(restored)
+        jax.tree_util.tree_map(
+            lambda a: a.block_until_ready()
+            if hasattr(a, "block_until_ready") else a, placed)
+        cold.append((time.perf_counter() - t0) * 1e3)
+    cold.sort()
+    cold_p50 = round(cold[len(cold) // 2], 3)
+    clear_registries()
+
+    platform, kind, _ = _device_peak()
+    swap_load_p99 = _q(swap_lat, 0.99)
+    steady_p99 = _q(steady_lat, 0.99)
+    rec = {"metric": "model_swap", "value": swap_load_p99, "unit": "ms",
+           "n_requests": n_requests, "concurrency": concurrency,
+           "seed": seed, "max_batch": max_batch, "window_ms": window_ms,
+           "steady_p50": _q(steady_lat, 0.5), "steady_p99": steady_p99,
+           "swap_load_p50": _q(swap_lat, 0.5),
+           "swap_load_p99": swap_load_p99,
+           "swap_p99_ratio": round(swap_load_p99 / max(steady_p99, 1e-9), 2),
+           "swaps": len(swap_results),
+           "drained_during_swaps": sum(s["drained"] for s in swap_results),
+           "swap_total_ms_p50": _q(totals, 0.5),
+           "swap_total_ms_p99": _q(totals, 0.99),
+           "swap_stage_ms": stage_ms,
+           "retraces": int(retraces),
+           "verdict_mismatches": mismatches,
+           "canary_fraction": 0.25, "canary_served": canary_served,
+           "promotion": promotion,
+           "active_version": active_version,
+           "wake_p50_ms": paging["wakeP50Ms"],
+           "wake_p99_ms": paging["wakeP99Ms"],
+           "wakes": paging["wakes"], "evictions": paging["evictions"],
+           "cold_restore_p50_ms": cold_p50,
+           "wake_speedup": round(cold_p50 / max(paging["wakeP99Ms"] or 1e-9,
+                                                1e-9), 2),
+           "device": platform, "device_kind": kind}
+    return rec
+
+
+def _model_swap_cli(argv: list) -> dict:
+    """``python bench.py model_swap [--requests N] [--concurrency N]
+    [--seed N] [--max-batch N] [--window-ms X] [--swaps N]``"""
+    kwargs: dict = {}
+    flags = {"--requests": ("n_requests", int),
+             "--concurrency": ("concurrency", int), "--seed": ("seed", int),
+             "--max-batch": ("max_batch", int),
+             "--window-ms": ("window_ms", float),
+             "--swaps": ("n_swaps", int)}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg not in flags or i + 1 >= len(argv):
+            raise SystemExit(f"model_swap: bad or valueless arg {arg!r}")
+        name, cast = flags[arg]
+        kwargs[name] = cast(argv[i + 1])
+        i += 2
+    return bench_model_swap(**kwargs)
+
+
 def bench_kernel_search(seq_lens: tuple = (128,), blocks: "tuple | None" = None,
                         steps: int = 3, rounds: int = 3, seed: int = 0,
                         state_path: "str | None" = None,
@@ -3366,6 +3608,16 @@ if __name__ == "__main__":
         # secondary. Pure-CPU virtual-time sim — no re-exec needed.
         rec = _fleet_serve_cli(sys.argv[2:])
         for srec in fleet_serve_stage_records(rec.get("fleet_stage_ms")):
+            print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
+        print(json.dumps(rec, ensure_ascii=False))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "model_swap":
+        # Subcommand mode (ISSUE 20): ONE stdout line = the lifecycle
+        # record (swap-under-load quantiles, canary/promotion A/B, paging
+        # wake vs cold restore); per-swap-stage quantile lines ride on
+        # stderr like every secondary.
+        rec = _model_swap_cli(sys.argv[2:])
+        for srec in model_swap_stage_records(rec.get("swap_stage_ms")):
             print(f"secondary: {json.dumps(srec)}", file=sys.stderr)
         print(json.dumps(rec, ensure_ascii=False))
         sys.exit(0)

@@ -354,7 +354,9 @@ class TestRepoGateAndRunner:
     def test_repo_proto_pass_clean(self):
         findings, scanned = proto.run(REPO_ROOT)
         assert findings == [], [f.render() for f in findings]
-        assert scanned == 6  # the six PROTO_MODULES all parsed
+        # the six PROTO_MODULES plus models/batching.py, pulled in by the
+        # ISSUE-20 swap ORDER rules (run() groups by rule.module)
+        assert scanned == 7
 
     def test_gate_of_routes_rule_families(self):
         assert gate_of("GL-PROTO-EPOCH") == "protolint"
@@ -394,7 +396,7 @@ class TestRepoGateAndRunner:
         data = json.loads(out.read_text(encoding="utf-8"))
         assert set(data["gates"]) == {"protolint"}
         assert data["gates"]["protolint"]["active"] == 0
-        assert data["gates"]["protolint"]["files"] == 6
+        assert data["gates"]["protolint"]["files"] == 7
 
     def test_cli_comma_separated_only(self, capsys):
         from vainplex_openclaw_tpu.analysis.__main__ import main
@@ -402,7 +404,7 @@ class TestRepoGateAndRunner:
                    "--only", "GL-PROTO-EPOCH,GL-PROTO-ORDER"])
         assert rc == 0
         outerr = capsys.readouterr()
-        assert outerr.out.splitlines()[-1].startswith("protolint: files=6 ")
+        assert outerr.out.splitlines()[-1].startswith("protolint: files=7 ")
 
 
 # ── ProtocolWitness ──────────────────────────────────────────────────

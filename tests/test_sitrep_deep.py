@@ -225,7 +225,7 @@ class TestGenerateAndRotate:
         assert set(report["collectors"]) == {
             "systemd_timers", "nats", "goals", "threads", "errors", "calendar",
             "gateway", "stage_quantiles", "resilience", "journal", "cluster",
-            "lifecycle", "slo", "pattern_safety"}
+            "lifecycle", "slo", "pattern_safety", "model_registry"}
         assert all(r["status"] == "skipped" for r in report["collectors"].values())
         assert report["generatedAt"].endswith("Z")
 

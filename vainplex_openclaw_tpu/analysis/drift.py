@@ -63,7 +63,10 @@ CONFIG_SITES: tuple = (
     ("vainplex_openclaw_tpu/models/serve.py",
      ("SERVE_DEFAULTS",), ("scfg", "serve_cfg"),
      ("make_local_call_llm", "shared_batcher", "_mesh_key",
-      "_resolve_mesh")),
+      "_resolve_mesh", "_registry_key")),
+    ("vainplex_openclaw_tpu/models/registry.py",
+     ("REGISTRY_DEFAULTS",), ("raw", "out", "s"),
+     ("registry_settings", "__init__")),
     ("vainplex_openclaw_tpu/parallel/plan_search.py",
      ("PLAN_SEARCH_DEFAULTS",), ("scfg",),
      ("search", "_measure_validator", "_measure_embeddings")),

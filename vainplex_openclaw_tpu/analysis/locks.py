@@ -239,6 +239,19 @@ GUARDED: tuple = (
                          "wakes", "evictions", "hibernate_failures")},
         hot=("_lock",),
     ),
+    # Versioned model registry (ISSUE 20): the version book, active/canary
+    # pointers, tenant pins, the shadow ring, and swap counters are read on
+    # the request path (resolve per enqueue, checkout per batch) — hot, and
+    # all device/disk work (device_put, checkpoint loads, placement-cache
+    # eviction) deliberately runs OUTSIDE the critical sections.
+    GuardSpec(
+        module="vainplex_openclaw_tpu/models/registry.py",
+        cls="ModelRegistry",
+        locks={"_lock": ("_versions", "_placed", "_active", "_previous",
+                         "_canary", "_canary_fraction", "_pins", "_shadow",
+                         "_resolved", "swaps", "rollbacks", "promotions")},
+        hot=("_lock",),
+    ),
 )
 
 

@@ -202,6 +202,18 @@ ORDER_RULES: tuple = (
               "ClusterSupervisor.retire_worker",
               first="drain_worker", then="handoff", forbid_early=True,
               invariant="drain-before-retire"),
+    # Hot weight swap (ISSUE 20): the swap protocol's legs are strictly
+    # drain → place → resume. Placing before the open bucket window is
+    # drained would mix versions inside one batch; resuming (flipping the
+    # active pointer) before the new version's params are placed would
+    # stall the first post-swap batch on a cold device_put — exactly the
+    # serving-path cost the hot swap exists to avoid.
+    OrderRule(f"{_PKG}/models/batching.py", "ContinuousBatcher.swap_to",
+              first="_swap_drain", then="_swap_place", forbid_early=True,
+              invariant="drain-before-place"),
+    OrderRule(f"{_PKG}/models/batching.py", "ContinuousBatcher.swap_to",
+              first="_swap_place", then="_swap_resume", forbid_early=True,
+              invariant="place-before-resume"),
 )
 
 ACK_RULES: tuple = (

@@ -89,6 +89,15 @@ FLEET_DEFAULTS = {
     "routeSubject": "cluster.fleet",
     "ackSubject": "cluster.fleetack",
     "ackEvery": 8,
+    # Model lifecycle (ISSUE 20): a fleet-wide versioned registry —
+    # versions resolve at the FLEET EDGE (tenant pin > canary > active)
+    # before the route publish, so the version rides the route-log
+    # payload and redelivery/adoption serve redelivered requests by
+    # their original stamp. Version decisions (activate/canary/pin) are
+    # ctl events a replacement supervisor replays. Bool or dict
+    # (models/registry.REGISTRY_DEFAULTS); default off keeps the PR-17
+    # single-version fleet verbatim.
+    "modelRegistry": False,
 }
 
 
@@ -158,7 +167,7 @@ class ReplicaFleet:
                  workers: Callable[[], list], logger=None,
                  batcher_factory: Optional[Callable] = None,
                  on_result: Optional[Callable[[dict, dict], None]] = None,
-                 adopt: bool = False):
+                 adopt: bool = False, registry=None):
         cfg = dict(FLEET_DEFAULTS)
         cfg.update(config or {})
         self.cfg = cfg
@@ -182,6 +191,21 @@ class ReplicaFleet:
 
         self.admission = AdmissionController.from_config(
             cfg.get("admission") or None)
+        # Model lifecycle (ISSUE 20): ONE registry per fleet — version
+        # decisions are fleet-wide, ctl-logged, and every replica batcher
+        # shares it (injected via the default factory). An explicit
+        # ``registry=`` wins (sim rigs book stub versions); otherwise an
+        # enabled config section builds one with the fleet checkpoint
+        # bootstrapped as the incumbent "v0".
+        self.registry = registry
+        if self.registry is None:
+            from ..models.registry import ModelRegistry, registry_settings
+
+            rcfg = registry_settings(cfg.get("modelRegistry", False))
+            if rcfg["enabled"]:
+                self.registry = ModelRegistry(
+                    rcfg, name=f"fleet:{cfg.get('routeSubject', 'cluster.fleet')}")
+                self.registry.register("v0", cfg.get("checkpointDir"))
 
         # ── guarded state (self._lock; see the GUARDED table) ────────────
         self._lock = threading.Lock()
@@ -228,7 +252,7 @@ class ReplicaFleet:
         scfg_fleet["admission"] = None
         scope = f"{worker_id}:fleet:{rid}"
         return (shared_batcher(self.cfg.get("checkpointDir"), scfg_fleet,
-                               scope=scope), scope)
+                               scope=scope, registry=self.registry), scope)
 
     def _pick_worker(self) -> str:
         """Live worker with the fewest resident replicas (deterministic
@@ -434,9 +458,14 @@ class ReplicaFleet:
             rep = self._replicas.get(rid)
         if rep is None:
             return None
+        kwargs: dict = {"at": op.get("at")}
+        if op.get("version") is not None:
+            # Keyword only when stamped: injected sim batchers predating
+            # the version seam keep their enqueue signature working.
+            kwargs["version"] = op.get("version")
         ticket = rep.batcher.enqueue(str(op.get("text") or ""),
                                      str(op.get("tenant") or "serve"),
-                                     at=op.get("at"))
+                                     **kwargs)
         with self._lock:
             rep.fifo.append((seq, op, ticket))
             rep.pending += 1
@@ -465,6 +494,13 @@ class ReplicaFleet:
                     self.shed += 1
                 self.on_result(dict(op), {"shed": True})
                 return None
+        if self.registry is not None and op.get("version") is None:
+            # Version resolved at the fleet EDGE, before the publish: the
+            # stamp rides the route-log payload, so a redelivered or
+            # adopted request is served by the version that admitted it —
+            # never silently re-resolved onto whatever is active later.
+            op = dict(op, version=self.registry.resolve(
+                str(op.get("tenant") or "serve")))
         pc = time.perf_counter
         t0 = pc()
         rid = self._route(op)
@@ -550,6 +586,10 @@ class ReplicaFleet:
             obs = ({"error": str(ticket.error)} if ticket.error is not None
                    else {"verdict": ticket.result,
                          "latMs": (done_at - ticket.enqueued_at) * 1e3})
+            if getattr(ticket, "version", None) is not None:
+                # Every verdict carries the version that served it — the
+                # chaos rig's mis-versioned count reads this (ISSUE 20).
+                obs["version"] = ticket.version
             self.on_result(op, obs)
         if to_publish is not None:
             self._publish(self._ack_subject, "cluster.fleet.ack",
@@ -604,6 +644,94 @@ class ReplicaFleet:
             self._scale_events.append(decision)
         return decision
 
+    # ── model lifecycle ctl (ISSUE 20) ───────────────────────────────
+
+    def _publish_model(self, op: str, version: str = "", tenant: str = "",
+                       fraction: float = 0.0, reason: str = "") -> None:
+        self._publish(self._ctl_subject, "cluster.fleet.ctl",
+                      {"action": "model", "op": op, "version": version,
+                       "tenant": tenant, "fraction": fraction,
+                       "reason": reason})
+
+    def activate_model(self, version: str, reason: str = "rollout") -> None:
+        """Fleet-wide hot swap, ctl-logged BEFORE application (the TACCL
+        discipline: the decision is a replayable schedule entry, so a
+        replacement supervisor adopting from the route log lands on the
+        same active version). Application runs the per-replica swap
+        protocol — drain the open window, place once through the shared
+        placement cache, resume — rollback included (activate the
+        registry's rollback target)."""
+        if self.registry is None:
+            raise RuntimeError("fleet has no model registry "
+                               "(cluster fleet modelRegistry is off)")
+        self._publish_model("activate", version=str(version), reason=reason)
+        self._apply_model({"op": "activate", "version": str(version)})
+
+    def set_model_canary(self, version: str, fraction: float,
+                         reason: str = "canary") -> None:
+        if self.registry is None:
+            raise RuntimeError("fleet has no model registry")
+        self._publish_model("canary", version=str(version),
+                            fraction=float(fraction), reason=reason)
+        self._apply_model({"op": "canary", "version": str(version),
+                           "fraction": float(fraction)})
+
+    def pin_tenant_model(self, tenant: str, version: str,
+                         reason: str = "pin") -> None:
+        if self.registry is None:
+            raise RuntimeError("fleet has no model registry")
+        self._publish_model("pin", version=str(version), tenant=str(tenant),
+                            reason=reason)
+        self._apply_model({"op": "pin", "version": str(version),
+                           "tenant": str(tenant)})
+
+    def unpin_tenant_model(self, tenant: str, reason: str = "unpin") -> None:
+        if self.registry is None:
+            raise RuntimeError("fleet has no model registry")
+        self._publish_model("pin", tenant=str(tenant), reason=reason)
+        self._apply_model({"op": "pin", "tenant": str(tenant)})
+
+    def _apply_model(self, payload: dict) -> None:
+        """Apply one model ctl payload to the fleet registry — the shared
+        path for live verbs and adoption replay. Replayed versions this
+        generation has not (yet) registered are skipped with a warning,
+        never a crash: adoption must finish even when a deployment trimmed
+        its version book."""
+        reg = self.registry
+        if reg is None:
+            return
+        op = str(payload.get("op") or "")
+        version = str(payload.get("version") or "")
+        if version and not reg.has(version):
+            if self.logger is not None:
+                self.logger.warn(f"[fleet] model ctl {op!r} skipped: "
+                                 f"version {version!r} not registered "
+                                 "in this generation")
+            return
+        if op == "activate":
+            with self._lock:
+                rids = sorted(r.rid for r in self._replicas.values()
+                              if r.alive)
+            for rid in rids:
+                with self._lock:
+                    rep = self._replicas.get(rid)
+                if rep is not None and rep.alive \
+                        and hasattr(rep.batcher, "swap_to"):
+                    rep.batcher.swap_to(version)
+            if reg.active() != version:  # no replicas live yet (adoption)
+                reg.activate(version)
+        elif op == "canary":
+            if version:
+                reg.set_canary(version, float(payload.get("fraction") or 0.0))
+            else:
+                reg.clear_canary()
+        elif op == "pin":
+            tenant = str(payload.get("tenant") or "")
+            if version:
+                reg.pin(tenant, version)
+            else:
+                reg.unpin(tenant)
+
     # ── adoption (replacement supervisor) ────────────────────────────
 
     def recover_watermark(self) -> int:
@@ -630,9 +758,16 @@ class ReplicaFleet:
         exactly-once by result keying, exactly like workspace adoption."""
         size = 0
         max_idx = -1
+        model_ops: list[dict] = []
         for event in self.transport.fetch(subject_filter=self._ctl_subject):
             payload = event.payload or {}
             action = payload.get("action")
+            if action == "model":
+                # Version decisions replay in order AFTER the fleet is
+                # re-sized — the last activate/canary/pin state wins,
+                # exactly what the previous generation was serving.
+                model_ops.append(dict(payload))
+                continue
             if action == "spawn":
                 size += 1
                 rid = str(payload.get("rid") or "")
@@ -656,6 +791,8 @@ class ReplicaFleet:
             self._acked = mark
         for _ in range(size):
             self.spawn_replica(reason="adoption")
+        for payload in model_ops:
+            self._apply_model(payload)
         redelivered = 0
         for event in self.transport.fetch(subject_filter=self._req_subject,
                                           start_seq=mark):
@@ -751,4 +888,6 @@ class ReplicaFleet:
                "lastFailover": failovers[-1] if failovers else None}
         if self.admission is not None:
             out["admission"] = self.admission.stats()
+        if self.registry is not None:
+            out["modelRegistry"] = self.registry.stats()
         return out

@@ -308,7 +308,7 @@ class ClusterSupervisor:
             return [w for w, s in self._workers.items() if s.alive]
 
     def enable_fleet(self, batcher_factory=None, on_result=None,
-                     adopt: bool = False):
+                     adopt: bool = False, registry=None):
         """Arm fleet serving (ISSUE 17) behind ``cluster.fleetServing`` —
         the escape hatch: when the flag is off this returns None and the
         single-process serve path (models/serve.make_local_call_llm) is
@@ -325,7 +325,8 @@ class ClusterSupervisor:
             transport=self.transport, clock=self.clock,
             workers=self._live_worker_ids, logger=self.logger,
             batcher_factory=batcher_factory,
-            on_result=on_result or self.on_result, adopt=adopt)
+            on_result=on_result or self.on_result, adopt=adopt,
+            registry=registry)
         return self.fleet
 
     def _worker(self, worker_id: str) -> Optional[_WorkerState]:

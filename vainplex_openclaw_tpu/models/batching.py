@@ -23,6 +23,14 @@ batch formation), ``batch`` (drain + tokenize + pad), ``prefill`` (the
 batched encoder forward), ``decode`` (severity argmax + verdict render) —
 so the serve-path bench can say WHICH stage ate a regression
 (docs/serving-perf.md).
+
+Versioned serving (ISSUE 20): with a :class:`~.registry.ModelRegistry`
+attached, tickets are version-stamped at enqueue, batches form
+version-homogeneous, params come from the registry's LRU-paged placed
+trees, and :meth:`ContinuousBatcher.swap_to` hot-swaps the active version
+(drain → place → resume — protolint-pinned order) with zero retraces and
+no teardown. ``registry=None`` keeps every prior path verbatim
+(docs/model-lifecycle.md).
 """
 
 from __future__ import annotations
@@ -68,6 +76,12 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[str] = None
     error: Optional[BaseException] = None
+    # Version stamped at enqueue (ISSUE 20): the registry resolves it
+    # once (pin > canary > active) and the ticket is SERVED by exactly
+    # this version whatever swaps land later — "mis-versioned" means the
+    # serving version disagreed with this stamp, and the chaos rig pins
+    # that count at zero through swap + rollback storms.
+    version: Optional[str] = None
 
 
 class ContinuousBatcher:
@@ -92,14 +106,27 @@ class ContinuousBatcher:
                  plan_family: str = "encoder_validator",
                  searched_plans: bool = True,
                  long_threshold: int = 1024,
-                 model_fn: Optional[Callable] = None):
+                 model_fn: Optional[Callable] = None,
+                 registry=None):
         # Fleet sim seam (ISSUE 17): ``model_fn(texts) -> [severity]``
         # replaces the checkpoint forward entirely — queue/window/verdict
         # plumbing runs verbatim while service time is whatever the
         # injected fn (and its virtual clock) says. Checkpoint-backed
-        # construction keeps the LOUD no-checkpoint contract.
+        # construction keeps the LOUD no-checkpoint contract. With a
+        # registry attached the sim contract is ``model_fn(texts,
+        # version)`` — version-dependent severities are what make a
+        # mis-versioned verdict detectable at all.
+        #
+        # Model registry seam (ISSUE 20): a ModelRegistry makes the
+        # batcher multi-version — tickets are stamped at enqueue, batches
+        # form version-homogeneous, params come from registry.checkout
+        # (LRU-paged placed trees) instead of load_pretrained, and
+        # swap_to() hot-swaps the active version without teardown.
+        # ``registry=None`` (serve.modelRegistry off) keeps every prior
+        # path byte-for-byte — the equivalence oracle.
         self.model_fn = model_fn
-        if model_fn is None:
+        self.registry = registry
+        if model_fn is None and registry is None:
             from .pretrained import available
 
             if not available(checkpoint_dir):
@@ -154,13 +181,18 @@ class ContinuousBatcher:
     # ── request surface ──────────────────────────────────────────────
 
     def enqueue(self, text: str, tenant: str = "serve",
-                at: Optional[float] = None) -> _Pending:
+                at: Optional[float] = None,
+                version: Optional[str] = None) -> _Pending:
         """Queue one request WITHOUT waiting — the fleet router's surface
         (ISSUE 17): the supervisor enqueues on the chosen replica and pumps
         batches itself, acking the route log as tickets complete. Admission
         and shed semantics are byte-for-byte :meth:`submit`'s; ``at``
         overrides the enqueue timestamp so virtual-time drivers attribute
-        queue wait in sim seconds. Returns the ticket."""
+        queue wait in sim seconds. ``version`` (ISSUE 20) pre-stamps the
+        serving version — the fleet edge resolves it BEFORE the route-log
+        publish so redelivery preserves it; local callers leave it None
+        and the attached registry resolves (pin > canary > active) here.
+        Returns the ticket."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher closed")
@@ -172,8 +204,13 @@ class ContinuousBatcher:
                     self.shed += 1
                 raise ServeSheddedError(
                     f"serve admission shed (queue depth {depth})")
+        if self.registry is not None:
+            if version is None:
+                version = self.registry.resolve(tenant)
+            self.registry.shadow_note(text)
         req = _Pending(text=text, tenant=tenant,
-                       enqueued_at=self._clock() if at is None else at)
+                       enqueued_at=self._clock() if at is None else at,
+                       version=version)
         with self._nonempty:
             self._queue.append(req)
             self._nonempty.notify()
@@ -195,8 +232,25 @@ class ContinuousBatcher:
 
     def _drain(self) -> list:
         with self._lock:
-            batch, self._queue = (self._queue[:self.max_batch],
-                                  self._queue[self.max_batch:])
+            if self.registry is None:
+                batch, self._queue = (self._queue[:self.max_batch],
+                                      self._queue[self.max_batch:])
+            else:
+                # Version-homogeneous formation (ISSUE 20): one batch =
+                # one placed param tree. The head request's version leads;
+                # same-version followers join up to max_batch, everything
+                # else keeps its queue order for the next drain — so a
+                # mixed queue around a swap serves strictly per-stamp
+                # (zero mis-versioned), at worst one extra batch per
+                # version transition.
+                head_v = self._queue[0].version if self._queue else None
+                batch, rest = [], []
+                for req in self._queue:
+                    if len(batch) < self.max_batch and req.version == head_v:
+                        batch.append(req)
+                    else:
+                        rest.append(req)
+                self._queue = rest
         if self.admission is not None:
             with self._lock:
                 depth = len(self._queue)
@@ -284,7 +338,15 @@ class ContinuousBatcher:
             # sim milliseconds).
             t1 = self._clock()
             self.timer.add("batch", (t1 - t0) * 1e3)
-            classes = self.model_fn([r.text for r in batch])
+            if self.registry is not None:
+                # Versioned sim contract: the injected model sees the
+                # batch's version, so chaos rigs can make severities a
+                # function of version — the only way a mis-versioned
+                # verdict is observable.
+                classes = self.model_fn([r.text for r in batch],
+                                        batch[0].version)
+            else:
+                classes = self.model_fn([r.text for r in batch])
             t2 = self._clock()
             self.timer.add("prefill", (t2 - t1) * 1e3)
             for req, cls in zip(batch, classes):
@@ -293,12 +355,24 @@ class ContinuousBatcher:
             with self._lock:
                 self.served += len(batch)
                 self.batches += 1
+            if self.registry is not None:
+                self.registry.note_served(batch[0].version, len(batch))
             self.timer.add("decode", (self._clock() - t2) * 1e3)
             return
-        loaded = load_pretrained(self.checkpoint_dir)
-        if loaded is None:
-            raise RuntimeError("continuous serve: checkpoint no longer loadable")
-        cfg, params = loaded
+        batch_version = batch[0].version
+        reg_key = None
+        if self.registry is not None:
+            # Registry-owned params (ISSUE 20): the batch's stamped
+            # version decides the tree — checkout wakes a paged version
+            # (device_put from the host cache) and LRU-evicts colder
+            # placed trees. Same cfg ⇒ same compiled variants below.
+            cfg, params, reg_key = self.registry.checkout(batch_version)
+        else:
+            loaded = load_pretrained(self.checkpoint_dir)
+            if loaded is None:
+                raise RuntimeError(
+                    "continuous serve: checkpoint no longer loadable")
+            cfg, params = loaded
         tokens = encode_texts([r.text for r in batch], cfg.seq_len,
                               cfg.vocab_size)
         if self.mesh is not None:
@@ -343,7 +417,8 @@ class ContinuousBatcher:
             self.timer.add("batch", (t1 - t0) * 1e3)
             from .pretrained import DEFAULT_DIR
 
-            ckpt_key = os.path.abspath(self.checkpoint_dir or DEFAULT_DIR)
+            ckpt_key = reg_key if reg_key is not None else \
+                os.path.abspath(self.checkpoint_dir or DEFAULT_DIR)
             placed = [
                 (idx, sub_plan,
                  sharding_plan.sharded_params(ckpt_key, params, self.mesh,
@@ -396,7 +471,89 @@ class ContinuousBatcher:
         with self._lock:
             self.served += len(batch)
             self.batches += 1
+        if self.registry is not None:
+            self.registry.note_served(batch_version, len(batch))
         self.timer.add("decode", (self._clock() - t2) * 1e3)
+
+    # ── hot weight swap (ISSUE 20) ───────────────────────────────────
+
+    def swap_to(self, version: str) -> dict:
+        """Zero-downtime swap to ``version`` — the PR-12 planned-handoff
+        shape applied to weights: **drain** the open bucket window (serve
+        every request queued before the swap started), **place** the new
+        version's params through the placement cache (pre-warmed, blocked
+        until device-resident), then **resume** (flip the registry's
+        active pointer so new enqueues stamp the new version). No batcher
+        teardown and no recompile: the compiled variants key on (cfg,
+        mesh, plan), which the swap never changes. The stage order is a
+        protocol invariant (protolint GL-PROTO-ORDER): place-before-drain
+        would serve pre-swap stamps from a half-warm tree, resume-before-
+        place would stall the first post-swap batch on placement. Stage
+        walls land in the StageTimer (``swap_drain``/``swap_place``/
+        ``swap_resume``) and come back in the result for the bench.
+        Rollback is this method with :meth:`~.registry.ModelRegistry.
+        rollback_target` — the same protocol in reverse."""
+        if self.registry is None:
+            raise RuntimeError(
+                "swap_to requires a model registry "
+                "(serve.modelRegistry is off)")
+        t0 = self._clock()
+        drained = self._swap_drain(t0)
+        t1 = self._clock()
+        self.timer.add("swap_drain", (t1 - t0) * 1e3)
+        self._swap_place(version)
+        t2 = self._clock()
+        self.timer.add("swap_place", (t2 - t1) * 1e3)
+        self._swap_resume(version)
+        t3 = self._clock()
+        self.timer.add("swap_resume", (t3 - t2) * 1e3)
+        return {"version": str(version), "drained": drained,
+                "stages": {"drain": (t1 - t0) * 1e3,
+                           "place": (t2 - t1) * 1e3,
+                           "resume": (t3 - t2) * 1e3},
+                "totalMs": (t3 - t0) * 1e3}
+
+    def _swap_drain(self, cutoff: float) -> int:
+        """Serve until no queued request predates ``cutoff`` — the open
+        bucket window empties, but concurrent enqueues landing DURING the
+        swap don't extend it (they are already stamped and will be served
+        by their stamped version after resume — zero dropped, zero
+        mis-versioned, bounded drain)."""
+        served = 0
+        while True:
+            with self._lock:
+                pending = any(r.enqueued_at <= cutoff for r in self._queue)
+            if not pending:
+                return served
+            served += self.step()
+
+    def _swap_place(self, version: str) -> None:
+        """Pre-place the new version: checkout (device_put from the host
+        cache) and, on a mesh, push the tree through the placement cache
+        for the resolved plan — the first post-resume batch finds its
+        shards already resident instead of paying placement inline."""
+        import jax
+
+        if getattr(self.registry, "is_stub", lambda v: False)(version):
+            return  # sim version: no params to place, drain/resume suffice
+        cfg, params, key = self.registry.checkout(version)
+        if self.mesh is not None:
+            from ..parallel import plan as sharding_plan
+
+            plan = sharding_plan.resolve_plan(
+                self.plan_family, self.mesh, searched=self.searched_plans)
+            placed = sharding_plan.sharded_params(key, params, self.mesh,
+                                                  plan)
+            jax.tree_util.tree_map(
+                lambda a: a.block_until_ready()
+                if hasattr(a, "block_until_ready") else a, placed)
+        else:
+            jax.tree_util.tree_map(
+                lambda a: a.block_until_ready()
+                if hasattr(a, "block_until_ready") else a, params)
+
+    def _swap_resume(self, version: str) -> None:
+        self.registry.activate(version)
 
     # ── lifecycle / observability ────────────────────────────────────
 
@@ -428,6 +585,10 @@ class ContinuousBatcher:
                 "auxMean": round(self._moe_aux_sum / self._moe_batches, 6),
                 "batches": self._moe_batches,
             }
+        if self.registry is not None:
+            # Pointer only — the full version book is the sitrep
+            # model_registry panel's job (registry.stats()).
+            base["activeVersion"] = self.registry.active()
         if self.admission is not None:
             base["admission"] = self.admission.stats()
         base["stages"] = self.timer.snapshot()

@@ -1,0 +1,520 @@
+"""Versioned model registry: hot weight swap, canary/shadow promotion, and
+LRU weight paging behind the continuous batcher (ISSUE 20, ROADMAP item 4).
+
+``restore_checkpoint(mesh=, plan=)`` could reshard any checkpoint onto any
+mesh, but nothing could *swap* one under live traffic — a weight update
+meant tearing down a batcher and paying a cold restore + recompile on the
+serving path. This module applies three established shapes to weights:
+
+- **Hot swap** is the PR-12 planned-handoff shape: drain the open bucket
+  window → place the new version's params through the existing
+  ``parallel/plan.py`` placement cache → resume
+  (:meth:`~.batching.ContinuousBatcher.swap_to`). No batcher teardown and
+  no recompile — the compiled serve variants are keyed ``(cfg, mesh,
+  family)``, so two versions of the SAME architecture share every compiled
+  program; only the placed param tree changes (RetraceWitness pins zero
+  retraces through a swap in tests/test_model_lifecycle.py).
+- **Promotion** is FastKernels' regression-gated-artifact discipline
+  (PAPERS.md) applied to checkpoints: a candidate promotes only by beating
+  the incumbent-as-oracle — pinned-bench win (:data:`REGISTRY_DEFAULTS`
+  ``benchFactor``) AND zero verdict regressions on shadow replay of the
+  recent-traffic ring. Canary fractions split live traffic
+  deterministically (counter-based, bit-reproducible — no RNG on the
+  serving path); rollback is the same swap in reverse
+  (:meth:`rollback_target`).
+- **Weight paging** is the PR-11 hibernation pattern applied to placed
+  params: past ``maxResidentVersions`` the LRU version's *device* arrays
+  are dropped (its placement-cache entries evicted via
+  ``plan.drop_sharded_params``) while the host tree stays cached — wake is
+  a ``device_put`` + re-place, counted and timed by the shared
+  :class:`~..storage.lifecycle.LifecycleManager`, p99 well under a cold
+  ``restore_checkpoint`` (disk npz + cast) on the same checkpoint.
+
+``serve.modelRegistry`` (default **off**) is the escape hatch: off keeps
+the single-version PR 14–18 serving path byte-for-byte intact as the
+equivalence oracle. Registries self-register by name for the sitrep
+``model_registry`` collector (/ops panel), in-process and I/O-free.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..storage.lifecycle import LifecycleManager
+
+# Registry knobs (GL-DRIFT-CONFIG site), resolved from the governance
+# llmValidator config's ``serve.modelRegistry`` section (bool or dict) by
+# :func:`registry_settings` — the same shape discipline as
+# ``storage.lifecycle``. ``false`` IS the old single-version path verbatim.
+REGISTRY_DEFAULTS = {
+    "enabled": False,
+    # LRU weight paging: resident *placed* (device) trees beyond this are
+    # evicted coldest-first; host trees always stay cached, so wake is a
+    # device_put, never a disk restore.
+    "maxResidentVersions": 4,
+    # Deterministic share of unpinned traffic routed to the canary version
+    # (counter-based split — the n-th resolution serves the canary iff
+    # floor(n·f) advanced, so reruns are bit-identical).
+    "canaryFraction": 0.0,
+    # Recent served texts kept for shadow replay (the promotion gate's
+    # verdict-regression oracle input). 0 disables the ring.
+    "shadowWindow": 64,
+    # Pinned-bench leg of the promotion gate: candidate p50 over the
+    # shadow ring must be <= incumbent p50 × benchFactor (a materially
+    # slower candidate loses even with clean verdicts).
+    "benchFactor": 1.25,
+    "benchRounds": 3,
+}
+
+
+def registry_settings(raw, default_enabled: bool = False) -> dict:
+    """Resolve a ``serve.modelRegistry`` section (bool or dict) into full
+    settings — the ``lifecycle_settings`` shape discipline."""
+    out = dict(REGISTRY_DEFAULTS)
+    out["enabled"] = default_enabled
+    if isinstance(raw, bool):
+        out["enabled"] = raw
+    elif isinstance(raw, dict):
+        out.update({k: v for k, v in raw.items() if k in out})
+        out["enabled"] = bool(raw.get("enabled", True))
+    return out
+
+
+@dataclass
+class ModelVersion:
+    """One registered version: identity, host-cached params, lifecycle
+    state (``registered|canary|active|standby``) and serve accounting."""
+
+    version: str
+    checkpoint_dir: str
+    cfg: object
+    params: object            # HOST tree (numpy) — paging never drops it
+    state: str = "registered"
+    served: int = 0
+    registered_at: float = 0.0
+    stub: bool = False        # sim-only version (model_fn batchers)
+
+
+class ModelRegistry:
+    """Process-resident version book for one serving surface.
+
+    The batcher reads it per batch (:meth:`resolve` at enqueue,
+    :meth:`checkout` at serve) and drives the swap protocol through it
+    (:meth:`activate` is the resume leg). Placement/paging I/O
+    (``device_put``, cache eviction, checkpoint loads) always runs OUTSIDE
+    the registry lock — the same hot-lock discipline as
+    :class:`LifecycleManager` (GUARDED table, analysis/locks.py).
+    """
+
+    def __init__(self, settings=None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 name: str = "serve", logger=None):
+        if isinstance(settings, dict):
+            s = dict(REGISTRY_DEFAULTS)
+            s.update({k: v for k, v in settings.items() if k in s})
+            s["enabled"] = bool(settings.get("enabled", True))
+        else:
+            s = registry_settings(settings, default_enabled=True)
+        self.settings = s
+        self.clock = clock
+        self.name = str(name)
+        self.logger = logger
+        self._shadow_cap = max(0, int(s["shadowWindow"]))
+        self._bench_factor = float(s["benchFactor"])
+        self._bench_rounds = max(1, int(s["benchRounds"]))
+        # Paging manager: LRU over PLACED trees only. Reuses the workspace
+        # hibernation machinery verbatim — versions are just string keys,
+        # the hibernate callback drops device arrays, wake accounting
+        # (p50/p99) lands in the same stats shape /ops already renders.
+        self._pager = LifecycleManager(
+            {"maxResident": max(1, int(s["maxResidentVersions"])),
+             "idleSeconds": 0.0},
+            clock=clock, logger=logger)
+        # ── guarded state (self._lock; GUARDED table, ISSUE 20) ──────
+        self._lock = threading.Lock()
+        self._versions: dict[str, ModelVersion] = {}
+        self._placed: dict[str, object] = {}   # version -> device tree
+        self._active: Optional[str] = None
+        self._previous: Optional[str] = None   # rollback target
+        self._canary: Optional[str] = None
+        self._canary_fraction = float(s["canaryFraction"])
+        self._pins: dict[str, str] = {}        # tenant -> version
+        self._shadow: list[str] = []           # recent served texts
+        self._resolved = 0                     # canary split counter
+        self.swaps = 0
+        self.rollbacks = 0
+        self.promotions = 0
+        register_registry(self.name, self)
+
+    # ── version book ─────────────────────────────────────────────────
+
+    def register(self, version: str, checkpoint_dir: Optional[str] = None,
+                 activate: bool = False) -> ModelVersion:
+        """Load ``checkpoint_dir`` (shipped default when None) and book it
+        under ``version``. LOUD on a missing checkpoint — a silently empty
+        version would serve nothing and look healthy. The first registered
+        version bootstraps as active (the incumbent)."""
+        import jax
+        import numpy as np
+
+        from .pretrained import DEFAULT_DIR, load_pretrained
+
+        loaded = load_pretrained(checkpoint_dir)  # disk I/O outside the lock
+        if loaded is None:
+            raise RuntimeError(
+                f"model registry refused version {version!r}: no trained "
+                f"checkpoint at {checkpoint_dir or 'the shipped default'}")
+        cfg, params = loaded
+        # Host copy per version: paging drops only the device tree, and two
+        # versions registered from one directory must not share identity
+        # (the placement cache pins `hit is params`).
+        host = jax.tree_util.tree_map(np.asarray, params)
+        mv = ModelVersion(
+            version=str(version),
+            checkpoint_dir=os.path.abspath(checkpoint_dir or DEFAULT_DIR),
+            cfg=cfg, params=host, registered_at=self.clock())
+        self._book(mv, activate)
+        self._pager.register(mv.version, self._make_dropper(mv.version),
+                             owner="registry")
+        return mv
+
+    def register_stub(self, version: str,
+                      activate: bool = False) -> ModelVersion:
+        """Book a checkpoint-less version for ``model_fn`` sim batchers
+        (fleet chaos rigs): resolution/canary/pinning/ctl plumbing runs
+        verbatim, :meth:`checkout` refuses (sims never load params)."""
+        mv = ModelVersion(version=str(version), checkpoint_dir="",
+                          cfg=None, params=None, stub=True,
+                          registered_at=self.clock())
+        self._book(mv, activate)
+        return mv
+
+    def _book(self, mv: ModelVersion, activate: bool) -> None:
+        with self._lock:
+            if mv.version in self._versions:
+                raise ValueError(
+                    f"model version {mv.version!r} already registered")
+            self._versions[mv.version] = mv
+            if activate or self._active is None:
+                self._previous = self._active
+                self._active = mv.version
+                mv.state = "active"
+
+    def has(self, version: str) -> bool:
+        with self._lock:
+            return str(version) in self._versions
+
+    def versions(self) -> list[str]:
+        with self._lock:
+            return sorted(self._versions)
+
+    def active(self) -> Optional[str]:
+        with self._lock:
+            return self._active
+
+    def rollback_target(self) -> Optional[str]:
+        """The previous active — rollback is ``swap_to(rollback_target())``,
+        the same protocol in reverse (no special path to rot)."""
+        with self._lock:
+            return self._previous
+
+    # ── request-time resolution ──────────────────────────────────────
+
+    def resolve(self, tenant: str = "serve") -> Optional[str]:
+        """Version for one request: tenant pin > deterministic canary
+        split > active. Counter-based split (no RNG): resolution n serves
+        the canary iff floor(n·f) > floor((n-1)·f) — exact fraction f,
+        bit-identical across reruns."""
+        with self._lock:
+            pin = self._pins.get(str(tenant))
+            if pin is not None and pin in self._versions:
+                return pin
+            if self._canary is not None and self._canary_fraction > 0:
+                self._resolved += 1
+                n, f = self._resolved, self._canary_fraction
+                if math.floor(n * f) > math.floor((n - 1) * f):
+                    return self._canary
+            return self._active
+
+    def checkout(self, version: str):
+        """``(cfg, placed_params, placement_key)`` for one batch — the
+        batcher's per-batch surface. Wakes a paged version (``device_put``
+        from the cached host tree, timed + counted) and LRU-evicts colder
+        versions' placed trees. All device/cache work outside the lock."""
+        import jax
+
+        v = str(version)
+        with self._lock:
+            mv = self._versions.get(v)
+            placed = self._placed.get(v)
+        if mv is None:
+            raise KeyError(f"unknown model version {v!r}")
+        if mv.stub:
+            raise RuntimeError(
+                f"model version {v!r} is a sim stub — checkout needs a "
+                "checkpoint-backed version")
+        key = f"{mv.checkpoint_dir}::{v}"
+        if placed is None:
+            was_sleeping = self._pager.is_sleeping(v)
+            t0 = self.clock()
+            fresh = jax.device_put(mv.params)
+            jax.tree_util.tree_map(
+                lambda a: a.block_until_ready()
+                if hasattr(a, "block_until_ready") else a, fresh)
+            wake_ms = (self.clock() - t0) * 1e3
+            with self._lock:
+                placed = self._placed.setdefault(v, fresh)
+            # Hibernation dropped the owner callback (the manager pins no
+            # closures for sleepers) — the wake path must re-register its
+            # dropper or the NEXT eviction of this version runs no-op.
+            self._pager.register(v, self._make_dropper(v))
+            if was_sleeping:
+                self._pager.note_wake(v, wake_ms)
+        victims = self._pager.note_traffic(v)
+        for victim in victims:
+            self._pager.hibernate(victim)
+        return mv.cfg, placed, key
+
+    def placement_key(self, version: str) -> str:
+        """Placement-cache identity for ``version`` — suffixed with the
+        version id so twin versions from one directory never collide, and
+        the batcher's registry-less default key stays untouched."""
+        v = str(version)
+        with self._lock:
+            mv = self._versions.get(v)
+        if mv is None:
+            raise KeyError(f"unknown model version {v!r}")
+        return f"{mv.checkpoint_dir}::{v}"
+
+    def note_served(self, version: str, n: int = 1) -> None:
+        with self._lock:
+            mv = self._versions.get(str(version))
+            if mv is not None:
+                mv.served += int(n)
+
+    def is_paged(self, version: str) -> bool:
+        return self._pager.is_sleeping(str(version))
+
+    def is_stub(self, version: str) -> bool:
+        with self._lock:
+            mv = self._versions.get(str(version))
+        return bool(mv is not None and mv.stub)
+
+    def _make_dropper(self, version: str):
+        def _drop() -> None:
+            from ..parallel.plan import drop_sharded_params
+
+            with self._lock:
+                self._placed.pop(version, None)
+            drop_sharded_params(self.placement_key(version))
+        return _drop
+
+    # ── swap / canary / pinning control plane ────────────────────────
+
+    def activate(self, version: str) -> None:
+        """Flip the active pointer — the RESUME leg of a hot swap
+        (:meth:`~.batching.ContinuousBatcher.swap_to` calls this after
+        drain + place). The displaced version stays ``standby`` (it keeps
+        serving its in-queue stragglers and is the rollback target);
+        activating the previous active counts as a rollback."""
+        v = str(version)
+        with self._lock:
+            mv = self._versions.get(v)
+            if mv is None:
+                raise KeyError(f"unknown model version {v!r}")
+            if self._active == v:
+                return
+            rollback = v == self._previous
+            prev = self._versions.get(self._active) \
+                if self._active is not None else None
+            if prev is not None and prev.state == "active":
+                prev.state = "standby"
+            self._previous = self._active
+            self._active = v
+            mv.state = "active"
+            if self._canary == v:
+                self._canary = None
+                self._canary_fraction = 0.0
+            self.swaps += 1
+            if rollback:
+                self.rollbacks += 1
+
+    def set_canary(self, version: str, fraction: float) -> None:
+        v = str(version)
+        with self._lock:
+            mv = self._versions.get(v)
+            if mv is None:
+                raise KeyError(f"unknown model version {v!r}")
+            self._canary = v
+            self._canary_fraction = max(0.0, min(1.0, float(fraction)))
+            if mv.state == "registered":
+                mv.state = "canary"
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            mv = self._versions.get(self._canary) \
+                if self._canary is not None else None
+            if mv is not None and mv.state == "canary":
+                mv.state = "registered"
+            self._canary = None
+            self._canary_fraction = 0.0
+
+    def pin(self, tenant: str, version: str) -> None:
+        v = str(version)
+        with self._lock:
+            if v not in self._versions:
+                raise KeyError(f"unknown model version {v!r}")
+            self._pins[str(tenant)] = v
+
+    def unpin(self, tenant: str) -> None:
+        with self._lock:
+            self._pins.pop(str(tenant), None)
+
+    # ── shadow traffic + promotion gate ──────────────────────────────
+
+    def shadow_note(self, text: str) -> None:
+        """Ring-buffer one served text for shadow replay (bounded by
+        ``shadowWindow``) — the enqueue path calls this per request."""
+        if self._shadow_cap <= 0:
+            return
+        with self._lock:
+            self._shadow.append(str(text))
+            if len(self._shadow) > self._shadow_cap:
+                del self._shadow[:len(self._shadow) - self._shadow_cap]
+
+    def shadow_texts(self) -> list[str]:
+        with self._lock:
+            return list(self._shadow)
+
+    def _score(self, version: str, texts: list) -> list:
+        """Oracle-path verdicts for ``texts`` under ``version`` — the
+        plain single-device forward through the shared renderer, so two
+        versions can only ever disagree through their weights."""
+        import numpy as np
+
+        from ..ops.similarity import pad_rows, pow2_bucket
+        from . import encode_texts, forward
+        from .batching import render_verdict
+
+        cfg, params, _key = self.checkout(version)
+        tokens = encode_texts(list(texts), cfg.seq_len, cfg.vocab_size)
+        out = forward(params, pad_rows(tokens, pow2_bucket(len(texts))), cfg)
+        classes = np.asarray(out["severity"])[:len(texts)].argmax(axis=-1)
+        return [render_verdict(int(c)) for c in classes]
+
+    def promotion_report(self, candidate: str,
+                         texts: Optional[list] = None) -> dict:
+        """Score ``candidate`` against the incumbent-as-oracle over the
+        shadow ring (or ``texts``): any verdict mismatch is a regression
+        (the incumbent IS the oracle), and the pinned-bench leg requires
+        candidate p50 <= incumbent p50 × ``benchFactor``. ``promote`` is
+        the conjunction — the FastKernels gate shape."""
+        with self._lock:
+            incumbent = self._active
+            ring = list(self._shadow)
+        sample = list(texts) if texts is not None else ring
+        report = {"candidate": str(candidate), "incumbent": incumbent,
+                  "replayed": len(sample), "verdictRegressions": 0,
+                  "candidateP50Ms": None, "incumbentP50Ms": None,
+                  "benchOk": True}
+        if incumbent is None or incumbent == str(candidate) or not sample:
+            report["promote"] = True
+            return report
+        # Untimed warmup leg: the candidate's first score pays one-time
+        # costs (placement device_put, a compile if its bucket is cold)
+        # that say nothing about steady-state serve — timing them would
+        # refuse every promotion whose incumbent happens to be warm.
+        cand_verdicts = self._score(candidate, sample)
+        inc_verdicts = self._score(incumbent, sample)
+        regressions = sum(1 for a, b in zip(cand_verdicts, inc_verdicts)
+                          if a != b)
+        cand_times, inc_times = [], []
+        for _ in range(self._bench_rounds):
+            t0 = self.clock()
+            cand_verdicts = self._score(candidate, sample)
+            cand_times.append((self.clock() - t0) * 1e3)
+            t0 = self.clock()
+            inc_verdicts = self._score(incumbent, sample)
+            inc_times.append((self.clock() - t0) * 1e3)
+        cand_p50 = sorted(cand_times)[len(cand_times) // 2]
+        inc_p50 = sorted(inc_times)[len(inc_times) // 2]
+        report.update({
+            "verdictRegressions": regressions,
+            "candidateP50Ms": round(cand_p50, 3),
+            "incumbentP50Ms": round(inc_p50, 3),
+            "benchOk": cand_p50 <= inc_p50 * self._bench_factor})
+        report["promote"] = report["benchOk"] and regressions == 0
+        return report
+
+    def promote(self, candidate: str,
+                report: Optional[dict] = None) -> dict:
+        """Arm a promotion: gate LOUDLY on the promotion report, count it,
+        and return the report. The caller completes the rollout with
+        ``batcher.swap_to(candidate)`` — promotion decides, the swap
+        protocol moves (one drain/place/resume path, never two)."""
+        rep = report if report is not None else self.promotion_report(candidate)
+        if not rep.get("promote"):
+            raise RuntimeError(
+                f"promotion gate refused {candidate!r}: "
+                f"{rep.get('verdictRegressions')} verdict regression(s), "
+                f"benchOk={rep.get('benchOk')}")
+        with self._lock:
+            self.promotions += 1
+        return rep
+
+    # ── observability (/ops model_registry panel) ────────────────────
+
+    def stats(self) -> dict:
+        pager = self._pager.stats()
+        resident = set(self._pager.resident_keys())
+        paged = self._pager.sleeping_keys()
+        with self._lock:
+            versions = {
+                v: {"state": mv.state, "served": mv.served,
+                    "stub": mv.stub, "resident": v in resident}
+                for v, mv in sorted(self._versions.items())}
+            out = {"enabled": True, "name": self.name,
+                   "active": self._active, "previous": self._previous,
+                   "canary": {"version": self._canary,
+                              "fraction": self._canary_fraction},
+                   "pins": dict(self._pins), "resolved": self._resolved,
+                   "swaps": self.swaps, "rollbacks": self.rollbacks,
+                   "promotions": self.promotions,
+                   "shadowBuffered": len(self._shadow),
+                   "shadowWindow": self._shadow_cap}
+        out["versions"] = versions
+        out["paging"] = {"maxResidentVersions": self._pager.max_resident,
+                         "resident": sorted(resident), "paged": paged,
+                         "wakes": pager["wakes"],
+                         "evictions": pager["evictions"],
+                         "wakeP50Ms": pager["wakeP50Ms"],
+                         "wakeP99Ms": pager["wakeP99Ms"]}
+        return out
+
+
+# ── process registry (sitrep model_registry collector, /ops) ─────────
+
+_registries: dict[str, ModelRegistry] = {}
+_registries_lock = threading.Lock()
+
+
+def register_registry(name: str, registry: ModelRegistry) -> None:
+    """Book a registry for the ops plane (latest wins per name) —
+    in-process, I/O-free, exactly like the gateway's StageTimer book."""
+    with _registries_lock:
+        _registries[str(name)] = registry
+
+
+def all_registries() -> dict:
+    with _registries_lock:
+        return dict(_registries)
+
+
+def clear_registries() -> None:
+    with _registries_lock:
+        _registries.clear()

@@ -69,6 +69,15 @@ SERVE_DEFAULTS = {
     # program; shorter rows take the dense short-path twin (same placed
     # weights). Irrelevant (and harmless) for other families.
     "longContext": {"thresholdTokens": 1024},
+    # Model lifecycle (ISSUE 20): versioned registry behind the batcher —
+    # hot weight swap (drain → place → resume, no teardown/recompile),
+    # canary fractions + shadow-replay promotion gates, per-tenant pins,
+    # LRU weight paging. Bool or dict (models/registry.REGISTRY_DEFAULTS
+    # documents the knobs). Default OFF: ``false`` IS the single-version
+    # PR 14–18 serving path byte-for-byte — the equivalence oracle, never
+    # deleted. When on, the construction checkpoint bootstraps as the
+    # active incumbent version "v0" (docs/model-lifecycle.md).
+    "modelRegistry": False,
     # Searched placement (ISSUE 16): resolve the serving plan through the
     # checked-in parallel/plan_table.json (regression-gated winners from
     # `bench.py plan_search`), hand-written rules as the fallback. `false`
@@ -155,18 +164,43 @@ def _resolve_mesh(serve_cfg: dict):
     return cached_mesh(tuple(int(s) for s in shape), axes)
 
 
+def _registry_key(serve_cfg: dict):
+    """Hashable registry identity for the batcher registry: a versioned
+    batcher must not share a queue with an unversioned one (different
+    _drain semantics and param source). Scalar knobs only — the section
+    is small and flat by contract (REGISTRY_DEFAULTS)."""
+    raw = serve_cfg.get("modelRegistry", False)
+    if isinstance(raw, dict):
+        return tuple(sorted((k, v) for k, v in raw.items()
+                            if not isinstance(v, dict)))
+    return bool(raw)
+
+
 def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict,
-                   scope: str = "global"):
+                   scope: str = "global", registry=None):
     from ..resilience.admission import AdmissionController
     from .batching import ContinuousBatcher
+    from .registry import ModelRegistry, registry_settings
 
     key = (scope, checkpoint_dir, serve_cfg["maxBatch"],
            serve_cfg["windowMs"],
            tuple(sorted((serve_cfg.get("admission") or {}).items())),
-           _mesh_key(serve_cfg))
+           _mesh_key(serve_cfg), _registry_key(serve_cfg))
     with _batchers_lock:
         batcher = _batchers.get(key)
         if batcher is None:
+            if registry is None:
+                # Model lifecycle (ISSUE 20): an enabled section builds a
+                # per-batcher registry with the construction checkpoint
+                # bootstrapped as the active incumbent "v0"; a fleet
+                # passes its own shared registry instead (version
+                # decisions are fleet-wide, ctl-logged). Default off ⇒
+                # registry None ⇒ every prior path verbatim.
+                rcfg = registry_settings(
+                    serve_cfg.get("modelRegistry", False))
+                if rcfg["enabled"]:
+                    registry = ModelRegistry(rcfg, name=f"serve:{scope}")
+                    registry.register("v0", checkpoint_dir)
             batcher = ContinuousBatcher(
                 checkpoint_dir,
                 max_batch=serve_cfg["maxBatch"],
@@ -177,7 +211,8 @@ def shared_batcher(checkpoint_dir: Optional[str], serve_cfg: dict,
                 plan_family=serve_cfg.get("planFamily", "encoder_validator"),
                 searched_plans=serve_cfg.get("searchedPlans", True),
                 long_threshold=(serve_cfg.get("longContext") or {})
-                .get("thresholdTokens", 1024))
+                .get("thresholdTokens", 1024),
+                registry=registry)
             _batchers[key] = batcher
         return batcher
 

@@ -565,6 +565,41 @@ def collect_pattern_safety(config: dict, ctx: dict) -> dict:
                         else "all compiled patterns screened clean")}
 
 
+def collect_model_registry(config: dict, ctx: dict) -> dict:
+    """Versioned serving health (ISSUE 20): per-registry version book
+    (active/previous/canary/pins), swap + rollback + promotion counters,
+    and the weight-paging view (resident vs paged versions, wake
+    quantiles). In-process and I/O-free — registries self-register by
+    name (models/registry.all_registries), exactly like the gateway's
+    StageTimer book. Warns only on a live condition: a canary armed with
+    a zero fraction serves nobody — a rollout someone forgot to open."""
+    from ..models.registry import all_registries
+
+    registries = all_registries()
+    if not registries:
+        return {"status": "skipped", "items": [],
+                "summary": "no model registries registered"}
+    items = []
+    worries = []
+    versions = swaps = paged = 0
+    for name in sorted(registries):
+        s = registries[name].stats()
+        items.append({"registry": name, **s})
+        versions += len(s.get("versions") or {})
+        swaps += s.get("swaps", 0)
+        paged += len((s.get("paging") or {}).get("paged") or [])
+        canary = s.get("canary") or {}
+        if canary.get("version") and not canary.get("fraction"):
+            worries.append(f"{name}: canary {canary['version']} armed at "
+                           "fraction 0 (serves no traffic)")
+    summary = (f"{len(items)} registr{'y' if len(items) == 1 else 'ies'}, "
+               f"{versions} version(s), {swaps} swap(s), {paged} paged")
+    if worries:
+        return {"status": "warn", "items": items,
+                "summary": summary + "; " + "; ".join(worries)}
+    return {"status": "ok", "items": items, "summary": summary}
+
+
 BUILTIN_COLLECTORS: dict[str, Callable] = {
     "systemd_timers": collect_systemd_timers,
     "nats": collect_nats,
@@ -580,6 +615,7 @@ BUILTIN_COLLECTORS: dict[str, Callable] = {
     "lifecycle": collect_lifecycle,
     "slo": collect_slo,
     "pattern_safety": collect_pattern_safety,
+    "model_registry": collect_model_registry,
 }
 
 

@@ -36,6 +36,9 @@ DEFAULTS = {
         "slo": {"enabled": True},
         # ReDoS screening rollup (ISSUE 8): reads governance status only.
         "pattern_safety": {"enabled": True},
+        # Versioned serving (ISSUE 20): registry version book, swap
+        # counters, weight-paging view. In-process, no I/O.
+        "model_registry": {"enabled": True},
     },
     "customCollectors": [],
 }
@@ -44,7 +47,8 @@ DEFAULTS = {
 # config says — the live dashboard must not go dark because an operator
 # trimmed the periodic report.
 OPS_COLLECTORS = ("gateway", "stage_quantiles", "resilience", "journal",
-                  "cluster", "lifecycle", "slo", "pattern_safety")
+                  "cluster", "lifecycle", "slo", "pattern_safety",
+                  "model_registry")
 
 MANIFEST = PluginManifest(
     id="sitrep",
@@ -226,6 +230,20 @@ class SitrepPlugin:
         if lc.get("status") != "skipped":
             lines.append(f"  {icon.get(lc.get('status'), '•')} lifecycle: "
                          f"{lc.get('summary', 'n/a')}")
+        mr = results.get("model_registry", {})
+        if mr.get("status") != "skipped":
+            lines.append(f"  {icon.get(mr.get('status'), '•')} models: "
+                         f"{mr.get('summary', 'n/a')}")
+            for item in mr.get("items", [])[:4]:
+                canary = item.get("canary") or {}
+                paging = item.get("paging") or {}
+                lines.append(
+                    f"    {item.get('registry')}: active={item.get('active')}"
+                    f" canary={canary.get('version')}@{canary.get('fraction')}"
+                    f" swaps={item.get('swaps')}"
+                    f" rollbacks={item.get('rollbacks')}"
+                    f" paged={len(paging.get('paged') or [])}"
+                    f" wakeP99={paging.get('wakeP99Ms')}ms")
         slo = results.get("slo", {})
         lines.append(f"  {icon.get(slo.get('status'), '•')} slo: "
                      f"{slo.get('summary', 'n/a')}")

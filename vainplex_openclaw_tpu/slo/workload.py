@@ -237,6 +237,17 @@ def generate_fleet_workload(seed: int = 0, n_ops: int = 2000,
     return ops
 
 
+def generate_serve_texts(seed: int = 0, n: int = 256) -> list:
+    """Seeded validator-prompt texts for serve/swap benches and the model
+    lifecycle storms (ISSUE 20): the fleet workload's message mix without
+    arrival times — callers drive their own submission schedule. A separate
+    rng stream (``serve-texts:<seed>``) and a brand-new function:
+    ``generate_workload``/``generate_fleet_workload`` draw sequences stay
+    byte-for-byte untouched (the drawing discipline the module pins)."""
+    rng = random.Random(f"serve-texts:{seed}")
+    return [_message(rng, rng.choice(ALL_LANGS), i) for i in range(int(n))]
+
+
 def workload_digest(ops: list) -> dict:
     """Checksum + mix breakdown — the deterministic identity of a run."""
     blob = json.dumps([op.to_tuple() for op in ops],

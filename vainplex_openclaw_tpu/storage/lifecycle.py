@@ -220,6 +220,17 @@ class LifecycleManager:
         with self._lock:
             return str(ws) in self._sleeping
 
+    def resident_keys(self) -> list[str]:
+        """Sorted resident keys — consumers that page non-workspace
+        residents (the model registry pages placed param trees, ISSUE 20)
+        render who is in and who is out, not just the counts."""
+        with self._lock:
+            return sorted(self._resident)
+
+    def sleeping_keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._sleeping)
+
     # ── eviction execution ───────────────────────────────────────────
 
     def hibernate(self, ws: str) -> bool:
